@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Benchmark: HDCE DML train-step throughput (samples/sec/chip) on real TPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The measured quantity is the full fused HDCE training step over the 3x3
+scenario/user grid at the reference batch size (256 per cell => 2304 samples
+per step; reference loop at ``Runner_P128_QuantumNAT_onchipQNN.py:181-204``).
+
+``vs_baseline`` is the speedup over a faithful torch-CPU implementation of the
+reference's training step (three Conv_P128 trunks + shared FC_P128 head, nine
+sequential (loss/9).backward() calls per step), measured in-process on this
+host. The reference's own hardware baseline is unpublished (SURVEY.md §6);
+BASELINE.md's target is >= 3x a single V100.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def measure_tpu(n_steps: int = 50, cell_bs: int = 256) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from qdml_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.data.datasets import make_network_batch
+    from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
+
+    cfg = ExperimentConfig(
+        data=DataConfig(), train=TrainConfig(batch_size=cell_bs, n_epochs=1)
+    )
+    geom = ChannelGeometry.from_config(cfg.data)
+    s, u = cfg.data.n_scenarios, cfg.data.n_users
+    scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, cell_bs))
+    user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, cell_bs))
+    idx = jnp.broadcast_to(jnp.arange(cell_bs)[None, None, :], (s, u, cell_bs))
+    batch = make_network_batch(
+        jnp.uint32(0), scen, user, idx, jnp.float32(cfg.data.snr_db), geom
+    )
+    batch = {k: batch[k] for k in ("yp_img", "h_label", "h_perf")}
+
+    model, state = init_hdce_state(cfg, steps_per_epoch=100)
+    step = make_hdce_train_step(model, state.tx)
+    for _ in range(3):  # warmup + compile
+        state, m = step(state, batch)
+    float(m["loss"])  # host transfer forces execution (block_until_ready is
+    # not sufficient on tunnelled backends)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, m = step(state, batch)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    return n_steps * s * u * cell_bs / dt
+
+
+def measure_torch_cpu_reference(n_steps: int = 2, cell_bs: int = 256) -> float | None:
+    """Reference-equivalent training step in torch on CPU (the only hardware
+    in this image torch can use): 3 trunks + shared head, 9 sequential
+    backwards per step, 4 Adam optimizers — the Runner...py:181-204 pattern."""
+    try:
+        import torch
+        import torch.nn as nn
+    except ImportError:
+        return None
+    torch.manual_seed(0)
+
+    def trunk():
+        layers = []
+        ch = 2
+        for _ in range(3):
+            layers += [
+                nn.Conv2d(ch, 32, 3, padding=1, bias=False),
+                nn.BatchNorm2d(32),
+                nn.ReLU(inplace=True),
+            ]
+            ch = 32
+        return nn.Sequential(*layers)
+
+    convs = [trunk() for _ in range(3)]
+    head = nn.Linear(32 * 16 * 8, 2048)
+    opts = [torch.optim.Adam(c.parameters(), lr=1e-3) for c in convs]
+    opts.append(torch.optim.Adam(head.parameters(), lr=1e-3))
+    crit = lambda a, b: torch.sum((a - b) ** 2) / torch.sum(b**2)  # noqa: E731
+
+    x = torch.randn(3, 3, cell_bs, 2, 16, 8)
+    y = torch.randn(3, 3, cell_bs, 2048)
+    # one warmup step
+    for it in range(n_steps + 1):
+        if it == 1:
+            t0 = time.perf_counter()
+        for o in opts:
+            o.zero_grad()
+        for si in range(3):
+            for ui in range(3):
+                feats = convs[si](x[si, ui]).flatten(1)
+                loss = crit(head(feats), y[si, ui]) / 9.0
+                loss.backward()
+        for o in opts:
+            o.step()
+    dt = time.perf_counter() - t0
+    return n_steps * 9 * cell_bs / dt
+
+
+def main() -> int:
+    value = measure_tpu()
+    baseline = measure_torch_cpu_reference()
+    vs = value / baseline if baseline else None
+    print(
+        json.dumps(
+            {
+                "metric": "hdce_train_samples_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "samples/sec (3x3 DML grid train step, cell batch 256)",
+                "vs_baseline": round(vs, 2) if vs else None,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""qdml_tpu — TPU-native quantum-distributed ML for RIS channel estimation.
+
+A brand-new JAX/XLA/Pallas/pjit framework with the capabilities of the reference
+repo `Fazilaton-Nisha/Quantum-Distributed-Machine-Learning-RIS-Channel-Estimation`
+(hierarchical deep channel estimation for RIS-assisted 6G with a hybrid
+quantum-classical scenario classifier), re-designed TPU-first:
+
+- the quantum layer is an in-tree, jit'd, differentiable state-vector simulator
+  (``qdml_tpu.quantum``) instead of PennyLane's CPU ``default.qubit``
+  (reference: ``Estimators_QuantumNAT_onchipQNN.py:122-149``),
+- the CNN/MLP estimators are Flax modules (``qdml_tpu.models``) trained with
+  optax (reference: torch.nn modules, ``Estimators_QuantumNAT_onchipQNN.py:40-295``),
+- QuantumNAT noise injection and on-chip-QNN gradient pruning are
+  pure-functional transforms (``qdml_tpu.ops``; reference:
+  ``Estimators_QuantumNAT_onchipQNN.py:176-228``),
+- distributed "DML" training (3 scenarios x 3 users with a shared head, plus
+  data parallelism) runs as SPMD over a ``jax.sharding.Mesh``
+  (``qdml_tpu.parallel``; reference: ``torch.nn.DataParallel``,
+  ``Runner_P128_QuantumNAT_onchipQNN.py:144-148``),
+- the missing-from-reference data module (``generate_data``) is implemented as
+  a synthetic DeepMIMO-style geometric channel generator with LS/LMMSE
+  classical baselines (``qdml_tpu.data``).
+"""
+
+__version__ = "0.1.0"
+
+from qdml_tpu import config  # noqa: F401

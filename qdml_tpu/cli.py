@@ -1,0 +1,85 @@
+"""Command-line entry points.
+
+The reference has no CLI at all — hardcoded ``__main__`` blocks
+(``Runner_P128_QuantumNAT_onchipQNN.py:432-444``, ``Test.py:339-346``). Here:
+
+    python -m qdml_tpu.cli train-hdce [--preset=NAME] [--train.lr=3e-4 ...]
+    python -m qdml_tpu.cli train-sc   [...]      # classical scenario classifier
+    python -m qdml_tpu.cli train-qsc  [...]      # quantum scenario classifier
+    python -m qdml_tpu.cli eval       [...]      # SNR sweep + plots + JSON
+    python -m qdml_tpu.cli gen-data --out=DIR    # materialise .npy cache
+
+Dotted-path overrides map onto :mod:`qdml_tpu.config` dataclasses; presets are
+the five BASELINE.json benchmark configs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from qdml_tpu import config as cfg_mod
+from qdml_tpu.utils.metrics import MetricsLogger
+
+
+def _cfg(argv):
+    extra = [a for a in argv if a.startswith("--out=")]
+    rest = [a for a in argv if not a.startswith("--out=")]
+    return cfg_mod.from_args(rest), extra
+
+
+def _workdir(cfg) -> str:
+    # reference scheme: ./workspace/Pn_128/HDCE (Runner...py:237-266)
+    return os.path.join(cfg.train.workdir, f"Pn_{cfg.data.pilot_num}", cfg.name)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    cfg, extra = _cfg(rest)
+    workdir = _workdir(cfg)
+    logger = MetricsLogger(os.path.join(workdir, f"{cmd}.metrics.jsonl"))
+    t0 = time.time()
+
+    if cmd == "train-hdce":
+        from qdml_tpu.train.hdce import train_hdce
+
+        train_hdce(cfg, logger=logger, workdir=workdir)
+    elif cmd in ("train-sc", "train-qsc"):
+        from qdml_tpu.train.qsc import train_classifier
+
+        train_classifier(cfg, quantum=(cmd == "train-qsc"), logger=logger, workdir=workdir)
+    elif cmd == "eval":
+        from qdml_tpu.eval.report import create_comparison_plots, save_results_json
+        from qdml_tpu.eval.sweep import run_snr_sweep
+        from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint
+
+        hdce_vars, _ = restore_checkpoint(workdir, "hdce_best")
+        sc_vars, _ = restore_checkpoint(workdir, "sc_best")
+        qsc_vars = None
+        if has_checkpoint(workdir, "qsc_best"):  # graceful fallback (Test.py:81-86)
+            qsc_vars, _ = restore_checkpoint(workdir, "qsc_best")
+        results = run_snr_sweep(cfg, hdce_vars, sc_vars, qsc_vars)
+        out_json = save_results_json(results, cfg.eval.results_dir)
+        out_png = create_comparison_plots(results, cfg.eval.results_dir)
+        print(f"results: {out_json} plot: {out_png}")
+    elif cmd == "gen-data":
+        from qdml_tpu.data.datasets import save_npy_cache
+
+        out = next((e.split("=", 1)[1] for e in extra), "available_data")
+        save_npy_cache(out, cfg.data)
+        print(f"wrote npy cache to {out}")
+    else:
+        print(f"unknown command {cmd!r}")
+        return 2
+    # reference prints total minutes (Runner...py:437-440)
+    print(f"total time: {(time.time() - t0) / 60.0:.2f} min")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,240 @@
+"""Configuration system (dataclasses + CLI) for qdml_tpu.
+
+The reference has no config/flag system at all -- every hyperparameter is a
+hardcoded class attribute (``Runner_P128_QuantumNAT_onchipQNN.py:20-38``,
+``Test.py:13-21``) or constructor kwarg (``Estimators_QuantumNAT_onchipQNN.py:108``).
+This module centralises all of them as frozen dataclasses, provides the five
+BASELINE.json benchmark presets, and a small CLI override layer
+(``--train.lr=3e-4`` style dotted flags).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+
+# ---------------------------------------------------------------------------
+# Data layer
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Synthetic RIS/DeepMIMO-style dataset configuration.
+
+    Mirrors the reference's hardcoded data constants: Pilot_num=128,
+    data_len=20000, SNRdb=10, train/test split 0.9
+    (``Runner_P128_QuantumNAT_onchipQNN.py:21-35``); channel dimension 1024 is
+    encoded in the reference's ``.npy`` filenames (``Runner...py:49-55``).
+    """
+
+    n_ant: int = 64          # BS ULA antennas; H is (n_ant, n_sub) complex
+    n_sub: int = 16          # OFDM subcarriers
+    n_beam: int = 8          # sounded DFT beams -> pilot_num = n_beam * n_sub
+    n_scenarios: int = 3     # propagation scenarios (reference: 3)
+    n_users: int = 3         # users per scenario (reference: 3)
+    data_len: int = 20000    # training samples per (scenario, user) cell
+    snr_db: float = 10.0     # training SNR (reference SNRdb=10)
+    train_split: float = 0.9  # reference train_test_ratio=0.9 (Runner...py:35)
+    seed: int = 2026         # base PRNG seed for the deterministic generator
+
+    @property
+    def pilot_num(self) -> int:
+        return self.n_beam * self.n_sub  # 128 for the default geometry
+
+    @property
+    def h_dim(self) -> int:
+        return self.n_ant * self.n_sub  # 1024 for the default geometry
+
+
+# ---------------------------------------------------------------------------
+# Models
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """CNN estimator family (reference ``Estimators_QuantumNAT_onchipQNN.py:40-279``)."""
+
+    features: int = 32       # conv channels (reference self.features=32)
+    kernel_size: int = 3
+    n_conv_layers: int = 3   # Conv_P128/DCE_P128 trunk depth
+    # input image = (n_sub, n_beam) spatial with 2 (re/im) channels, NHWC
+    image_hw: tuple[int, int] = (16, 8)
+    h_out_dim: int = 2048    # 64*16*2 real outputs (reference Linear(4096, 2048))
+    dtype: str = "float32"   # activation dtype ("bfloat16" for the MXU fast path)
+
+
+@dataclass(frozen=True)
+class QuantumConfig:
+    """Quantum scenario-classifier circuit (reference ``Estimators...py:107-149``)."""
+
+    n_qubits: int = 6        # reference default n_qubits=6; published 4/6/8
+    n_layers: int = 3        # reference default n_layers=3
+    n_classes: int = 3
+    use_quantumnat: bool = False      # reference ships with both OFF (Runner...py:313-316)
+    use_gradient_pruning: bool = False
+    noise_level: float = 0.01         # QuantumNAT sigma (Estimators...py:118)
+    gradient_threshold: float = 0.1   # on-chip-QNN pruning threshold (Estimators...py:119)
+    # simulator backend: "dense" builds per-layer unitaries (MXU matmuls, best
+    # for n<=10), "tensor" applies gates on the (2,)*n tensor (n<=14),
+    # "sharded" partitions the statevector over the mesh (n>=14).
+    backend: str = "dense"
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Mirrors ``Y2HRunner`` hyperparams (``Runner...py:20-46, 272-283, 320``)."""
+
+    batch_size: int = 256        # reference batch_size_DML=256
+    lr: float = 1e-3             # reference lr=1e-3
+    lr_decay_epochs: int = 30    # halve every 30 epochs (Runner...py:272-283)
+    lr_floor: float = 1e-6       # reference lr_threshold
+    n_epochs: int = 100
+    optimizer: str = "adam"      # 'adam' | 'sgd' | 'adamw' (Runner...py:40-46, :320)
+    weight_decay: float = 0.01   # AdamW wd for the QSC (Runner...py:320)
+    momentum: float = 0.9        # SGD momentum (Runner...py:45)
+    print_freq: int = 50         # batch-loss print period (Runner...py:30)
+    seed: int = 0
+    workdir: str = "workspace"   # checkpoint root (reference ./workspace/Pn_128/HDCE)
+    resume: bool = False         # reference cannot resume; we can
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh / SPMD layout. The reference's only distribution is
+    ``torch.nn.DataParallel`` over 4 GPUs (``Runner...py:144-148``); here the
+    mesh + sharding annotations ARE the communication layer."""
+
+    data_axis: int = -1      # -1: all devices on the data axis
+    model_axis: int = 1      # tensor/statevector-parallel axis size
+    fed_axis: int = 1        # federated (scenario-grid) axis size
+    # axis names used throughout qdml_tpu.parallel
+    data_axis_name: str = "data"
+    model_axis_name: str = "model"
+    fed_axis_name: str = "fed"
+
+
+@dataclass(frozen=True)
+class EvalConfig:
+    """Mirrors ``model_val`` config (``Test.py:11-21, 66``)."""
+
+    snr_grid: tuple[float, ...] = (5.0, 7.0, 9.0, 11.0, 13.0, 15.0)
+    test_len: int = 10000     # reference data_len_for_test
+    batch_size: int = 200     # reference batch_size=200
+    indicator: int = -1       # -1 = all scenarios mixed (Test.py:18)
+    results_dir: str = "results"
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "default"
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    quantum: QuantumConfig = field(default_factory=QuantumConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    eval: EvalConfig = field(default_factory=EvalConfig)
+
+
+# ---------------------------------------------------------------------------
+# BASELINE.json benchmark presets
+# ---------------------------------------------------------------------------
+
+
+def _preset(name: str, **overrides: Any) -> ExperimentConfig:
+    cfg = ExperimentConfig(name=name)
+    for dotted, value in overrides.items():
+        cfg = override(cfg, dotted, value)
+    return cfg
+
+
+def presets() -> dict[str, ExperimentConfig]:
+    """The five benchmark configurations from ``/root/repo/BASELINE.json``."""
+    return {
+        # 1. Runner_P128 single-worker, 4-qubit QuantumNAT classifier (CPU ref)
+        "single_4q": _preset(
+            "single_4q",
+            **{"quantum.n_qubits": 4, "quantum.use_quantumnat": True, "mesh.data_axis": 1},
+        ),
+        # 2. 8-qubit QNN + CNN estimator, data-parallel over the mesh
+        "dp_8q": _preset("dp_8q", **{"quantum.n_qubits": 8, "mesh.data_axis": -1}),
+        # 3. 16-qubit QNN, pjit model-sharded statevector
+        "sharded_16q": _preset(
+            "sharded_16q",
+            **{
+                "quantum.n_qubits": 16,
+                "quantum.backend": "sharded",
+                "mesh.model_axis": 4,
+                "mesh.data_axis": 1,
+            },
+        ),
+        # 4. Federated RIS: per-BS local QNN + psum aggregation
+        "federated": _preset("federated", **{"mesh.fed_axis": 3, "mesh.data_axis": 1}),
+        # 5. Noise-aware training sweep batched over hosts
+        "nat_sweep": _preset(
+            "nat_sweep", **{"quantum.use_quantumnat": True, "quantum.use_gradient_pruning": True}
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Dotted-path overrides + CLI
+# ---------------------------------------------------------------------------
+
+
+def override(cfg: Any, dotted: str, value: Any) -> Any:
+    """Return a copy of a (nested, frozen) dataclass with ``dotted`` replaced.
+
+    ``override(cfg, "train.lr", 3e-4)`` -> new ExperimentConfig.
+    """
+    head, _, rest = dotted.partition(".")
+    if not dataclasses.is_dataclass(cfg):
+        raise TypeError(f"cannot override {dotted!r} on non-dataclass {type(cfg)}")
+    names = {f.name: f for f in dataclasses.fields(cfg)}
+    if head not in names:
+        raise KeyError(f"unknown config field {head!r} (have {sorted(names)})")
+    if rest:
+        new_sub = override(getattr(cfg, head), rest, value)
+        return dataclasses.replace(cfg, **{head: new_sub})
+    return dataclasses.replace(cfg, **{head: _coerce(value, names[head])})
+
+
+def _coerce(value: Any, fld: dataclasses.Field) -> Any:
+    if not isinstance(value, str):
+        return value
+    t = fld.type
+    if t in ("int", int):
+        return int(value)
+    if t in ("float", float):
+        return float(value)
+    if t in ("bool", bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(t, str) and t.startswith("tuple"):
+        items = [v for v in value.replace("(", "").replace(")", "").split(",") if v.strip()]
+        return tuple(float(v) if "." in v else int(v) for v in items)
+    return value
+
+
+def from_args(argv: Sequence[str], base: ExperimentConfig | None = None) -> ExperimentConfig:
+    """Parse ``--preset=NAME`` plus ``--a.b.c=value`` dotted overrides."""
+    cfg = base or ExperimentConfig()
+    rest = []
+    for arg in argv:
+        if arg.startswith("--preset="):
+            cfg = presets()[arg.split("=", 1)[1]]
+        else:
+            rest.append(arg)
+    for arg in rest:
+        if not arg.startswith("--") or "=" not in arg:
+            raise SystemExit(f"unrecognised argument {arg!r}; expected --path.to.field=value")
+        dotted, value = arg[2:].split("=", 1)
+        cfg = override(cfg, dotted, value)
+    return cfg

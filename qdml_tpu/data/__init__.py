@@ -1,0 +1,21 @@
+from qdml_tpu.data.baselines import (  # noqa: F401
+    beam_delay_profile,
+    ls_estimate,
+    mmse_estimate,
+    sigma2_for_snr,
+)
+from qdml_tpu.data.channels import (  # noqa: F401
+    ChannelGeometry,
+    generate_samples,
+    make_sample_key,
+    noise_var,
+    sample_channel,
+    sound_pilots,
+)
+from qdml_tpu.data.datasets import (  # noqa: F401
+    DMLGridLoader,
+    generate_datapair,
+    load_npy_cache,
+    make_network_batch,
+    save_npy_cache,
+)

@@ -1,0 +1,86 @@
+"""Classical channel-estimation baselines: LS back-projection and LMMSE.
+
+Replaces the reference's missing ``generate_data.generate_MMSE_estimate``
+(called at ``Test.py:145`` with ``(HLS_numpy, sigma2)``) and the implicit LS
+estimator whose output is the ``HLS``/``Hlabel`` array the models train against
+(``Test.py:140``, ``Runner_P128_QuantumNAT_onchipQNN.py:49-55``). Both are pure
+jittable functions over :class:`~qdml_tpu.utils.complexops.CArr` real pairs;
+the LMMSE uses an empirical beam-delay prior profile computed once from the
+generator (diagonal Wiener filter in the beam-delay domain, where the geometric
+channel is approximately uncorrelated).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from qdml_tpu.data.channels import ChannelGeometry, generate_samples, noise_var
+from qdml_tpu.utils.complexops import CArr, ceinsum
+
+
+@partial(jax.jit, static_argnames=("geom",))
+def ls_estimate(yp: CArr, geom: ChannelGeometry) -> CArr:
+    """LS (matched-filter / back-projection) estimate: (..., pilot_num) -> (..., h_dim).
+
+    With a unitary-row beam codebook ``B``, the minimum-norm LS solution of
+    ``Yp = B H`` is ``B^H Yp`` — observed beams are restored, unsounded beams
+    are zero. This is the array the reference calls ``Hlabel``/``HLS``.
+    """
+    x = yp.reshape(yp.shape[:-1] + (geom.n_beam, geom.n_sub))
+    h = ceinsum("ba,...bk->...ak", geom.beam_matrix.conj(), x)
+    return h.reshape(yp.shape[:-1] + (geom.h_dim,))
+
+
+def _to_beam_delay(h: CArr, geom: ChannelGeometry) -> CArr:
+    """(..., n_ant, n_sub) antenna-frequency -> beam-delay domain."""
+    g = ceinsum("ma,...ak->...mk", geom.ant_dft, h)
+    return ceinsum("...mk,kd->...md", g, geom.sub_dft.conj().transpose())
+
+
+def _from_beam_delay(g: CArr, geom: ChannelGeometry) -> CArr:
+    h = ceinsum("am,...md->...ad", geom.ant_dft.conj().transpose(), g)
+    return ceinsum("...ad,dk->...ak", h, geom.sub_dft)
+
+
+def beam_delay_profile(
+    geom: ChannelGeometry, seed: int = 7, n_samples: int = 768
+) -> jnp.ndarray:
+    """Empirical prior variance profile E|G[m, d]|^2 in the beam-delay domain,
+    averaged over all scenarios/users: (n_ant, n_sub) float32.
+
+    Plays the role of the channel covariance a real LMMSE would use; computed
+    once per geometry from noiseless generator draws.
+    """
+    per_cell = max(n_samples // 9, 1)
+    scen = jnp.repeat(jnp.arange(3), 3 * per_cell)
+    user = jnp.tile(jnp.repeat(jnp.arange(3), per_cell), 3)
+    idx = jnp.tile(jnp.arange(per_cell), 9)
+    out = generate_samples(jnp.uint32(seed), scen, user, idx, jnp.float32(200.0), geom)
+    h = out["h_perf"].reshape(-1, geom.n_ant, geom.n_sub)
+    g = _to_beam_delay(h, geom)
+    return jnp.mean(g.abs2(), axis=0)
+
+
+@partial(jax.jit, static_argnames=("geom",))
+def mmse_estimate(
+    h_ls: CArr, sigma2: jnp.ndarray, profile: jnp.ndarray, geom: ChannelGeometry
+) -> CArr:
+    """LMMSE refinement of the LS estimate (reference ``generate_MMSE_estimate``,
+    ``Test.py:145``, with ``sigma2 = 10**(-SNR/10)`` scaled to pilot power).
+
+    Transforms the LS estimate to the beam-delay domain, applies the diagonal
+    Wiener gain ``P / (P + sigma2)`` on the sounded beams, transforms back.
+    """
+    hh = h_ls.reshape(h_ls.shape[:-1] + (geom.n_ant, geom.n_sub))
+    g = _to_beam_delay(hh, geom)
+    g = g * (profile / (profile + sigma2))
+    h = _from_beam_delay(g, geom)
+    return h.reshape(h_ls.shape)
+
+
+def sigma2_for_snr(geom: ChannelGeometry, snr_db) -> jnp.ndarray:
+    """Noise variance matching the generator's pilot noise (for MMSE eval)."""
+    return noise_var(geom, snr_db)

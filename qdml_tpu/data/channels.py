@@ -1,0 +1,226 @@
+"""Synthetic DeepMIMO-style geometric channel generator (TPU-native, real-pair).
+
+The reference trains on pre-generated ``.npy`` arrays from DeepMIMO ray tracing
+loaded by a ``generate_data`` module that is MISSING from its snapshot (imported
+at ``Runner_P128_QuantumNAT_onchipQNN.py:16`` and ``Test.py:7``; contracts
+reconstructed in SURVEY.md §2.8). This module is the TPU-native replacement: a
+fully jittable, deterministic (seeded per sample index) geometric multipath
+generator with three propagation scenarios x three users, matching the
+reference's array contracts:
+
+- ``Yp``: complex ``(N, 128)`` pilots (beam-major flattening of an
+  ``(n_beam=8, n_sub=16)`` beam-sounding grid),
+- ``Hperf``: complex ``(N, 1024)`` perfect CSI (flat ``(n_ant=64, n_sub=16)``),
+- ``Hlabel``: complex ``(N, 1024)`` LS estimate used as the training label
+  (``Test.py:140`` names it ``HLS``),
+- ``indicator``: int scenario id in {0,1,2} (``Runner...py:58-61``).
+
+All complex values are :class:`~qdml_tpu.utils.complexops.CArr` real pairs —
+TPUs have no complex dtype; contractions lower to real MXU matmuls.
+
+Physics: a base station ULA with ``n_ant`` antennas sounds the channel through
+the first ``n_beam`` rows of the unitary ``n_ant``-point DFT (a beam codebook),
+observing ``Yp = F_beam @ H + noise`` per subcarrier. Scenarios differ in path
+count, angular spread, delay spread and LOS K-factor; users differ in their
+angular sector. Channel energy concentrates in the sounded beam sector, so LS
+back-projection is a meaningful baseline while a learned estimator can exploit
+the scenario-conditional structure (Dirichlet side-lobe leakage into unsounded
+beams is a deterministic function of path geometry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.config import DataConfig
+from qdml_tpu.utils.complexops import CArr, ceinsum, cexp_i
+
+# Maximum paths across scenarios; per-scenario counts are masked (static shapes
+# for jit — no data-dependent Python control flow).
+MAX_PATHS = 20
+
+# Per-scenario propagation parameters: [LOS-dominant, moderate NLOS, rich scattering]
+SCENARIO_N_PATHS = np.array([3, 8, 20], dtype=np.int32)
+SCENARIO_ANGLE_SPREAD = np.array([0.3 / 64, 1.0 / 64, 2.8 / 64], dtype=np.float32)
+SCENARIO_DELAY_SPREAD = np.array([0.6, 1.8, 3.5], dtype=np.float32)  # in samples
+SCENARIO_K_FACTOR = np.array([8.0, 2.0, 0.5], dtype=np.float32)  # LOS power boost
+# Per-user angular sector centres, in spatial-frequency units f = d/lambda*sin(theta)
+USER_CENTER_F = np.array([1.5 / 64, 3.5 / 64, 5.5 / 64], dtype=np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChannelGeometry:
+    """Precomputed constants for a dataset geometry (hashable -> static under jit)."""
+
+    n_ant: int = 64
+    n_sub: int = 16
+    n_beam: int = 8
+
+    @classmethod
+    def from_config(cls, cfg: DataConfig) -> "ChannelGeometry":
+        return cls(n_ant=cfg.n_ant, n_sub=cfg.n_sub, n_beam=cfg.n_beam)
+
+    @property
+    def pilot_num(self) -> int:
+        return self.n_beam * self.n_sub
+
+    @property
+    def h_dim(self) -> int:
+        return self.n_ant * self.n_sub
+
+    def _dft(self, rows: int, n: int) -> CArr:
+        m = np.arange(rows)[:, None]
+        a = np.arange(n)[None, :]
+        ang = -2.0 * np.pi * m * a / n
+        scale = 1.0 / np.sqrt(n)
+        return CArr(
+            jnp.asarray((np.cos(ang) * scale).astype(np.float32)),
+            jnp.asarray((np.sin(ang) * scale).astype(np.float32)),
+        )
+
+    @property
+    def beam_matrix(self) -> CArr:
+        """First ``n_beam`` rows of the unitary ``n_ant``-point DFT: (n_beam, n_ant)."""
+        return self._dft(self.n_beam, self.n_ant)
+
+    @property
+    def ant_dft(self) -> CArr:
+        """Full unitary antenna DFT (n_ant, n_ant) — beam-domain transform."""
+        return self._dft(self.n_ant, self.n_ant)
+
+    @property
+    def sub_dft(self) -> CArr:
+        """Full unitary subcarrier DFT (n_sub, n_sub) — delay-domain transform."""
+        return self._dft(self.n_sub, self.n_sub)
+
+    @property
+    def noise_ref_power(self) -> float:
+        """Nominal per-pilot signal power used to set the noise floor.
+
+        With unit average channel-entry power, the sounded-beam sector holds
+        ~all the energy, so per-pilot power ~= h_dim / pilot_num.
+        """
+        return self.h_dim / self.pilot_num
+
+
+def noise_var(geom: ChannelGeometry, snr_db: jnp.ndarray | float) -> jnp.ndarray:
+    """Per-pilot-entry complex noise variance for a given SNR (dB)."""
+    return geom.noise_ref_power * 10.0 ** (-jnp.asarray(snr_db, jnp.float32) / 10.0)
+
+
+# ---------------------------------------------------------------------------
+# Single-sample generation (vmapped for batches)
+# ---------------------------------------------------------------------------
+
+
+def _steering(f: jnp.ndarray, n_ant: int) -> CArr:
+    """ULA steering vectors for spatial frequencies f: (L,) -> (L, n_ant)."""
+    n = jnp.arange(n_ant, dtype=jnp.float32)
+    return cexp_i(2.0 * jnp.pi * f[:, None] * n)
+
+
+def _delay_response(tau: jnp.ndarray, n_sub: int) -> CArr:
+    """Subcarrier responses for delays tau (samples): (L,) -> (L, n_sub)."""
+    k = jnp.arange(n_sub, dtype=jnp.float32)
+    return cexp_i(-2.0 * jnp.pi * tau[:, None] * k / n_sub)
+
+
+@partial(jax.jit, static_argnames=("geom",))
+def sample_channel(
+    key: jax.Array, scenario: jnp.ndarray, user: jnp.ndarray, geom: ChannelGeometry
+) -> CArr:
+    """Draw one channel realisation H (n_ant, n_sub) as a CArr.
+
+    ``scenario``/``user`` are traced int32 scalars — all branching is via
+    gather/mask so the function stays shape-static under jit and vmap.
+    """
+    k_f, k_tau, k_gain = jax.random.split(key, 3)
+    s = scenario.astype(jnp.int32)
+    u = user.astype(jnp.int32)
+
+    n_paths = jnp.asarray(SCENARIO_N_PATHS)[s]
+    spread = jnp.asarray(SCENARIO_ANGLE_SPREAD)[s]
+    dly = jnp.asarray(SCENARIO_DELAY_SPREAD)[s]
+    kfac = jnp.asarray(SCENARIO_K_FACTOR)[s]
+    center = jnp.asarray(USER_CENTER_F)[u]
+
+    mask = (jnp.arange(MAX_PATHS) < n_paths).astype(jnp.float32)
+
+    # Path spatial frequencies around the user's sector centre.
+    f = center + spread * jax.random.truncated_normal(k_f, -2.0, 2.0, (MAX_PATHS,))
+    f = jnp.clip(f, 0.05 / geom.n_ant, None)
+
+    # Path delays: LOS path at tau=0, NLOS exponential with scenario spread.
+    tau_raw = dly * jax.random.exponential(k_tau, (MAX_PATHS,))
+    tau = jnp.where(jnp.arange(MAX_PATHS) == 0, 0.0, jnp.clip(tau_raw, 0.0, geom.n_sub / 2.0))
+
+    # Path powers: exponential decay in delay; LOS K-factor boost on path 0.
+    p = jnp.exp(-tau / jnp.maximum(dly, 0.3))
+    p = p * jnp.where(jnp.arange(MAX_PATHS) == 0, kfac, 1.0) * mask
+    p = p / jnp.maximum(jnp.sum(p), 1e-12)  # E||H||^2 = n_ant * n_sub
+
+    g = jax.random.normal(k_gain, (MAX_PATHS, 2))
+    amp = jnp.sqrt(p / 2.0)
+    alpha = CArr(amp * g[:, 0], amp * g[:, 1])  # (L,)
+
+    a = _steering(f, geom.n_ant)  # (L, n_ant)
+    b = _delay_response(tau, geom.n_sub)  # (L, n_sub)
+    w = CArr(alpha.re[:, None], alpha.im[:, None]) * a  # (L, n_ant)
+    return ceinsum("la,lk->ak", w, b)  # (n_ant, n_sub)
+
+
+@partial(jax.jit, static_argnames=("geom",))
+def sound_pilots(
+    key: jax.Array, h: CArr, snr_db: jnp.ndarray, geom: ChannelGeometry
+) -> CArr:
+    """Observe Yp = F_beam @ H + noise, flattened beam-major to (pilot_num,)."""
+    x = ceinsum("ba,ak->bk", geom.beam_matrix, h)  # (n_beam, n_sub)
+    sigma2 = noise_var(geom, snr_db)
+    nre, nim = jax.random.normal(key, (2,) + x.shape)
+    scale = jnp.sqrt(sigma2 / 2.0)
+    return (x + CArr(scale * nre, scale * nim)).reshape(geom.pilot_num)
+
+
+def make_sample_key(seed: int | jnp.ndarray, scenario, user, index) -> jax.Array:
+    """Deterministic per-sample key: (seed, scenario, user, index) -> PRNGKey.
+
+    Replaces the reference's pre-generated-file determinism (``Runner...py:49-55``
+    filename scheme + ``start`` offsets in ``Test.py:127-129``): sample ``index``
+    of cell (scenario, user) is always the same realisation.
+    """
+    key = jax.random.PRNGKey(seed)
+    key = jax.random.fold_in(key, scenario)
+    key = jax.random.fold_in(key, user)
+    return jax.random.fold_in(key, index)
+
+
+@partial(jax.jit, static_argnames=("geom",))
+def generate_samples(
+    seed: jnp.ndarray,
+    scenarios: jnp.ndarray,
+    users: jnp.ndarray,
+    indices: jnp.ndarray,
+    snr_db: jnp.ndarray,
+    geom: ChannelGeometry,
+) -> dict:
+    """Vectorised sample synthesis.
+
+    Returns dict with ``yp (B, pilot_num) CArr``, ``h_perf (B, h_dim) CArr`` and
+    ``indicator (B,) i32``. (The LS label ``h_label`` is added by
+    :mod:`qdml_tpu.data.baselines` — it is a deterministic function of ``yp``.)
+    """
+
+    def one(scenario, user, index):
+        key = make_sample_key(seed, scenario, user, index)
+        k_h, k_n = jax.random.split(key)
+        h = sample_channel(k_h, scenario, user, geom)
+        yp = sound_pilots(k_n, h, snr_db, geom)
+        return yp, h.reshape(geom.h_dim)
+
+    yp, h = jax.vmap(one)(scenarios, users, indices)
+    return {"yp": yp, "h_perf": h, "indicator": scenarios.astype(jnp.int32)}

@@ -1,0 +1,208 @@
+"""Dataset plumbing: DML grid batching, eval datapair generation, npy cache.
+
+Replaces the reference's missing ``generate_data`` module (SURVEY.md §2.8):
+
+- ``DatasetFolder_DML`` (9-way zip dataset over the 3x3 scenario/user grid,
+  ``Runner_P128_QuantumNAT_onchipQNN.py:87-93``) becomes :class:`DMLGridLoader`,
+  which yields the whole grid as ONE stacked array batch
+  ``(n_scenarios, n_users, bs, ...)`` instead of nine Python objects — the
+  TPU-friendly shape for a single fused train step.
+- ``generate_datapair(Ns, Pilot_num, index, SNRdb, start, training_data_len)``
+  (``Test.py:127-129``) becomes :func:`generate_datapair` with the same
+  offset-past-training-data semantics via deterministic per-index seeding.
+- The ``.npy`` cache with the reference's filename scheme
+  (``Runner...py:49-55``) is reproduced by :func:`save_npy_cache` /
+  :func:`load_npy_cache` for interop.
+
+Data synthesis runs jitted on-device; there is no host dataloader bottleneck
+(the reference pins ``num_workers=0``, ``Runner...py:24``).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.config import DataConfig
+from qdml_tpu.data.baselines import ls_estimate
+from qdml_tpu.data.channels import ChannelGeometry, generate_samples
+from qdml_tpu.utils.complexops import pack_h, yp_to_image
+
+
+@partial(jax.jit, static_argnames=("geom",))
+def make_network_batch(
+    seed: jnp.ndarray,
+    scenarios: jnp.ndarray,
+    users: jnp.ndarray,
+    indices: jnp.ndarray,
+    snr_db: jnp.ndarray,
+    geom: ChannelGeometry,
+) -> dict[str, jnp.ndarray]:
+    """Synthesize samples and produce network-ready arrays (leading dims of the
+    scenario/user/index arrays are preserved — pass ``(S, U, B)`` grids or flat
+    ``(N,)`` vectors).
+
+    Fields: ``yp_img (..., n_sub, n_beam, 2) f32``, ``h_label (..., 2*h_dim) f32``
+    (packed LS target — the reference trains against the LS label,
+    ``Runner...py:112``), ``h_perf (..., 2*h_dim) f32``, ``indicator (...) i32``,
+    plus complex ``yp``/``h_ls``/``h_perf_c`` for the classical baselines.
+    """
+    lead = scenarios.shape
+    flat = generate_samples(
+        seed, scenarios.reshape(-1), users.reshape(-1), indices.reshape(-1), snr_db, geom
+    )
+    yp = flat["yp"].reshape(lead + (geom.pilot_num,))
+    h_perf = flat["h_perf"].reshape(lead + (geom.h_dim,))
+    h_ls = ls_estimate(yp, geom)
+    return {
+        "yp": yp,
+        "h_ls": h_ls,
+        "h_perf_c": h_perf,
+        "yp_img": yp_to_image(yp, geom.n_sub, geom.n_beam).astype(jnp.float32),
+        "h_label": pack_h(h_ls).astype(jnp.float32),
+        "h_perf": pack_h(h_perf).astype(jnp.float32),
+        "indicator": flat["indicator"].reshape(lead),
+    }
+
+
+class DMLGridLoader:
+    """Iterates (shuffled) minibatches of the full 3x3 scenario/user grid.
+
+    Each step yields arrays with leading shape ``(n_scenarios, n_users, bs)``,
+    the stacked equivalent of the reference's 9-tuple batches
+    (``Runner...py:181``). Per-epoch shuffling is deterministic in
+    ``(data_seed, epoch)``.
+    """
+
+    def __init__(
+        self,
+        cfg: DataConfig,
+        batch_size: int,
+        split: str = "train",
+        geom: ChannelGeometry | None = None,
+    ):
+        self.cfg = cfg
+        self.geom = geom or ChannelGeometry.from_config(cfg)
+        n_train = int(cfg.data_len * cfg.train_split)
+        if split == "train":
+            self.index_base, self.n = 0, n_train
+        elif split == "val":
+            self.index_base, self.n = n_train, cfg.data_len - n_train
+        else:
+            raise ValueError(f"unknown split {split!r}")
+        self.batch_size = batch_size = min(batch_size, self.n)
+        self.steps_per_epoch = self.n // batch_size
+        s, u = cfg.n_scenarios, cfg.n_users
+        self._scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, batch_size))
+        self._user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, batch_size))
+
+    def epoch(self, epoch: int, shuffle: bool = True) -> Iterator[dict[str, jnp.ndarray]]:
+        rng = np.random.default_rng((self.cfg.seed, epoch))
+        s, u, bs = self.cfg.n_scenarios, self.cfg.n_users, self.batch_size
+        if shuffle:
+            perms = rng.permuted(
+                np.broadcast_to(np.arange(self.n), (s, u, self.n)).copy(), axis=-1
+            )
+        else:
+            perms = np.broadcast_to(np.arange(self.n), (s, u, self.n))
+        perms = perms + self.index_base
+        for step in range(self.steps_per_epoch):
+            idx = jnp.asarray(perms[:, :, step * bs : (step + 1) * bs])
+            yield make_network_batch(
+                jnp.uint32(self.cfg.seed),
+                self._scen,
+                self._user,
+                idx,
+                jnp.float32(self.cfg.snr_db),
+                self.geom,
+            )
+
+
+def generate_datapair(
+    ns: int,
+    pilot_num: int,
+    index: int,
+    snr_db: float,
+    start: int,
+    cfg: DataConfig | None = None,
+    geom: ChannelGeometry | None = None,
+) -> dict[str, jnp.ndarray]:
+    """Test-set synthesis matching the reference call
+    ``generate_datapair(Ns, Pilot_num, index, SNRdb, start, training_data_len)``
+    (``Test.py:127-129``): ``index=-1`` mixes all scenarios (round-robin over
+    the 3x3 grid); ``start`` offsets sample indices past the training range so
+    test realisations never overlap training ones.
+    """
+    cfg = cfg or DataConfig()
+    geom = geom or ChannelGeometry.from_config(cfg)
+    if pilot_num != geom.pilot_num:
+        raise ValueError(f"pilot_num {pilot_num} != geometry pilot_num {geom.pilot_num}")
+    i = jnp.arange(ns)
+    if index == -1:
+        scen = i % cfg.n_scenarios
+        user = (i // cfg.n_scenarios) % cfg.n_users
+    else:
+        scen = jnp.full((ns,), index % cfg.n_scenarios)
+        user = (i % cfg.n_users)
+    return make_network_batch(
+        jnp.uint32(cfg.seed), scen, user, start + i, jnp.float32(snr_db), geom
+    )
+
+
+# ---------------------------------------------------------------------------
+# Reference-compatible .npy cache (``available_data/`` naming, Runner...py:49-55)
+# ---------------------------------------------------------------------------
+
+
+def _npy_names(dirpath: str, cfg: DataConfig, scenario: int, user: int) -> dict[str, str]:
+    tpl = "{name}{ind}_{pn}_{hd}_{snr}dB_{uid}_datalen_{dl}.npy"
+    return {
+        name: os.path.join(
+            dirpath,
+            tpl.format(
+                name=name,
+                ind=scenario,
+                pn=cfg.pilot_num,
+                hd=cfg.h_dim,
+                snr=int(cfg.snr_db),
+                uid=user,
+                dl=cfg.data_len,
+            ),
+        )
+        for name in ("Yp", "Hlabel", "Hperf")
+    }
+
+
+def save_npy_cache(dirpath: str, cfg: DataConfig, chunk: int = 2048) -> None:
+    """Materialise the dataset to ``.npy`` files with the reference's
+    ``available_data/`` filename scheme (``Runner...py:49-55``)."""
+    os.makedirs(dirpath, exist_ok=True)
+    geom = ChannelGeometry.from_config(cfg)
+    for s in range(cfg.n_scenarios):
+        for u in range(cfg.n_users):
+            parts: dict[str, list[np.ndarray]] = {"Yp": [], "Hlabel": [], "Hperf": []}
+            for lo in range(0, cfg.data_len, chunk):
+                n = min(chunk, cfg.data_len - lo)
+                out = make_network_batch(
+                    jnp.uint32(cfg.seed),
+                    jnp.full((n,), s),
+                    jnp.full((n,), u),
+                    jnp.arange(lo, lo + n),
+                    jnp.float32(cfg.snr_db),
+                    geom,
+                )
+                parts["Yp"].append(out["yp"].to_numpy())
+                parts["Hlabel"].append(out["h_ls"].to_numpy())
+                parts["Hperf"].append(out["h_perf_c"].to_numpy())
+            for name, path in _npy_names(dirpath, cfg, s, u).items():
+                np.save(path, np.concatenate(parts[name], axis=0))
+
+
+def load_npy_cache(dirpath: str, cfg: DataConfig, scenario: int, user: int) -> dict[str, np.ndarray]:
+    """Load one (scenario, user) cell from a reference-style ``.npy`` cache."""
+    return {n: np.load(p) for n, p in _npy_names(dirpath, cfg, scenario, user).items()}

@@ -1,0 +1,2 @@
+from qdml_tpu.eval.report import create_comparison_plots, save_results_json  # noqa: F401
+from qdml_tpu.eval.sweep import make_sweep_step, run_snr_sweep  # noqa: F401

@@ -1,0 +1,68 @@
+"""Reporting: comparison plots + JSON results (reference
+``create_comparison_plots``, ``Test.py:277-336``).
+
+Reproduces the reference's two-panel figure — NMSE (dB) vs SNR for
+LS / MMSE / HDCE-classical / HDCE-quantum, and classifier accuracy vs SNR —
+saved to ``results/Quantum_vs_Classical_Comparison.png``, plus a detailed
+results JSON (``results/quantum_classical_comparison.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+_CURVE_LABELS = {
+    "ls": "LS",
+    "mmse": "MMSE",
+    "hdce_classical": "HDCE (classical SC)",
+    "hdce_quantum": "HDCE (quantum SC)",
+}
+
+
+def save_results_json(results: dict[str, Any], results_dir: str) -> str:
+    os.makedirs(results_dir, exist_ok=True)
+    path = os.path.join(results_dir, "quantum_classical_comparison.json")
+    with open(path, "w") as fh:
+        json.dump(results, fh, indent=2)
+    return path
+
+
+def create_comparison_plots(results: dict[str, Any], results_dir: str) -> str | None:
+    """Two-panel comparison figure; returns the PNG path (None if matplotlib
+    is unavailable)."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:  # headless minimal images
+        return None
+
+    os.makedirs(results_dir, exist_ok=True)
+    snr = results["snr"]
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(12, 4.5))
+
+    for key, vals in results["nmse_db"].items():
+        ax1.plot(snr, vals, marker="o", label=_CURVE_LABELS.get(key, key))
+    ax1.set_xlabel("SNR (dB)")
+    ax1.set_ylabel("NMSE (dB)")
+    ax1.set_title("Channel estimation performance")
+    ax1.grid(True, alpha=0.4)
+    ax1.legend()
+
+    for key, vals in results["acc"].items():
+        ax2.plot(snr, vals, marker="s", label=f"{key} SC")
+    ax2.set_xlabel("SNR (dB)")
+    ax2.set_ylabel("Scenario classification accuracy")
+    ax2.set_ylim(0.0, 1.02)
+    ax2.set_title("Classifier accuracy")
+    ax2.grid(True, alpha=0.4)
+    ax2.legend()
+
+    fig.tight_layout()
+    path = os.path.join(results_dir, "Quantum_vs_Classical_Comparison.png")
+    fig.savefig(path, dpi=130)
+    plt.close(fig)
+    return path

@@ -1,0 +1,146 @@
+"""SNR-sweep evaluation harness (reference ``model_val``, ``Test.py:64-275``).
+
+For each SNR in the grid (default ``{5,7,9,11,13,15}`` dB, ``Test.py:66``) over
+``test_len`` fresh samples (``Test.py:20,127``):
+
+- classical baselines: LS back-projection and LMMSE (``Test.py:141-147``),
+- scenario classification with the classical CNN and (optionally) the quantum
+  classifier (``Test.py:158-164``),
+- HDCE estimation with each sample routed through the trunk matching its
+  PREDICTED scenario (``Test.py:167-214``) — expressed as run-all-trunks +
+  ``take_along_axis`` gather (:mod:`qdml_tpu.ops.routing`), no host sync,
+- NMSE vs perfect CSI for LS / MMSE / HDCE-classical / HDCE-quantum and both
+  classifier accuracies (``Test.py:217-256``).
+
+Everything inside the per-batch step is one jitted function, data generation
+included.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.baselines import (
+    beam_delay_profile,
+    mmse_estimate,
+    sigma2_for_snr,
+)
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import make_network_batch
+from qdml_tpu.models.cnn import SCP128
+from qdml_tpu.models.qsc import QSCP128
+from qdml_tpu.ops.routing import select_expert
+from qdml_tpu.train.hdce import HDCE
+from qdml_tpu.utils.metrics import nmse_db
+
+
+def _sum_sq(x) -> jnp.ndarray:
+    return jnp.sum(x.abs2()) if hasattr(x, "abs2") else jnp.sum(x**2)
+
+
+def make_sweep_step(
+    cfg: ExperimentConfig,
+    geom: ChannelGeometry,
+    hdce_vars: dict,
+    sc_vars: dict,
+    qsc_vars: dict | None,
+    profile: jnp.ndarray,
+):
+    """Build the jitted per-batch sweep step. Returns accumulator dicts of
+    error/power sums and correct-counts."""
+    hdce = HDCE(
+        n_scenarios=cfg.data.n_scenarios,
+        features=cfg.model.features,
+        out_dim=cfg.model.h_out_dim,
+    )
+    sc = SCP128(n_classes=cfg.quantum.n_classes)
+    qsc = (
+        QSCP128(
+            n_qubits=cfg.quantum.n_qubits,
+            n_layers=cfg.quantum.n_layers,
+            n_classes=cfg.quantum.n_classes,
+            backend=cfg.quantum.backend,
+        )
+        if qsc_vars is not None
+        else None
+    )
+    n_scen = cfg.data.n_scenarios
+
+    @partial(jax.jit, static_argnames=())
+    def step(start: jnp.ndarray, count_base: jnp.ndarray, snr_db: jnp.ndarray) -> dict:
+        bs = cfg.eval.batch_size
+        i = count_base + jnp.arange(bs)
+        scen = i % n_scen
+        user = (i // n_scen) % cfg.data.n_users
+        batch = make_network_batch(
+            jnp.uint32(cfg.data.seed), scen, user, start + i, snr_db, geom
+        )
+        h = batch["h_perf_c"]
+        x = batch["yp_img"]
+
+        # classical baselines
+        h_ls = batch["h_ls"]
+        h_mmse = mmse_estimate(h_ls, sigma2_for_snr(geom, snr_db), profile, geom)
+
+        # stacked-trunk HDCE outputs for every scenario hypothesis
+        xs = jnp.broadcast_to(x[None], (n_scen,) + x.shape)
+        est_all = hdce.apply(hdce_vars, xs, train=False)  # (S, B, 2048)
+
+        out: dict[str, jnp.ndarray] = {
+            "pow": _sum_sq(h),
+            "err_ls": _sum_sq(h_ls - h),
+            "err_mmse": _sum_sq(h_mmse - h),
+            "count": jnp.asarray(bs, jnp.float32),
+        }
+
+        label2 = jnp.concatenate([h.re, h.im], -1)
+        for name, vars_, model in (("classical", sc_vars, sc), ("quantum", qsc_vars, qsc)):
+            if model is None:
+                continue
+            logp = model.apply(vars_, x, train=False)
+            pred = jnp.argmax(logp, -1)
+            routed = select_expert(est_all, pred)  # (B, 2048)
+            out[f"err_hdce_{name}"] = _sum_sq(routed - label2)
+            out[f"correct_{name}"] = jnp.sum(pred == batch["indicator"]).astype(jnp.float32)
+        return out
+
+    return step
+
+
+def run_snr_sweep(
+    cfg: ExperimentConfig,
+    hdce_vars: dict,
+    sc_vars: dict,
+    qsc_vars: dict | None = None,
+) -> dict[str, Any]:
+    """Full sweep; returns ``{"snr": [...], "nmse_db": {curve: [...]}, "acc": {...}}``."""
+    geom = ChannelGeometry.from_config(cfg.data)
+    profile = beam_delay_profile(geom)
+    step = make_sweep_step(cfg, geom, hdce_vars, sc_vars, qsc_vars, profile)
+
+    start = cfg.data.data_len * 3  # offset past training data (Test.py:127)
+    curves: dict[str, list] = {}
+    accs: dict[str, list] = {}
+    for snr in cfg.eval.snr_grid:
+        sums: dict[str, float] = {}
+        n_batches = max(cfg.eval.test_len // cfg.eval.batch_size, 1)
+        for b in range(n_batches):
+            out = step(
+                jnp.asarray(start),
+                jnp.asarray(b * cfg.eval.batch_size),
+                jnp.float32(snr),
+            )
+            for k, v in out.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+        pow_ = max(sums["pow"], 1e-30)
+        for key in sums:
+            if key.startswith("err_"):
+                curves.setdefault(key[4:], []).append(nmse_db(sums[key] / pow_))
+            elif key.startswith("correct_"):
+                accs.setdefault(key[8:], []).append(sums[key] / sums["count"])
+    return {"snr": list(cfg.eval.snr_grid), "nmse_db": curves, "acc": accs}

@@ -1,0 +1,11 @@
+from qdml_tpu.models.cnn import (  # noqa: F401
+    ConvBlock,
+    ConvP128,
+    DCEP128,
+    FCP128,
+    QSCPreprocess,
+    SCP128,
+    StackedConvP128,
+)
+from qdml_tpu.models.losses import accuracy, nll_loss, nmse_loss  # noqa: F401
+from qdml_tpu.models.qsc import QSCP128  # noqa: F401

@@ -1,0 +1,157 @@
+"""Classical model zoo: Flax re-designs of the reference estimators.
+
+Reference architectures (``Estimators_QuantumNAT_onchipQNN.py``):
+
+- ``Conv_P128`` (:237-268): 3 x [Conv3x3(no bias) + BatchNorm + ReLU],
+  channels 2->32->32->32, flatten to 32*16*8 = 4096.
+- ``FC_P128`` (:272-279): Linear(4096 -> 64*16*2 = 2048) — the shared head.
+- ``DCE_P128`` (:40-75): Conv_P128 trunk + the linear head in one module.
+- ``SC_P128`` (:79-101): Conv3x3 2->32 + ReLU + maxpool2, Conv3x3 32->32 +
+  ReLU + maxpool2, flatten 32*4*2 = 256, Linear(256, 3), log_softmax.
+
+TPU-first deviations from the torch originals: NHWC layout (inputs are
+``(batch, n_sub=16, n_beam=8, 2)``), optional bfloat16 activation dtype for the
+MXU (params stay float32), and a scenario-stacked trunk
+(:class:`StackedConvP128`) that evaluates all three per-scenario trunks as one
+batched conv — replacing the reference's three separate ``Conv_P128`` instances
+(``Runner_P128_QuantumNAT_onchipQNN.py:139-141``) with a single vmapped module
+so the 3x3 DML grid trains in one fused step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _dtype(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
+
+
+class ConvBlock(nn.Module):
+    """Conv3x3(no bias) + BatchNorm + ReLU (reference trunk block)."""
+
+    features: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+class ConvP128(nn.Module):
+    """Per-scenario feature extractor (reference ``Conv_P128``, :237-268).
+
+    ``(B, 16, 8, 2) -> (B, 4096)``.
+    """
+
+    features: int = 32
+    n_layers: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for _ in range(self.n_layers):
+            x = ConvBlock(self.features, self.dtype)(x, train=train)
+        return x.reshape(x.shape[0], -1).astype(jnp.float32)
+
+
+class FCP128(nn.Module):
+    """Shared estimation head (reference ``FC_P128``, :272-279): 4096 -> 2048."""
+
+    out_dim: int = 2048
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.out_dim, dtype=self.dtype)(x).astype(jnp.float32)
+
+
+class DCEP128(nn.Module):
+    """Monolithic direct channel estimator (reference ``DCE_P128``, :40-75)."""
+
+    features: int = 32
+    out_dim: int = 2048
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = ConvP128(self.features, dtype=self.dtype)(x, train=train)
+        return FCP128(self.out_dim, dtype=self.dtype)(x)
+
+
+class SCP128(nn.Module):
+    """Classical scenario classifier (reference ``SC_P128``, :79-101).
+
+    ``(B, 16, 8, 2) -> (B, 3)`` log-probabilities.
+    """
+
+    n_classes: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):  # train unused: no BatchNorm
+        x = nn.Conv(32, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(32, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)  # (B, 32*4*2)
+        x = nn.Dense(self.n_classes)(x)
+        return nn.log_softmax(x, axis=-1)
+
+
+class StackedConvP128(nn.Module):
+    """All ``n_scenarios`` Conv_P128 trunks as one vmapped module.
+
+    Parameters carry a leading scenario axis; input ``(S, B, 16, 8, 2)`` maps to
+    ``(S, B, 4096)``. Replaces the reference's list of three independent
+    modules + three optimizers (``Runner...py:139-141, 160-163``) — gradients
+    for scenario ``s`` flow only to slice ``s`` of the stacked params, which is
+    mathematically identical (elementwise Adam over disjoint slices) but runs
+    as one XLA computation and shards naturally over a mesh axis.
+    """
+
+    n_scenarios: int = 3
+    features: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        vconv = nn.vmap(
+            ConvP128,
+            in_axes=(0, None),  # x stacked over scenarios; train broadcast
+            out_axes=0,
+            variable_axes={"params": 0, "batch_stats": 0},
+            split_rngs={"params": True},
+            methods=["__call__"],
+        )
+        # NOTE: train must be positional — flax nn.vmap drops kwargs.
+        return vconv(self.features, dtype=self.dtype)(x, train)
+
+
+class QSCPreprocess(nn.Module):
+    """CNN front-end of the quantum classifier (reference ``QSC_P128.preprocess``,
+    ``Estimators...py:152-162``): Conv 2->16 + ReLU + maxpool2, Conv 16->32 +
+    ReLU + maxpool2, flatten 256, Dense -> n_qubits, tanh (angle range [-1, 1])."""
+
+    n_qubits: int = 6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(16, (3, 3), padding=1, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(32, (3, 3), padding=1, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        x = nn.Dense(self.n_qubits)(x)
+        return nn.tanh(x)

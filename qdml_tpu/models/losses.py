@@ -1,0 +1,28 @@
+"""Loss functions: NMSE (reference ``NMSELoss``) and NLL for the classifiers.
+
+Reference semantics preserved exactly:
+- NMSE is a whole-batch ratio ``sum((x_hat - x)^2) / sum(x^2)`` — NOT a
+  per-sample mean (``Estimators_QuantumNAT_onchipQNN.py:282-295``).
+- Classifier loss is ``F.nll_loss`` over ``log_softmax`` outputs
+  (``Runner_P128_QuantumNAT_onchipQNN.py:292``), i.e. mean negative
+  log-likelihood.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def nmse_loss(x_hat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Whole-batch NMSE over real (packed re/im) arrays."""
+    return jnp.sum((x_hat - x) ** 2) / jnp.sum(x**2)
+
+
+def nll_loss(log_probs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean negative log-likelihood given log-probabilities (torch ``F.nll_loss``)."""
+    picked = jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def accuracy(log_probs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean((jnp.argmax(log_probs, axis=-1) == labels).astype(jnp.float32))

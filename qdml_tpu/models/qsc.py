@@ -1,0 +1,64 @@
+"""Quantum scenario classifier: CNN front-end + variational circuit + head.
+
+TPU-native re-design of ``QSC_P128`` (reference
+``Estimators_QuantumNAT_onchipQNN.py:107-228``). The PennyLane
+``QNode``/``TorchLayer`` bridge (reference ``:148-149``) disappears: circuit
+weights are a plain Flax param and the circuit is just a differentiable
+function in the forward pass, executed by the in-tree statevector simulator on
+the same device as the CNN — no host round-trip per forward.
+
+QuantumNAT noise injection (reference ``:176-196``) becomes pure-functional:
+instead of mutating ``param.data`` in place and restoring it, the forward
+evaluates the circuit at ``weights + noise`` with noise drawn from a threaded
+PRNG stream. The gradient is therefore taken at the *noisy* point while the
+optimizer state tracks the *clean* params — exactly the reference semantics
+(SURVEY.md §3.4) with no mutate/restore dance.
+
+Gradient pruning (reference ``apply_gradient_pruning``, ``:205-228``) is NOT a
+model method here; it is an optax transform in the optimizer chain
+(:func:`qdml_tpu.ops.grad_prune.gradient_prune`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.models.cnn import QSCPreprocess
+from qdml_tpu.quantum.circuits import run_circuit
+
+
+class QSCP128(nn.Module):
+    """``(B, 16, 8, 2) -> (B, n_classes)`` log-probabilities."""
+
+    n_qubits: int = 6
+    n_layers: int = 3
+    n_classes: int = 3
+    use_quantumnat: bool = False   # reference ships with this OFF (Runner...py:313-316)
+    noise_level: float = 0.01      # QuantumNAT sigma (Estimators...py:118)
+    backend: str = "dense"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        angles = QSCPreprocess(self.n_qubits, dtype=self.dtype)(x)
+
+        # PennyLane TorchLayer initialises circuit weights uniform in [0, 2pi).
+        weights = self.param(
+            "qweights",
+            lambda key, shape: jax.random.uniform(key, shape, jnp.float32, 0.0, 2.0 * np.pi),
+            (self.n_layers, self.n_qubits, 2),
+        )
+        if train and self.use_quantumnat and self.noise_level > 0:
+            noise = self.noise_level * jax.random.normal(
+                self.make_rng("quantumnat"), weights.shape, jnp.float32
+            )
+            weights = weights + noise  # gradient at the noisy point (C7 semantics)
+
+        expz = run_circuit(angles, weights, self.n_qubits, self.n_layers, self.backend)
+        logits = nn.Dense(self.n_classes)(expz)
+        return nn.log_softmax(logits, axis=-1)

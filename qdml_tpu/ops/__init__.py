@@ -1,0 +1,3 @@
+from qdml_tpu.ops.grad_prune import GradientPruneState, gradient_prune  # noqa: F401
+from qdml_tpu.ops.quantumnat import perturb  # noqa: F401
+from qdml_tpu.ops.routing import one_hot_dispatch, select_expert  # noqa: F401

@@ -1,0 +1,44 @@
+"""On-chip-QNN gradient pruning as an optax GradientTransformation.
+
+Reference behaviour (``Estimators_QuantumNAT_onchipQNN.py:205-228``): after
+``loss.backward()`` and before ``optimizer.step()``, every gradient element
+with ``|g| <= threshold`` (default 0.1, ``:119``) across ALL named parameters
+is zeroed; the pruning ratio is logged when it exceeds 10%.
+
+Here the same operation is a pure transform placed at the FRONT of the
+optimizer chain (prune, then Adam/AdamW sees the pruned gradients — matching
+the reference's backward -> prune -> step order,
+``Runner_P128_QuantumNAT_onchipQNN.py:364-369``). The observed pruning ratio is
+kept in the transform state for metric logging instead of printing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class GradientPruneState(NamedTuple):
+    prune_ratio: jnp.ndarray  # fraction of gradient elements zeroed last step
+
+
+def gradient_prune(threshold: float = 0.1) -> optax.GradientTransformation:
+    """Zero gradient elements with ``|g| <= threshold``."""
+
+    def init_fn(params):
+        del params
+        return GradientPruneState(prune_ratio=jnp.zeros((), jnp.float32))
+
+    def update_fn(updates, state, params=None):
+        del params
+        masks = jax.tree.map(lambda g: (jnp.abs(g) > threshold).astype(g.dtype), updates)
+        pruned = jax.tree.map(lambda g, m: g * m, updates, masks)
+        total = sum(jnp.size(m) for m in jax.tree.leaves(masks))
+        kept = sum(jnp.sum(m) for m in jax.tree.leaves(masks))
+        ratio = 1.0 - kept / jnp.asarray(total, jnp.float32)
+        return pruned, GradientPruneState(prune_ratio=ratio)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,47 @@
+"""QuantumNAT noise injection as a pure-functional parameter perturbation.
+
+Reference behaviour (``Estimators_QuantumNAT_onchipQNN.py:176-196``, after
+QuantumNAT, arXiv:2110.11331): during training, clone the quantum parameters,
+add ``noise_level * randn_like(param)``, forward through the circuit, restore
+the originals. The gradient IS taken at the noisy point; the optimizer state
+stays at the clean point (SURVEY.md §3.4).
+
+In JAX this is simply evaluating the loss at ``params + sigma * normal(key)``
+— the in-place mutate/restore dance does not exist. :class:`QSCP128` does this
+inline for its circuit weights; :func:`perturb` is the generic tree-level
+version for perturbing arbitrary parameter subtrees (e.g. noise-level sweeps,
+BASELINE.json config 5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def perturb(
+    params: Any,
+    key: jax.Array,
+    noise_level: float | jnp.ndarray,
+    where: Callable[[tuple, jnp.ndarray], bool] | None = None,
+) -> Any:
+    """Return ``params + noise_level * N(0, 1)`` on selected leaves.
+
+    ``where(path, leaf) -> bool`` selects which leaves to perturb (default:
+    all floating-point leaves).
+    """
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    keys = jax.random.split(key, len(leaves))
+
+    flat = {}
+    for (path, leaf), k in zip(leaves, keys):
+        sel = jnp.issubdtype(jnp.result_type(leaf), jnp.floating) and (
+            where is None or where(path, leaf)
+        )
+        flat[path] = leaf + noise_level * jax.random.normal(k, jnp.shape(leaf), leaf.dtype) if sel else leaf
+
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), [flat[p] for p, _ in leaves]
+    )

@@ -1,0 +1,39 @@
+"""Predicted-scenario expert routing without host round-trips.
+
+The reference's evaluation partitions each batch by the classifier's PREDICTED
+scenario and feeds each partition through the matching ``Conv_P128`` trunk with
+Python-level boolean indexing (``Test.py:167-214``) — data-dependent control
+flow that would force host sync under XLA. The TPU-native expression (SURVEY.md
+§3.3, §7.3): run ALL trunks on the full batch (they are tiny and the stacked
+trunk is one batched conv) and gather each sample's row by its predicted id —
+a pure ``take_along_axis``, i.e. MoE-style hard routing with S=3 experts and
+top-1 dispatch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def select_expert(stacked: jnp.ndarray, pred: jnp.ndarray) -> jnp.ndarray:
+    """Gather per-sample expert outputs.
+
+    ``stacked``: (S, B, D) outputs of every expert on every sample;
+    ``pred``: (B,) int expert ids. Returns (B, D).
+    """
+    idx = pred[None, :, None]  # (1, B, 1)
+    return jnp.take_along_axis(stacked, idx, axis=0)[0]
+
+
+def one_hot_dispatch(stacked: jnp.ndarray, log_probs: jnp.ndarray) -> jnp.ndarray:
+    """Differentiable variant: weight expert outputs by hard one-hot of argmax.
+
+    Equivalent to :func:`select_expert` in value; expressed as a masked sum
+    (einsum against a one-hot) which shards cleanly when ``stacked`` is
+    scenario-sharded over a mesh axis.
+    """
+    pred = jnp.argmax(log_probs, axis=-1)
+    onehot = jnp.equal(
+        jnp.arange(stacked.shape[0])[:, None], pred[None, :]
+    ).astype(stacked.dtype)  # (S, B)
+    return jnp.einsum("sb,sbd->bd", onehot, stacked)

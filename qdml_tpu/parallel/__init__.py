@@ -1,0 +1,14 @@
+from qdml_tpu.parallel.dp import (  # noqa: F401
+    replicate,
+    shard_flat_batch,
+    shard_grid_batch,
+)
+from qdml_tpu.parallel.federated import (  # noqa: F401
+    hdce_state_shardings,
+    shard_hdce_state,
+)
+from qdml_tpu.parallel.mesh import (  # noqa: F401
+    init_distributed,
+    make_mesh,
+    single_device_mesh,
+)

@@ -1,0 +1,48 @@
+"""Data-parallel placement: batch sharding over the mesh ``data`` axis.
+
+Replaces ``torch.nn.DataParallel``'s per-forward scatter/replicate/gather
+(``Runner_P128_QuantumNAT_onchipQNN.py:144-148``) with SPMD: the batch is
+device_put with a ``NamedSharding`` splitting the batch dimension, params are
+replicated, and the jitted train step — the SAME function used single-chip
+(:func:`qdml_tpu.train.hdce.make_hdce_train_step`) — runs with XLA inserting
+the gradient all-reduce (psum over ICI) automatically. There is no explicit
+communication code anywhere; the annotations are the communication layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def _pad(spec: tuple, ndim: int) -> P:
+    return P(*(spec + (None,) * (ndim - len(spec))))
+
+
+def shard_grid_batch(batch: dict, mesh: Mesh, fed: bool = False) -> dict:
+    """Place a DML grid batch ``(S, U, B, ...)``: B over ``data``; optionally
+    S over ``fed`` (federated training, see :mod:`qdml_tpu.parallel.federated`)."""
+    s_axis = "fed" if fed and mesh.shape.get("fed", 1) > 1 else None
+
+    def put(x):
+        spec = _pad((s_axis, None, "data"), jax.numpy.ndim(x))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, batch)
+
+
+def shard_flat_batch(batch: Any, mesh: Mesh) -> Any:
+    """Place a flat-batch pytree ``(B, ...)`` with B over ``data``."""
+
+    def put(x):
+        return jax.device_put(x, NamedSharding(mesh, _pad(("data",), jax.numpy.ndim(x))))
+
+    return jax.tree.map(put, batch)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Fully replicate a pytree over the mesh (params, opt state for pure DP)."""
+    return jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)

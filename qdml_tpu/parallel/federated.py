@@ -1,0 +1,65 @@
+"""Federated/DML sharding: per-scenario trunks local, shared head aggregated.
+
+The reference's signature "distributed ML" pattern (SURVEY.md §2.7): three
+scenario-specific ``Conv_P128`` trunks + ONE shared ``FC_P128`` head, gradients
+accumulated across the 3x3 scenario/user grid every step
+(``Runner_P128_QuantumNAT_onchipQNN.py:139-142, 181-204``). In the TPU
+re-design each "base station" (scenario) lives on its own ``fed`` mesh slice:
+
+- stacked trunk params/opt-state/batch-stats shard their leading scenario axis
+  over ``fed`` — trunk gradients never leave their slice (local models),
+- the shared head is replicated; because its gradient sums contributions from
+  the fed-sharded scenario axis, GSPMD inserts exactly one psum over ``fed``
+  per step — the federated aggregation, compiled, over ICI,
+- the grid batch shards S over ``fed`` and B over ``data`` (DP composes).
+
+Optionally the 4096x2048 head is ALSO tensor-parallel over ``model``
+(column-sharded kernel), demonstrating tp x dp x fed on one tiny model.
+
+No hand-written collectives: this module only builds ``NamedSharding`` trees
+for the existing train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from qdml_tpu.train.state import TrainState
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def hdce_state_shardings(
+    state: TrainState, mesh: Mesh, n_scenarios: int = 3, tensor_parallel: bool = False
+) -> Any:
+    """NamedSharding tree for a full HDCE TrainState (params + opt state +
+    batch stats — optax's Adam moments mirror the param tree, so one rule set
+    covers everything)."""
+    fed_ok = mesh.shape.get("fed", 1) == n_scenarios
+    tp_ok = tensor_parallel and mesh.shape.get("model", 1) > 1
+
+    def spec_for(path, leaf) -> NamedSharding:
+        nd = jax.numpy.ndim(leaf)
+        ps = _path_str(path)
+        if fed_ok and "StackedConvP128" in ps and nd >= 1 and leaf.shape[0] == n_scenarios:
+            return NamedSharding(mesh, P("fed", *(None,) * (nd - 1)))
+        if tp_ok and "FCP128" in ps and ps.endswith("kernel") and nd == 2:
+            return NamedSharding(mesh, P(None, "model"))
+        if tp_ok and "FCP128" in ps and ps.endswith("bias") and nd == 1:
+            return NamedSharding(mesh, P("model"))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec_for, state)
+
+
+def shard_hdce_state(
+    state: TrainState, mesh: Mesh, n_scenarios: int = 3, tensor_parallel: bool = False
+) -> TrainState:
+    shardings = hdce_state_shardings(state, mesh, n_scenarios, tensor_parallel)
+    return jax.tree.map(jax.device_put, state, shardings)

@@ -1,0 +1,58 @@
+"""Device-mesh construction: the communication layer IS the mesh.
+
+The reference's only distribution is single-process ``torch.nn.DataParallel``
+over 4 CUDA GPUs (``Runner_P128_QuantumNAT_onchipQNN.py:144-148`` — per-forward
+scatter/replicate/gather; no NCCL/MPI anywhere, SURVEY.md §2.7). TPU-native
+replacement: a named ``jax.sharding.Mesh`` with three logical axes —
+
+- ``data``  — batch sharding (data parallel; gradient psum compiler-inserted),
+- ``model`` — tensor/statevector sharding (the 2^n amplitudes, the 4096x2048
+  head),
+- ``fed``   — the federated scenario axis (per-BS trunks local, shared head
+  psum-aggregated; BASELINE.json config 4),
+
+with XLA collectives riding ICI within a slice and DCN across slices. For
+multi-host, call :func:`init_distributed` first (``jax.distributed``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from qdml_tpu.config import MeshConfig
+
+
+def init_distributed(**kwargs) -> None:
+    """Multi-host init (no-op on a single process)."""
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (RuntimeError, ValueError):
+        pass  # already initialised or single-process
+
+
+def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a (fed, data, model) mesh from the available devices.
+
+    ``data_axis=-1`` consumes all devices left over after the model/fed axes.
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    model = max(cfg.model_axis, 1)
+    fed = max(cfg.fed_axis, 1)
+    if cfg.data_axis == -1:
+        data = max(n // (model * fed), 1)
+    else:
+        data = max(cfg.data_axis, 1)
+    need = fed * data * model
+    if need > n:
+        raise ValueError(f"mesh {fed}x{data}x{model} needs {need} devices, have {n}")
+    arr = np.array(devices[:need]).reshape(fed, data, model)
+    return Mesh(arr, (cfg.fed_axis_name, cfg.data_axis_name, cfg.model_axis_name))
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1), ("fed", "data", "model"))

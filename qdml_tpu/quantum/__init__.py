@@ -1,0 +1,21 @@
+from qdml_tpu.quantum.circuits import (  # noqa: F401
+    angle_embed,
+    ansatz_unitary,
+    apply_ansatz_tensor,
+    rot_gate,
+    run_circuit,
+)
+from qdml_tpu.quantum.statevector import (  # noqa: F401
+    apply_1q,
+    apply_cnot,
+    apply_perm,
+    apply_ry,
+    apply_rz,
+    cnot_perm,
+    expvals_z,
+    gate_h,
+    gate_rx,
+    ring_cnot_perm,
+    z_signs,
+    zero_state,
+)

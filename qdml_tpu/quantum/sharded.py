@@ -1,0 +1,241 @@
+"""Mesh-sharded statevector simulation: the 2^n amplitudes across devices.
+
+The reference's scaling axis is qubit count (published 4/6/8-qubit runs; the
+BASELINE.json "16-qubit QNN, pjit model-sharded statevec" config) — the
+TPU-native analog of sequence parallelism (SURVEY.md §5.7): a 16-qubit batched
+statevector (batch x 65536 amplitudes) is partitioned over the mesh's model
+axis and gates on sharded qubits become pairwise ``ppermute`` exchanges over
+the ICI ring, exactly the ring-exchange pattern of ring attention.
+
+Layout: with K = 2^k devices on the ``model`` axis, the k MOST significant
+qubits are "global" (their bits index the device), the remaining n-k are local
+(flat trailing dimension of each shard — maps to TPU lanes). Per device the
+shard is ``(batch, 2^(n-k))``.
+
+Gate rules (all differentiable; AD flows through ``ppermute``):
+
+- 1q gate on LOCAL qubit: ordinary axis-split application, zero comms.
+- RZ on GLOBAL qubit: diagonal — each device applies its bit's phase. No comms.
+- RY (or any 1q) on GLOBAL qubit: one ``ppermute`` with the partner device
+  (index XOR bit) then a local linear combination.
+- CNOT: control global/local x target global/local — either a local
+  permutation, a masked local flip, or a partner exchange with ``where``.
+
+Everything runs inside one ``shard_map`` region so XLA schedules the
+collectives; with ``k = 0`` this degrades to the unsharded tensor path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from qdml_tpu.quantum import statevector as sv
+from qdml_tpu.utils.complexops import CArr
+
+
+def _axis_size(axis_name: str) -> int:
+    return jax.lax.axis_size(axis_name)
+
+
+def _my_bit(axis_name: str, k: int, q: int) -> jnp.ndarray:
+    """Bit q (MSB-first among the k global qubits) of this device's index."""
+    idx = jax.lax.axis_index(axis_name)
+    return (idx >> (k - 1 - q)) & 1
+
+
+def _partner_perm(k_devices: int, bit: int) -> list[tuple[int, int]]:
+    """ppermute pairs: each device exchanges with index XOR (1 << bit_pos)."""
+    return [(d, d ^ bit) for d in range(k_devices)]
+
+
+def _exchange(local: CArr, axis_name: str, k: int, q: int) -> CArr:
+    """Fetch the partner shard for global qubit q (index XOR)."""
+    nd = _axis_size(axis_name)
+    pairs = _partner_perm(nd, 1 << (k - 1 - q))
+    return CArr(
+        jax.lax.ppermute(local.re, axis_name, pairs),
+        jax.lax.ppermute(local.im, axis_name, pairs),
+    )
+
+
+def _bc(theta) -> jnp.ndarray:
+    """Angle (scalar or batched (B,)) -> broadcastable over the (B, 2^n_local) shard."""
+    t = jnp.asarray(theta)
+    return t[..., None] if t.ndim else t
+
+
+def ry_global(local: CArr, theta, axis_name: str, k: int, q: int) -> CArr:
+    """RY(theta) on a sharded qubit: one partner exchange + linear combine."""
+    other = _exchange(local, axis_name, k, q)
+    b = _my_bit(axis_name, k, q)
+    c = jnp.cos(_bc(theta) / 2)
+    s = jnp.sin(_bc(theta) / 2)
+    # b == 0: amp0' = c*a0 - s*a1 (other holds a1); b == 1: amp1' = s*a0 + c*a1.
+    sign = jnp.where(b == 0, -1.0, 1.0)
+    return CArr(c * local.re + sign * s * other.re, c * local.im + sign * s * other.im)
+
+
+def rz_global(local: CArr, theta, axis_name: str, k: int, q: int) -> CArr:
+    """RZ on a sharded qubit is diagonal: apply the bit's phase locally."""
+    b = _my_bit(axis_name, k, q)
+    t = _bc(theta) / 2
+    c = jnp.cos(t)
+    s = jnp.where(b == 0, -jnp.sin(t), jnp.sin(t))  # e^{-it/2} or e^{+it/2}
+    return CArr(c * local.re - s * local.im, c * local.im + s * local.re)
+
+
+def _local_bits(n_local: int, q: int) -> jnp.ndarray:
+    """(2^n_local,) 0/1 mask of bit q (MSB-first) of the local flat index."""
+    idx = jnp.arange(2**n_local)
+    return (idx >> (n_local - 1 - q)) & 1
+
+
+def cnot_sharded(
+    local: CArr, axis_name: str, k: int, n_local: int, control: int, target: int
+) -> CArr:
+    """CNOT with qubits indexed globally (0..k-1 sharded, k..n-1 local)."""
+    c_global, t_global = control < k, target < k
+    if not c_global and not t_global:
+        perm = jnp.asarray(sv.cnot_perm(n_local, control - k, target - k))
+        return sv.apply_perm(local, perm)
+    if c_global and not t_global:
+        # X on the local target when my control bit is 1: flip-bit permutation.
+        cbit = _my_bit(axis_name, k, control)
+        flip = jnp.asarray(_flip_perm(n_local, target - k))
+        flipped = sv.apply_perm(local, flip)
+        keep = (cbit == 0)
+        return CArr(
+            jnp.where(keep, local.re, flipped.re), jnp.where(keep, local.im, flipped.im)
+        )
+    if not c_global and t_global:
+        other = _exchange(local, axis_name, k, target)
+        cbit = _local_bits(n_local, control - k)  # (2^n_local,)
+        take_other = (cbit == 1)
+        return CArr(
+            jnp.where(take_other, other.re, local.re),
+            jnp.where(take_other, other.im, local.im),
+        )
+    # both global: exchange on target bit where my control bit is 1
+    other = _exchange(local, axis_name, k, target)
+    cbit = _my_bit(axis_name, k, control)
+    keep = (cbit == 0)
+    return CArr(
+        jnp.where(keep, local.re, other.re), jnp.where(keep, local.im, other.im)
+    )
+
+
+def _flip_perm(n_local: int, q: int) -> np.ndarray:
+    idx = np.arange(2**n_local)
+    return idx ^ (1 << (n_local - 1 - q))
+
+
+def apply_1q_sharded(
+    local: CArr,
+    axis_name: str,
+    k: int,
+    n_local: int,
+    q: int,
+    kind: str,
+    theta,
+) -> CArr:
+    """Dispatch RY/RZ on global or local qubit q (global index)."""
+    if q < k:
+        return ry_global(local, theta, axis_name, k, q) if kind == "ry" else rz_global(
+            local, theta, axis_name, k, q
+        )
+    ql = q - k
+    if kind == "ry":
+        return sv.apply_ry(local, n_local, ql, theta)
+    return sv.apply_rz(local, n_local, ql, theta)
+
+
+def expvals_z_sharded(local: CArr, axis_name: str, k: int, n_local: int, n: int) -> jnp.ndarray:
+    """Per-wire <Z_i> with a single psum: (..., 2^n_local) -> (..., n)."""
+    probs = local.abs2()  # (B, 2^n_local)
+    local_ev = probs @ jnp.asarray(sv.z_signs(n_local))  # (B, n_local)
+    total = jnp.sum(probs, axis=-1, keepdims=True)  # (B, 1)
+    idx = jax.lax.axis_index(axis_name)
+    gbits = (idx >> (k - 1 - jnp.arange(k))) & 1  # (k,)
+    gsigns = 1.0 - 2.0 * gbits.astype(jnp.float32)
+    global_ev = total * gsigns  # (B, k)
+    ev = jnp.concatenate([global_ev, local_ev], axis=-1)  # (B, n)
+    return jax.lax.psum(ev, axis_name)
+
+
+def _circuit_local(
+    angles: jnp.ndarray,
+    weights: jnp.ndarray,
+    n: int,
+    n_layers: int,
+    k: int,
+    axis_name: str,
+) -> jnp.ndarray:
+    """The reference circuit on one shard (runs inside shard_map)."""
+    n_local = n - k
+    batch = angles.shape[:-1]
+    # |0...0>: amplitude 1 at flat index 0 on device 0 only.
+    idx = jax.lax.axis_index(axis_name)
+    re = jnp.zeros(batch + (2**n_local,), jnp.float32)
+    re = re.at[..., 0].set(jnp.where(idx == 0, 1.0, 0.0))
+    psi = CArr(re, jnp.zeros_like(re))
+
+    for q in range(n):
+        psi = apply_1q_sharded(psi, axis_name, k, n_local, q, "ry", angles[..., q])
+    for l in range(n_layers):
+        for q in range(n):
+            psi = apply_1q_sharded(psi, axis_name, k, n_local, q, "ry", weights[l, q, 0])
+            psi = apply_1q_sharded(psi, axis_name, k, n_local, q, "rz", weights[l, q, 1])
+        for c in range(n - 1):
+            psi = cnot_sharded(psi, axis_name, k, n_local, c, c + 1)
+        psi = cnot_sharded(psi, axis_name, k, n_local, n - 1, 0)
+    return expvals_z_sharded(psi, axis_name, k, n_local, n)
+
+
+def run_circuit_sharded(
+    angles: jnp.ndarray,
+    weights: jnp.ndarray,
+    n_qubits: int,
+    n_layers: int,
+    mesh: Mesh | None = None,
+    axis_name: str = "model",
+) -> jnp.ndarray:
+    """Reference circuit with the statevector sharded over ``mesh[axis_name]``.
+
+    Falls back to the tensor path when no suitable mesh axis exists.
+    """
+    if mesh is None:
+        mesh = _default_model_mesh(axis_name)
+    k_devices = mesh.shape[axis_name]
+    k = int(np.log2(k_devices))
+    if 2**k != k_devices:
+        raise ValueError(f"model axis size {k_devices} must be a power of two")
+    if k == 0:
+        from qdml_tpu.quantum.circuits import run_circuit
+
+        return run_circuit(angles, weights, n_qubits, n_layers, "tensor")
+
+    fn = jax.shard_map(
+        partial(
+            _circuit_local,
+            n=n_qubits,
+            n_layers=n_layers,
+            k=k,
+            axis_name=axis_name,
+        ),
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=P(),
+    )
+    return fn(angles, weights)
+
+
+def _default_model_mesh(axis_name: str) -> Mesh:
+    devs = np.array(jax.devices())
+    k = 1 << int(np.log2(len(devs)))
+    return Mesh(devs[:k], (axis_name,))

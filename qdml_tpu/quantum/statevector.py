@@ -1,0 +1,163 @@
+"""Differentiable TPU-native state-vector quantum simulator primitives.
+
+Replaces PennyLane's CPU ``default.qubit`` device + torch ``QNode`` bridge
+(reference ``Estimators_QuantumNAT_onchipQNN.py:122-149``) — the defining
+performance problem of the reference, whose every forward pass crosses a
+torch->PennyLane->CPU boundary (SURVEY.md §3.1). Here the statevector lives
+on-device as a :class:`~qdml_tpu.utils.complexops.CArr` real pair of shape
+``(..., 2**n)``, gates are jit-compiled XLA ops, batching is a leading axis
+(not a Python loop over samples), and gradients come from plain JAX AD — no
+parameter-shift rules needed on a simulator.
+
+Conventions: qubit 0 is the MOST significant bit of the flat basis index
+(axis order of the ``(2,)*n`` tensor view), matching PennyLane wire order.
+
+Scaling: with n qubits the statevector has ``2**n`` amplitudes; the flat last
+dimension maps to TPU lanes. For ``n >= 14`` use the mesh-sharded simulator in
+:mod:`qdml_tpu.quantum.sharded`.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.utils.complexops import CArr
+
+
+def zero_state(n: int, batch_shape: tuple[int, ...] = ()) -> CArr:
+    """|0...0> statevector, shape ``batch_shape + (2**n,)``."""
+    dim = 2**n
+    re = jnp.zeros(batch_shape + (dim,), jnp.float32).at[..., 0].set(1.0)
+    return CArr(re, jnp.zeros(batch_shape + (dim,), jnp.float32))
+
+
+def _split(psi: CArr, n: int, q: int):
+    """View the flat statevector with qubit ``q`` exposed: returns the two
+    half-slices ``psi_{q=0}``, ``psi_{q=1}`` of shape ``(..., 2**q, 2**(n-q-1))``
+    plus the lead shape for reassembly."""
+    lead = psi.shape[:-1]
+    left, right = 2**q, 2 ** (n - q - 1)
+    v = psi.reshape(lead + (left, 2, right))
+    return v[..., 0, :], v[..., 1, :], lead
+
+
+def _join(p0: CArr, p1: CArr, lead, n: int) -> CArr:
+    re = jnp.stack([p0.re, p1.re], axis=-2)
+    im = jnp.stack([p0.im, p1.im], axis=-2)
+    return CArr(re, im).reshape(lead + (2**n,))
+
+
+def _bcast(theta: jnp.ndarray) -> jnp.ndarray:
+    """Angle with batch shape ``lead`` -> broadcastable over ``(lead, L, R)``."""
+    return jnp.asarray(theta)[..., None, None]
+
+
+def apply_ry(psi: CArr, n: int, q: int, theta: jnp.ndarray) -> CArr:
+    """RY(theta) on qubit q. RY is real, so this is four real multiplies.
+
+    ``theta`` may be scalar or batched with the statevector's lead shape
+    (per-sample angles for AngleEmbedding, reference ``Estimators...py:127``).
+    """
+    p0, p1, lead = _split(psi, n, q)
+    c, s = jnp.cos(_bcast(theta) / 2), jnp.sin(_bcast(theta) / 2)
+    new0 = CArr(c * p0.re - s * p1.re, c * p0.im - s * p1.im)
+    new1 = CArr(s * p0.re + c * p1.re, s * p0.im + c * p1.im)
+    return _join(new0, new1, lead, n)
+
+
+def apply_rz(psi: CArr, n: int, q: int, theta: jnp.ndarray) -> CArr:
+    """RZ(theta) on qubit q: diag(e^{-i theta/2}, e^{+i theta/2})."""
+    p0, p1, lead = _split(psi, n, q)
+    c, s = jnp.cos(_bcast(theta) / 2), jnp.sin(_bcast(theta) / 2)
+    new0 = CArr(c * p0.re + s * p0.im, c * p0.im - s * p0.re)  # * e^{-i t/2}
+    new1 = CArr(c * p1.re - s * p1.im, c * p1.im + s * p1.re)  # * e^{+i t/2}
+    return _join(new0, new1, lead, n)
+
+
+def apply_1q(psi: CArr, n: int, q: int, u: CArr) -> CArr:
+    """Apply an arbitrary single-qubit gate ``u`` (CArr, shape (..., 2, 2),
+    broadcastable over the lead shape) to qubit q."""
+    p0, p1, lead = _split(psi, n, q)
+
+    def el(i, j) -> CArr:
+        return CArr(_bcast(u.re[..., i, j]), _bcast(u.im[..., i, j]))
+
+    new0 = el(0, 0) * p0 + el(0, 1) * p1
+    new1 = el(1, 0) * p0 + el(1, 1) * p1
+    return _join(new0, new1, lead, n)
+
+
+def apply_cnot(psi: CArr, n: int, control: int, target: int) -> CArr:
+    """CNOT as a basis permutation (gather on the flat statevector)."""
+    perm = cnot_perm(n, control, target)
+    return CArr(psi.re[..., perm], psi.im[..., perm])
+
+
+def apply_perm(psi: CArr, perm: jnp.ndarray) -> CArr:
+    """Apply a precomputed basis-state permutation: psi'[y] = psi[perm[y]]."""
+    return CArr(psi.re[..., perm], psi.im[..., perm])
+
+
+@lru_cache(maxsize=None)
+def cnot_perm(n: int, control: int, target: int) -> np.ndarray:
+    """Source-index permutation for CNOT(control, target): psi'[y] = psi[src[y]]."""
+    y = np.arange(2**n)
+    cbit = (y >> (n - 1 - control)) & 1
+    src = y ^ (cbit << (n - 1 - target))
+    return src
+
+
+@lru_cache(maxsize=None)
+def ring_cnot_perm(n: int) -> np.ndarray:
+    """Composed permutation of the reference's entangling ring
+    (``Estimators...py:137-139``): CNOT(i, i+1) for i < n-1, then CNOT(n-1, 0).
+
+    Returns ``src`` with ``psi'[y] = psi[src[y]]``.
+    """
+    # Forward map f: basis x -> ring(x), built by applying CNOTs in order.
+    x = np.arange(2**n)
+    out = x.copy()
+    for c in range(n - 1):
+        cbit = (out >> (n - 1 - c)) & 1
+        out = out ^ (cbit << (n - 1 - (c + 1)))
+    cbit = (out >> (n - 1 - (n - 1))) & 1
+    out = out ^ (cbit << (n - 1 - 0))
+    # psi'[f(x)] = psi[x]  =>  src[y] = f^{-1}(y)
+    src = np.empty_like(x)
+    src[out] = x
+    return src
+
+
+@lru_cache(maxsize=None)
+def z_signs(n: int) -> np.ndarray:
+    """(2**n, n) matrix of PauliZ eigenvalues: entry [b, i] = +1 if bit i of
+    basis state b (MSB-first) is 0 else -1."""
+    b = np.arange(2**n)
+    bits = (b[:, None] >> (n - 1 - np.arange(n))[None, :]) & 1
+    return (1.0 - 2.0 * bits).astype(np.float32)
+
+
+def expvals_z(psi: CArr, n: int) -> jnp.ndarray:
+    """Per-wire <PauliZ_i> (reference measurement, ``Estimators...py:142``):
+    probabilities contracted with the sign matrix — one real MXU matmul."""
+    probs = psi.abs2()  # (..., 2**n)
+    return probs @ jnp.asarray(z_signs(n))
+
+
+# -- common fixed gates (for tests and extensions) --------------------------
+
+
+def gate_h() -> CArr:
+    m = np.array([[1.0, 1.0], [1.0, -1.0]]) / np.sqrt(2.0)
+    return CArr(jnp.asarray(m, jnp.float32), jnp.zeros((2, 2), jnp.float32))
+
+
+def gate_rx(theta: float) -> CArr:
+    c, s = np.cos(theta / 2), np.sin(theta / 2)
+    return CArr(
+        jnp.asarray([[c, 0.0], [0.0, c]], jnp.float32),
+        jnp.asarray([[0.0, -s], [-s, 0.0]], jnp.float32),
+    )

@@ -1,0 +1,22 @@
+from qdml_tpu.train.checkpoint import (  # noqa: F401
+    has_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from qdml_tpu.train.hdce import (  # noqa: F401
+    HDCE,
+    cell_nmse,
+    init_hdce_state,
+    make_hdce_eval_step,
+    make_hdce_train_step,
+    train_hdce,
+)
+from qdml_tpu.train.optim import get_optimizer, lr_schedule  # noqa: F401
+from qdml_tpu.train.qsc import (  # noqa: F401
+    build_classifier,
+    init_sc_state,
+    make_sc_eval_step,
+    make_sc_train_step,
+    train_classifier,
+)
+from qdml_tpu.train.state import TrainState  # noqa: F401

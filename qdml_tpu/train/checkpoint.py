@@ -1,0 +1,51 @@
+"""Checkpointing with orbax: best + last policies, resume-capable.
+
+The reference saves write-only ``torch.save`` state dicts with
+filename-encoded metadata and two policies — best-metric and final-epoch
+(``Runner_P128_QuantumNAT_onchipQNN.py:237-266, 417-426``) — and its loader
+must juggle three dict formats plus DataParallel ``module.`` prefixes
+(``Test.py:23-62``). Here checkpoints are orbax PyTree directories with a
+sidecar ``meta.json`` (epoch, metric, config name); restore is structure-safe
+and training can RESUME (the reference cannot — SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _ckptr() -> ocp.StandardCheckpointer:
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(workdir: str, tag: str, payload: Any, meta: dict | None = None) -> str:
+    """Save a pytree under ``workdir/tag`` (tag in {'best', 'last', ...})."""
+    path = os.path.abspath(os.path.join(workdir, tag))
+    payload = jax.tree.map(lambda x: x, payload)  # shallow copy
+    ckptr = _ckptr()
+    ckptr.save(path, payload, force=True)
+    ckptr.wait_until_finished()
+    if meta is not None:
+        with open(path + ".meta.json", "w") as fh:
+            json.dump(meta, fh)
+    return path
+
+
+def restore_checkpoint(workdir: str, tag: str, target: Any | None = None) -> tuple[Any, dict]:
+    """Restore ``workdir/tag``; returns (pytree, meta dict)."""
+    path = os.path.abspath(os.path.join(workdir, tag))
+    restored = _ckptr().restore(path, target)
+    meta: dict = {}
+    if os.path.exists(path + ".meta.json"):
+        with open(path + ".meta.json") as fh:
+            meta = json.load(fh)
+    return restored, meta
+
+
+def has_checkpoint(workdir: str, tag: str) -> bool:
+    return os.path.isdir(os.path.join(workdir, tag))

@@ -1,0 +1,189 @@
+"""HDCE training: hierarchical deep channel estimation, one fused SPMD step.
+
+Reference training loop (``Runner_P128_QuantumNAT_onchipQNN.py:134-283``,
+SURVEY.md §3.2): three ``Conv_P128`` trunks + one shared ``FC_P128`` head, four
+Adam optimizers, and NINE sequential ``backward()`` calls per step (one per
+(scenario, user) grid cell, each loss divided by 9 — gradient accumulation
+across the grid; the head accumulates from all 9 cells, each trunk from its 3
+user cells).
+
+TPU-native re-design: the 3x3 grid is ONE stacked array batch, the three trunks
+are ONE vmapped module (:class:`~qdml_tpu.models.cnn.StackedConvP128`), the
+summed per-cell loss is differentiated ONCE (gradients accumulate linearly, so
+one backward of ``mean_cells(nmse_cell)`` produces gradients identical to the
+reference's nine ``(loss/9).backward()`` calls), and the four Adam optimizers
+collapse into one optax Adam over the combined tree (Adam is elementwise, so
+disjoint param slices update identically). The whole step — data included —
+is jit-compiled; under a mesh the batch axis shards for data parallelism
+(:mod:`qdml_tpu.parallel`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import DMLGridLoader
+from qdml_tpu.models.cnn import FCP128, StackedConvP128
+from qdml_tpu.train.checkpoint import save_checkpoint
+from qdml_tpu.train.optim import get_optimizer
+from qdml_tpu.train.state import TrainState
+from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
+
+
+class HDCE(nn.Module):
+    """Stacked per-scenario trunks + shared head.
+
+    Input ``(S, B, 16, 8, 2)`` -> ``(S, B, 2048)``; scenario s flows through
+    trunk slice s only, and every scenario shares the single FC head — the
+    reference's "shared knowledge" hierarchy (``Runner...py:139-142``).
+    """
+
+    n_scenarios: int = 3
+    features: int = 32
+    out_dim: int = 2048
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        feats = StackedConvP128(self.n_scenarios, self.features, self.dtype)(x, train=train)
+        return FCP128(self.out_dim, self.dtype)(feats)
+
+
+def cell_nmse(pred: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
+    """Per-grid-cell whole-batch NMSE: (S, U, B, D) -> (S, U)."""
+    err = jnp.sum((pred - label) ** 2, axis=(-1, -2))
+    pow_ = jnp.sum(label**2, axis=(-1, -2))
+    return err / pow_
+
+
+def make_hdce_train_step(model: HDCE, tx) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        s, u, b = batch["yp_img"].shape[:3]
+        x = batch["yp_img"].reshape(s, u * b, *batch["yp_img"].shape[3:])
+        label = batch["h_label"]
+        perf = batch["h_perf"]
+
+        def loss_fn(params):
+            out, upd = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            pred = out.reshape(s, u, b, -1)
+            loss = jnp.mean(cell_nmse(pred, label))  # == reference sum(cell/9)
+            loss_perf = jnp.mean(cell_nmse(pred, perf))
+            return loss, (upd["batch_stats"], loss_perf)
+
+        (loss, (new_stats, loss_perf)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        state = state.apply_gradients(grads=grads)
+        state = state.replace(batch_stats=new_stats)
+        return state, {"loss": loss, "loss_perf": loss_perf}
+
+    return step
+
+
+def make_hdce_eval_step(model: HDCE) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: dict) -> dict:
+        s, u, b = batch["yp_img"].shape[:3]
+        x = batch["yp_img"].reshape(s, u * b, *batch["yp_img"].shape[3:])
+        out = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats}, x, train=False
+        )
+        pred = out.reshape(s, u, b, -1)
+        # Error/power sums so the caller can form the epoch NMSE over ALL val
+        # data (the reference concatenates predictions first, Runner...py:216-235).
+        return {
+            "err": jnp.sum((pred - batch["h_label"]) ** 2),
+            "pow": jnp.sum(batch["h_label"] ** 2),
+            "err_perf": jnp.sum((pred - batch["h_perf"]) ** 2),
+            "pow_perf": jnp.sum(batch["h_perf"] ** 2),
+        }
+
+    return step
+
+
+def init_hdce_state(cfg: ExperimentConfig, steps_per_epoch: int) -> tuple[HDCE, TrainState]:
+    model = HDCE(
+        n_scenarios=cfg.data.n_scenarios,
+        features=cfg.model.features,
+        out_dim=cfg.model.h_out_dim,
+    )
+    dummy = jnp.zeros(
+        (cfg.data.n_scenarios, 2, *cfg.model.image_hw, 2), jnp.float32
+    )
+    variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)
+    tx = get_optimizer(cfg.train, steps_per_epoch)
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        tx=tx,
+        batch_stats=variables["batch_stats"],
+    )
+    return model, state
+
+
+def train_hdce(
+    cfg: ExperimentConfig,
+    logger: MetricsLogger | None = None,
+    workdir: str | None = None,
+) -> tuple[TrainState, dict]:
+    """Full HDCE training run (reference ``train_Conv_Linear_of_HDCE``).
+
+    Returns the final state and a history dict with per-epoch train/val NMSE.
+    """
+    logger = logger or MetricsLogger(echo=False)
+    geom = ChannelGeometry.from_config(cfg.data)
+    train_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "train", geom)
+    val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
+    model, state = init_hdce_state(cfg, train_loader.steps_per_epoch)
+    train_step = make_hdce_train_step(model, state.tx)
+    eval_step = make_hdce_eval_step(model)
+
+    history: dict[str, list] = {"train_loss": [], "val_nmse": [], "val_nmse_perf": []}
+    best = float("inf")
+    for epoch in range(cfg.train.n_epochs):
+        tot, n = 0.0, 0
+        for batch in train_loader.epoch(epoch):
+            state, m = train_step(state, batch)
+            tot, n = tot + float(m["loss"]), n + 1
+            if n % cfg.train.print_freq == 0:
+                logger.log(step=int(state.step), epoch=epoch, loss=float(m["loss"]))
+        train_loss = tot / max(n, 1)
+
+        sums = {"err": 0.0, "pow": 0.0, "err_perf": 0.0, "pow_perf": 0.0}
+        for batch in val_loader.epoch(epoch, shuffle=False):
+            out = eval_step(state, batch)
+            for k in sums:
+                sums[k] += float(out[k])
+        val_nmse = sums["err"] / max(sums["pow"], 1e-30)
+        val_perf = sums["err_perf"] / max(sums["pow_perf"], 1e-30)
+        history["train_loss"].append(train_loss)
+        history["val_nmse"].append(val_nmse)
+        history["val_nmse_perf"].append(val_perf)
+        logger.log(
+            epoch=epoch,
+            train_loss=train_loss,
+            val_nmse=val_nmse,
+            val_nmse_db=nmse_db(val_nmse),
+            val_nmse_perf=val_perf,
+        )
+
+        if workdir is not None:
+            payload = {"params": state.params, "batch_stats": state.batch_stats}
+            meta = {"epoch": epoch, "val_nmse": val_nmse, "name": cfg.name}
+            if val_nmse < best:
+                best = val_nmse
+                save_checkpoint(workdir, "hdce_best", payload, meta)
+            save_checkpoint(workdir, "hdce_last", payload, meta)
+    return state, history

@@ -1,0 +1,50 @@
+"""Optimizers and LR schedule (reference ``get_optimizer`` + manual decay).
+
+Reference facts reproduced:
+- 'adam' -> Adam, 'sgd' -> SGD(momentum=0.9), anything else ->
+  NotImplementedError (``Runner_P128_QuantumNAT_onchipQNN.py:40-46``);
+- the QSC trainer uses AdamW(lr=1e-3, weight_decay=0.01) (``Runner...py:320``);
+- LR is halved every ``lr_decay_epochs`` (30) epochs with floor 1e-6
+  (``Runner...py:272-283``) — here an optax schedule instead of a manual
+  param-group mutation;
+- on-chip-QNN gradient pruning slots in FRONT of the optimizer
+  (``Runner...py:364-369``) as an optax transform.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from qdml_tpu.config import QuantumConfig, TrainConfig
+from qdml_tpu.ops.grad_prune import gradient_prune
+
+
+def lr_schedule(cfg: TrainConfig, steps_per_epoch: int) -> optax.Schedule:
+    """Step-indexed schedule: halve every ``lr_decay_epochs`` epochs, floored."""
+
+    def sched(step):
+        epoch = step // max(steps_per_epoch, 1)
+        lr = cfg.lr * 0.5 ** (epoch // cfg.lr_decay_epochs)
+        return jnp.maximum(lr, cfg.lr_floor)
+
+    return sched
+
+
+def get_optimizer(
+    cfg: TrainConfig,
+    steps_per_epoch: int,
+    quantum: QuantumConfig | None = None,
+) -> optax.GradientTransformation:
+    sched = lr_schedule(cfg, steps_per_epoch)
+    if cfg.optimizer == "adam":
+        base = optax.adam(sched)
+    elif cfg.optimizer == "adamw":
+        base = optax.adamw(sched, weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "sgd":
+        base = optax.sgd(sched, momentum=cfg.momentum)
+    else:
+        raise NotImplementedError(f"optimizer {cfg.optimizer!r}")  # Runner...py:46
+    if quantum is not None and quantum.use_gradient_pruning:
+        return optax.chain(gradient_prune(quantum.gradient_threshold), base)
+    return base

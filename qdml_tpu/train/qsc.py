@@ -1,0 +1,145 @@
+"""Scenario-classifier training (classical SC and quantum QSC).
+
+Reference loop (``train_QSC_P128``, ``Runner_P128_QuantumNAT_onchipQNN.py:307-426``,
+SURVEY.md §3.1): AdamW(1e-3, wd=0.01), 100 epochs over the 3x3 grid with
+``F.nll_loss/9`` summed per cell, optional QuantumNAT noise injection and
+gradient pruning, best-accuracy + last checkpoints.
+
+TPU-native: the grid flattens to one batch (equal cell sizes make the summed
+per-cell mean equal to the flat mean), the QuantumNAT PRNG is threaded through
+``apply(rngs={'quantumnat': ...})``, pruning lives in the optax chain, and the
+step jits end-to-end — there is no torch->PennyLane->CPU boundary (the
+reference's hottest bottleneck, SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import DMLGridLoader
+from qdml_tpu.models.cnn import SCP128
+from qdml_tpu.models.losses import nll_loss
+from qdml_tpu.models.qsc import QSCP128
+from qdml_tpu.train.checkpoint import save_checkpoint
+from qdml_tpu.train.optim import get_optimizer
+from qdml_tpu.train.state import TrainState
+from qdml_tpu.utils.metrics import MetricsLogger
+
+
+def build_classifier(cfg: ExperimentConfig, quantum: bool) -> nn.Module:
+    if quantum:
+        return QSCP128(
+            n_qubits=cfg.quantum.n_qubits,
+            n_layers=cfg.quantum.n_layers,
+            n_classes=cfg.quantum.n_classes,
+            use_quantumnat=cfg.quantum.use_quantumnat,
+            noise_level=cfg.quantum.noise_level,
+            backend=cfg.quantum.backend,
+        )
+    return SCP128(n_classes=cfg.quantum.n_classes)
+
+
+def make_sc_train_step(model: nn.Module, needs_rng: bool) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: dict, rng: jax.Array):
+        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+        labels = batch["indicator"].reshape(-1)
+
+        def loss_fn(params):
+            kwargs = {"rngs": {"quantumnat": rng}} if needs_rng else {}
+            log_probs = model.apply({"params": params}, x, train=True, **kwargs)
+            return nll_loss(log_probs, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss}
+
+    return step
+
+
+def make_sc_eval_step(model: nn.Module) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: dict):
+        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+        labels = batch["indicator"].reshape(-1)
+        log_probs = model.apply({"params": state.params}, x, train=False)
+        return {
+            "nll_sum": -jnp.sum(
+                jnp.take_along_axis(log_probs, labels[:, None], axis=-1)
+            ),
+            "correct": jnp.sum(jnp.argmax(log_probs, -1) == labels),
+            "count": jnp.asarray(labels.size, jnp.float32),
+        }
+
+    return step
+
+
+def init_sc_state(cfg: ExperimentConfig, quantum: bool, steps_per_epoch: int):
+    model = build_classifier(cfg, quantum)
+    dummy = jnp.zeros((2, *cfg.model.image_hw, 2), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)
+    train_cfg = cfg.train
+    if quantum:
+        # Reference QSC training uses AdamW (Runner...py:320).
+        import dataclasses
+
+        train_cfg = dataclasses.replace(train_cfg, optimizer="adamw")
+    tx = get_optimizer(train_cfg, steps_per_epoch, cfg.quantum if quantum else None)
+    state = TrainState.create(apply_fn=model.apply, params=variables["params"], tx=tx)
+    return model, state
+
+
+def train_classifier(
+    cfg: ExperimentConfig,
+    quantum: bool,
+    logger: MetricsLogger | None = None,
+    workdir: str | None = None,
+) -> tuple[TrainState, dict]:
+    """Train SC_P128 (classical) or QSC_P128 (quantum) over the DML grid."""
+    logger = logger or MetricsLogger(echo=False)
+    geom = ChannelGeometry.from_config(cfg.data)
+    train_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "train", geom)
+    val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
+    model, state = init_sc_state(cfg, quantum, train_loader.steps_per_epoch)
+    needs_rng = quantum and cfg.quantum.use_quantumnat
+    train_step = make_sc_train_step(model, needs_rng)
+    eval_step = make_sc_eval_step(model)
+    tag = "qsc" if quantum else "sc"
+
+    rng = jax.random.PRNGKey(cfg.train.seed + 1)
+    history: dict[str, list] = {"train_loss": [], "val_loss": [], "val_acc": []}
+    best_acc = -1.0
+    for epoch in range(cfg.train.n_epochs):
+        tot, n = 0.0, 0
+        for batch in train_loader.epoch(epoch):
+            rng, sub = jax.random.split(rng)
+            state, m = train_step(state, batch, sub)
+            tot, n = tot + float(m["loss"]), n + 1
+        train_loss = tot / max(n, 1)
+
+        sums = {"nll_sum": 0.0, "correct": 0.0, "count": 0.0}
+        for batch in val_loader.epoch(epoch, shuffle=False):
+            out = eval_step(state, batch)
+            for k in sums:
+                sums[k] += float(out[k])
+        val_loss = sums["nll_sum"] / max(sums["count"], 1)
+        val_acc = sums["correct"] / max(sums["count"], 1)
+        history["train_loss"].append(train_loss)
+        history["val_loss"].append(val_loss)
+        history["val_acc"].append(val_acc)
+        logger.log(epoch=epoch, train_loss=train_loss, val_loss=val_loss, val_acc=val_acc)
+
+        if workdir is not None:
+            payload = {"params": state.params}
+            meta = {"epoch": epoch, "val_acc": val_acc, "name": cfg.name}
+            if val_acc > best_acc:
+                best_acc = val_acc
+                save_checkpoint(workdir, f"{tag}_best", payload, meta)
+            save_checkpoint(workdir, f"{tag}_last", payload, meta)
+    return state, history

@@ -1,0 +1,14 @@
+"""Train state: params + optimizer + BatchNorm running statistics."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    """Flax TrainState extended with BatchNorm ``batch_stats`` (the reference
+    trunks use BatchNorm2d, ``Estimators_QuantumNAT_onchipQNN.py:52, 249``)."""
+
+    batch_stats: Any = None

@@ -1,0 +1,14 @@
+from qdml_tpu.utils.complexops import (  # noqa: F401
+    CArr,
+    ceinsum,
+    cexp_i,
+    cmatmul,
+    complex_to_real_pair,
+    cconcat,
+    cstack,
+    cwhere,
+    pack_h,
+    unpack_h,
+    yp_to_image,
+)
+from qdml_tpu.utils.metrics import MetricsLogger, nmse, nmse_complex, nmse_db  # noqa: F401

@@ -1,0 +1,231 @@
+"""TPU-native complex arithmetic: complex numbers as real pairs.
+
+TPUs have no native complex dtype support (on this backend even materialising a
+``complex64`` constant is UNIMPLEMENTED), and the MXU only multiplies real
+matrices. The idiomatic TPU representation of the complex-valued signal
+processing in the reference (complex pilots/channels throughout
+``Runner_P128_QuantumNAT_onchipQNN.py:97-132``, ``Test.py:140-214``) is a
+real/imag pair of float32 arrays — :class:`CArr` — with complex ops expanded
+into real ops:
+
+- elementwise ``(a+ib)(c+id) = (ac - bd) + i(ad + bc)``,
+- contractions (``cmatmul``/``ceinsum``) as four real contractions, each of
+  which XLA tiles onto the MXU,
+- ``exp(i theta) = (cos theta, sin theta)``.
+
+``CArr`` is a registered pytree, so it passes transparently through ``jit``,
+``vmap``, ``grad``, and sharding. Host-side conversion to numpy ``complex64``
+(for plots/serialisation) is the only place a true complex dtype appears.
+
+The reference's real-packing conventions (``cat([real, imag], dim=1)``,
+``view(bs, 2, 16, 8)`` at ``Runner...py:104-108``) map to :func:`pack_h` and
+:func:`yp_to_image` below, in TPU-friendly NHWC layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class CArr:
+    """A complex array stored as a (real, imag) pair of real arrays."""
+
+    __slots__ = ("re", "im")
+
+    def __init__(self, re: jnp.ndarray, im: jnp.ndarray):
+        self.re = re
+        self.im = im
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.re, self.im), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    # -- basic info --------------------------------------------------------
+    @property
+    def shape(self):
+        return jnp.shape(self.re)
+
+    @property
+    def dtype(self):
+        return jnp.result_type(self.re)
+
+    @property
+    def ndim(self):
+        return jnp.ndim(self.re)
+
+    def __repr__(self):
+        return f"CArr(shape={self.shape}, dtype={self.dtype})"
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def zeros(cls, shape, dtype=jnp.float32) -> "CArr":
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    @classmethod
+    def from_real(cls, re: jnp.ndarray) -> "CArr":
+        return cls(re, jnp.zeros_like(re))
+
+    @classmethod
+    def from_numpy(cls, x: np.ndarray | Any) -> "CArr":
+        """Host-side: numpy complex (or real) array -> CArr of float32."""
+        x = np.asarray(x)
+        return cls(
+            jnp.asarray(np.real(x), jnp.float32), jnp.asarray(np.imag(x), jnp.float32)
+        )
+
+    def to_numpy(self) -> np.ndarray:
+        """Host-side: CArr -> numpy complex64."""
+        return np.asarray(self.re) + 1j * np.asarray(self.im)
+
+    # -- elementwise algebra ----------------------------------------------
+    def __add__(self, o):
+        o = _as_carr(o)
+        return CArr(self.re + o.re, self.im + o.im)
+
+    def __sub__(self, o):
+        o = _as_carr(o)
+        return CArr(self.re - o.re, self.im - o.im)
+
+    def __mul__(self, o):
+        if isinstance(o, (int, float)) or (hasattr(o, "dtype") and not isinstance(o, CArr)):
+            return CArr(self.re * o, self.im * o)  # real scalar/array scaling
+        return CArr(
+            self.re * o.re - self.im * o.im, self.re * o.im + self.im * o.re
+        )
+
+    __rmul__ = __mul__
+    __radd__ = __add__
+
+    def conj(self) -> "CArr":
+        return CArr(self.re, -self.im)
+
+    def abs2(self) -> jnp.ndarray:
+        return self.re * self.re + self.im * self.im
+
+    def abs(self) -> jnp.ndarray:
+        return jnp.sqrt(self.abs2())
+
+    # -- shape ops ---------------------------------------------------------
+    def reshape(self, *shape) -> "CArr":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return CArr(self.re.reshape(shape), self.im.reshape(shape))
+
+    def transpose(self, *axes) -> "CArr":
+        return CArr(jnp.transpose(self.re, axes or None), jnp.transpose(self.im, axes or None))
+
+    def __getitem__(self, idx) -> "CArr":
+        return CArr(self.re[idx], self.im[idx])
+
+    def astype(self, dtype) -> "CArr":
+        return CArr(self.re.astype(dtype), self.im.astype(dtype))
+
+
+def _as_carr(x) -> CArr:
+    if isinstance(x, CArr):
+        return x
+    return CArr.from_real(jnp.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# Complex contractions as real contractions (MXU path)
+# ---------------------------------------------------------------------------
+
+
+def ceinsum(spec: str, a: CArr | jnp.ndarray, b: CArr | jnp.ndarray) -> CArr:
+    """Complex einsum over CArr operands via four real einsums."""
+    a, b = _as_carr(a), _as_carr(b)
+    rr = jnp.einsum(spec, a.re, b.re)
+    ii = jnp.einsum(spec, a.im, b.im)
+    ri = jnp.einsum(spec, a.re, b.im)
+    ir = jnp.einsum(spec, a.im, b.re)
+    return CArr(rr - ii, ri + ir)
+
+
+def cmatmul(a: CArr, b: CArr) -> CArr:
+    """Complex matmul via the 3-multiplication Gauss/Karatsuba trick.
+
+    ``(a+ib)(c+id)``: with ``k1=c(a+b)``, ``k2=a(d-c)``, ``k3=b(c+d)`` the
+    product is ``(k1-k3) + i(k1+k2)`` — three MXU matmuls instead of four.
+    """
+    a, b = _as_carr(a), _as_carr(b)
+    k1 = (a.re + a.im) @ b.re
+    k2 = a.re @ (b.im - b.re)
+    k3 = a.im @ (b.re + b.im)
+    return CArr(k1 - k3, k1 + k2)
+
+
+def ckron(a: CArr, b: CArr) -> CArr:
+    """Complex Kronecker product of 2-D CArrs: (p,q) x (r,s) -> (pr, qs)."""
+    out = ceinsum("ij,kl->ikjl", a, b)
+    p, q = a.shape
+    r, s = b.shape
+    return out.reshape(p * r, q * s)
+
+
+def cexp_i(theta: jnp.ndarray) -> CArr:
+    """``exp(i * theta)`` for real theta."""
+    return CArr(jnp.cos(theta), jnp.sin(theta))
+
+
+def cstack(arrs: list[CArr], axis: int = 0) -> CArr:
+    return CArr(
+        jnp.stack([a.re for a in arrs], axis), jnp.stack([a.im for a in arrs], axis)
+    )
+
+
+def cconcat(arrs: list[CArr], axis: int = 0) -> CArr:
+    return CArr(
+        jnp.concatenate([a.re for a in arrs], axis),
+        jnp.concatenate([a.im for a in arrs], axis),
+    )
+
+
+def cwhere(pred: jnp.ndarray, a: CArr, b: CArr) -> CArr:
+    a, b = _as_carr(a), _as_carr(b)
+    return CArr(jnp.where(pred, a.re, b.re), jnp.where(pred, a.im, b.im))
+
+
+# ---------------------------------------------------------------------------
+# Packing conventions (reference Runner...py:104-108, TPU NHWC)
+# ---------------------------------------------------------------------------
+
+
+def complex_to_real_pair(x: CArr) -> jnp.ndarray:
+    """``(..., d) -> (..., 2d)`` real, real half first (reference
+    ``cat([real, imag], dim=1)``, ``Runner...py:104-105``)."""
+    return jnp.concatenate([x.re, x.im], axis=-1)
+
+
+def pack_h(h: CArr) -> jnp.ndarray:
+    """Flat complex channel ``(..., h_dim)`` -> real training target ``(..., 2*h_dim)``."""
+    return complex_to_real_pair(h)
+
+
+def unpack_h(h2: jnp.ndarray) -> CArr:
+    """Inverse of :func:`pack_h`."""
+    d = h2.shape[-1] // 2
+    return CArr(h2[..., :d], h2[..., d:])
+
+
+def yp_to_image(yp: CArr, n_sub: int = 16, n_beam: int = 8) -> jnp.ndarray:
+    """Flat complex pilots ``(..., n_beam*n_sub)`` -> NHWC image
+    ``(..., n_sub, n_beam, 2)``.
+
+    The flat pilot vector is beam-major (``X[beam, sub].reshape(-1)``); the CNN
+    sees a (subcarrier, beam) spatial grid with re/im as trailing channels (the
+    reference uses a (2, 16, 8) NCHW view, ``Runner...py:108``; NHWC is the
+    native TPU conv layout).
+    """
+    x = yp.reshape(yp.shape[:-1] + (n_beam, n_sub))
+    img = jnp.stack([x.re, x.im], axis=-1)  # (..., n_beam, n_sub, 2)
+    return jnp.swapaxes(img, -2, -3)  # (..., n_sub, n_beam, 2)

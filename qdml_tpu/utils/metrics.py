@@ -1,0 +1,60 @@
+"""NMSE metrics and structured JSONL metric logging.
+
+The reference logs with bare ``print()`` (``Runner...py:206-208, 268-270``) and
+keeps histories in in-memory lists (``Runner...py:36-38``); its NMSE is a
+whole-batch ratio ``sum((x_hat-x)**2)/sum(x**2)``
+(``Estimators_QuantumNAT_onchipQNN.py:282-286``), reported in dB as
+``10*log10(nmse)`` (``Test.py:259-265``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, IO
+
+import jax.numpy as jnp
+
+
+def nmse(x_hat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Whole-batch NMSE over real arrays (reference ``NMSE_cuda``)."""
+    return jnp.sum((x_hat - x) ** 2) / jnp.sum(x**2)
+
+
+def nmse_complex(h_hat, h) -> jnp.ndarray:
+    """Whole-batch NMSE over complex (CArr real-pair) arrays."""
+    return jnp.sum((h_hat - h).abs2()) / jnp.sum(h.abs2())
+
+
+def nmse_db(value: float) -> float:
+    return 10.0 * math.log10(max(float(value), 1e-30))
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics stream + optional console echo."""
+
+    def __init__(self, path: str | None = None, echo: bool = True):
+        self._fh: IO[str] | None = None
+        self.echo = echo
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+
+    def log(self, step: int | None = None, **values: Any) -> None:
+        rec = {"ts": round(time.time(), 3)}
+        if step is not None:
+            rec["step"] = step
+        for k, v in values.items():
+            rec[k] = float(v) if hasattr(v, "item") else v
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+        if self.echo:
+            shown = {k: (round(v, 6) if isinstance(v, float) else v) for k, v in rec.items() if k != "ts"}
+            print(" ".join(f"{k}={v}" for k, v in shown.items()), flush=True)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

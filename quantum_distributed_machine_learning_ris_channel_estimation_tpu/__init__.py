@@ -1,0 +1,20 @@
+"""Alias package: full-name import path for the framework.
+
+The canonical implementation lives in :mod:`qdml_tpu` (the project's dashed
+name is not a valid Python identifier); this package re-exports it under the
+full underscored name.
+"""
+
+from qdml_tpu import *  # noqa: F401,F403
+from qdml_tpu import (  # noqa: F401
+    config,
+    data,
+    eval,
+    models,
+    ops,
+    parallel,
+    quantum,
+    train,
+    utils,
+)
+from qdml_tpu import __version__  # noqa: F401

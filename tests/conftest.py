@@ -1,0 +1,17 @@
+"""Test harness: force the CPU backend with 8 virtual devices.
+
+This is the standard JAX way to test pjit/psum/mesh logic without a real pod
+(SURVEY.md §4): multi-chip sharding tests see an 8-device mesh backed by host
+CPU. Must run before any ``import jax`` in test modules.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

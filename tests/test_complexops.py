@@ -1,0 +1,74 @@
+"""CArr (complex-as-real-pair) algebra vs numpy complex ground truth."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qdml_tpu.utils import CArr, ceinsum, cexp_i, cmatmul, pack_h, unpack_h, yp_to_image
+
+
+def _rand_c(rng, *shape):
+    return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_roundtrip(rng):
+    x = _rand_c(rng, 3, 4)
+    np.testing.assert_allclose(CArr.from_numpy(x).to_numpy(), x, rtol=1e-6)
+
+
+def test_elementwise(rng):
+    a, b = _rand_c(rng, 5, 7), _rand_c(rng, 5, 7)
+    ca, cb = CArr.from_numpy(a), CArr.from_numpy(b)
+    np.testing.assert_allclose((ca + cb).to_numpy(), a + b, rtol=1e-5)
+    np.testing.assert_allclose((ca - cb).to_numpy(), a - b, rtol=1e-5)
+    np.testing.assert_allclose((ca * cb).to_numpy(), a * b, rtol=1e-5)
+    np.testing.assert_allclose(ca.conj().to_numpy(), a.conj(), rtol=1e-5)
+    np.testing.assert_allclose(ca.abs2(), np.abs(a) ** 2, rtol=1e-5)
+
+
+def test_real_scaling(rng):
+    a = _rand_c(rng, 4, 4)
+    s = rng.standard_normal((4, 4)).astype(np.float32)
+    got = (CArr.from_numpy(a) * jnp.asarray(s)).to_numpy()
+    np.testing.assert_allclose(got, a * s, rtol=1e-5)
+
+
+def test_cmatmul_gauss_trick(rng):
+    a, b = _rand_c(rng, 6, 8), _rand_c(rng, 8, 5)
+    got = cmatmul(CArr.from_numpy(a), CArr.from_numpy(b)).to_numpy()
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_ceinsum(rng):
+    a, b = _rand_c(rng, 3, 6, 8), _rand_c(rng, 8, 5)
+    got = ceinsum("bij,jk->bik", CArr.from_numpy(a), CArr.from_numpy(b)).to_numpy()
+    np.testing.assert_allclose(got, np.einsum("bij,jk->bik", a, b), rtol=1e-4, atol=1e-5)
+
+
+def test_cexp_i():
+    theta = np.linspace(-3, 3, 17).astype(np.float32)
+    np.testing.assert_allclose(
+        cexp_i(jnp.asarray(theta)).to_numpy(), np.exp(1j * theta), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_pack_unpack(rng):
+    h = _rand_c(rng, 4, 10)
+    packed = pack_h(CArr.from_numpy(h))
+    assert packed.shape == (4, 20)
+    np.testing.assert_allclose(unpack_h(packed).to_numpy(), h, rtol=1e-6)
+
+
+def test_yp_to_image_layout(rng):
+    """Pixel (sub k, beam b, re) must equal Re Yp[b*n_sub + k] (beam-major flat)."""
+    yp = _rand_c(rng, 2, 128)
+    img = yp_to_image(CArr.from_numpy(yp), n_sub=16, n_beam=8)
+    assert img.shape == (2, 16, 8, 2)
+    b, k = 5, 11
+    np.testing.assert_allclose(img[1, k, b, 0], yp[1, b * 16 + k].real, rtol=1e-6)
+    np.testing.assert_allclose(img[1, k, b, 1], yp[1, b * 16 + k].imag, rtol=1e-6)

@@ -1,0 +1,148 @@
+"""Data layer: generator determinism, physics sanity, baselines, loaders."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qdml_tpu.config import DataConfig
+from qdml_tpu.data import (
+    ChannelGeometry,
+    DMLGridLoader,
+    beam_delay_profile,
+    generate_datapair,
+    generate_samples,
+    ls_estimate,
+    make_network_batch,
+    mmse_estimate,
+    sigma2_for_snr,
+)
+from qdml_tpu.utils import nmse_complex
+
+CFG = DataConfig(data_len=256)
+GEOM = ChannelGeometry.from_config(CFG)
+
+
+def _batch(n=128, snr=10.0, seed=CFG.seed, start=0):
+    i = jnp.arange(start, start + n)
+    return make_network_batch(
+        jnp.uint32(seed), i % 3, (i // 3) % 3, i, jnp.float32(snr), GEOM
+    )
+
+
+def test_shapes_and_dtypes():
+    out = _batch(32)
+    assert out["yp"].shape == (32, 128)
+    assert out["h_perf"].shape == (32, 2048)
+    assert out["h_label"].shape == (32, 2048)
+    assert out["yp_img"].shape == (32, 16, 8, 2)
+    assert out["indicator"].shape == (32,)
+    assert out["yp_img"].dtype == jnp.float32
+    assert out["indicator"].dtype == jnp.int32
+
+
+def test_determinism_and_offset_disjointness():
+    a = _batch(16)
+    b = _batch(16)
+    np.testing.assert_array_equal(np.asarray(a["yp"].re), np.asarray(b["yp"].re))
+    c = _batch(16, start=10_000)
+    assert not np.allclose(np.asarray(a["yp"].re), np.asarray(c["yp"].re))
+
+
+def test_channel_energy_normalised():
+    out = _batch(256, snr=100.0)
+    epow = float(jnp.mean(out["h_perf_c"].abs2()))
+    assert 0.8 < epow < 1.2  # E|H_ij|^2 ~ 1
+
+
+def test_ls_floor_is_leakage_limited():
+    """At very high SNR the LS error is the unsounded-beam leakage: small but nonzero."""
+    out = _batch(512, snr=100.0)
+    floor = float(nmse_complex(out["h_ls"], out["h_perf_c"]))
+    assert 0.005 < floor < 0.25
+
+
+def test_ls_improves_with_snr_and_mmse_beats_ls():
+    prof = beam_delay_profile(GEOM, n_samples=180)
+    vals = {}
+    for snr in (5.0, 15.0):
+        out = _batch(512, snr=snr)
+        ls = float(nmse_complex(out["h_ls"], out["h_perf_c"]))
+        mm = float(
+            nmse_complex(
+                mmse_estimate(out["h_ls"], sigma2_for_snr(GEOM, snr), prof, GEOM),
+                out["h_perf_c"],
+            )
+        )
+        vals[snr] = (ls, mm)
+        assert mm < ls  # LMMSE must beat LS
+    assert vals[15.0][0] < vals[5.0][0]  # LS improves with SNR
+
+
+def test_scenarios_are_distinguishable():
+    """Beam-energy spread differs across scenarios (the classifier's signal)."""
+    spreads = []
+    for s in range(3):
+        out = make_network_batch(
+            jnp.uint32(0),
+            jnp.full((256,), s),
+            jnp.arange(256) % 3,
+            jnp.arange(256),
+            jnp.float32(100.0),
+            GEOM,
+        )
+        p = out["yp"].abs2().reshape(256, GEOM.n_beam, GEOM.n_sub).sum(-1)
+        p = p / p.sum(-1, keepdims=True)
+        idx = jnp.arange(GEOM.n_beam)
+        mean = (p * idx).sum(-1)
+        var = (p * (idx - mean[:, None]) ** 2).sum(-1)
+        spreads.append(float(var.mean()))
+    assert spreads[0] < spreads[1] < spreads[2]
+
+
+def test_generate_datapair_contract():
+    out = generate_datapair(90, 128, -1, 10.0, 60000, CFG, GEOM)
+    ind = np.asarray(out["indicator"])
+    assert set(ind.tolist()) == {0, 1, 2}
+    single = generate_datapair(30, 128, 1, 10.0, 60000, CFG, GEOM)
+    assert set(np.asarray(single["indicator"]).tolist()) == {1}
+    with pytest.raises(ValueError):
+        generate_datapair(8, 64, -1, 10.0, 0, CFG, GEOM)
+
+
+def test_grid_loader():
+    ldr = DMLGridLoader(CFG, batch_size=32)
+    assert ldr.steps_per_epoch == int(256 * 0.9) // 32
+    batches = list(ldr.epoch(0))
+    assert len(batches) == ldr.steps_per_epoch
+    b = batches[0]
+    assert b["yp_img"].shape == (3, 3, 32, 16, 8, 2)
+    ind = np.asarray(b["indicator"])
+    for s in range(3):
+        assert (ind[s] == s).all()
+    # deterministic epochs
+    b2 = next(iter(ldr.epoch(0)))
+    np.testing.assert_array_equal(np.asarray(b["h_label"]), np.asarray(b2["h_label"]))
+    # val split uses disjoint indices
+    val = DMLGridLoader(CFG, batch_size=16, split="val")
+    assert val.index_base == int(256 * 0.9)
+
+
+def test_npy_cache_roundtrip(tmp_path):
+    from qdml_tpu.data import load_npy_cache, save_npy_cache
+
+    small = DataConfig(data_len=8)
+    save_npy_cache(str(tmp_path), small, chunk=4)
+    cell = load_npy_cache(str(tmp_path), small, 1, 2)
+    assert cell["Yp"].shape == (8, 128) and cell["Yp"].dtype == np.complex64
+    assert cell["Hlabel"].shape == (8, 1024)
+    assert cell["Hperf"].shape == (8, 1024)
+    # content matches on-the-fly generation
+    out = make_network_batch(
+        jnp.uint32(small.seed),
+        jnp.full((8,), 1),
+        jnp.full((8,), 2),
+        jnp.arange(8),
+        jnp.float32(small.snr_db),
+        GEOM,
+    )
+    np.testing.assert_allclose(cell["Hperf"], out["h_perf_c"].to_numpy(), rtol=1e-5, atol=1e-6)

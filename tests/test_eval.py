@@ -1,0 +1,70 @@
+"""Eval harness: routing ops, SNR sweep structure and baseline sanity."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.config import DataConfig, EvalConfig, ExperimentConfig, TrainConfig
+from qdml_tpu.eval import run_snr_sweep, save_results_json
+from qdml_tpu.ops import one_hot_dispatch, select_expert
+from qdml_tpu.train.hdce import init_hdce_state
+from qdml_tpu.train.qsc import init_sc_state
+
+
+def test_select_expert_and_one_hot_agree():
+    rng = np.random.default_rng(0)
+    stacked = jnp.asarray(rng.standard_normal((3, 8, 5)).astype(np.float32))
+    logp = jnp.asarray(rng.standard_normal((8, 3)).astype(np.float32))
+    pred = jnp.argmax(logp, -1)
+    a = select_expert(stacked, pred)
+    b = one_hot_dispatch(stacked, logp)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    for i in range(8):
+        np.testing.assert_allclose(np.asarray(a[i]), np.asarray(stacked[int(pred[i]), i]))
+
+
+def _sweep_cfg():
+    return ExperimentConfig(
+        data=DataConfig(data_len=64),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        eval=EvalConfig(snr_grid=(5.0, 15.0), test_len=60, batch_size=30),
+    )
+
+
+def test_snr_sweep_structure(tmp_path):
+    cfg = _sweep_cfg()
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    sc_vars = {"params": sc_state.params}
+    qcfg = dataclasses.replace(cfg, quantum=dataclasses.replace(cfg.quantum, n_qubits=4, n_layers=2))
+    _, qsc_state = init_sc_state(qcfg, quantum=True, steps_per_epoch=4)
+    qsc_vars = {"params": qsc_state.params}
+
+    results = run_snr_sweep(qcfg, hdce_vars, sc_vars, qsc_vars)
+    assert results["snr"] == [5.0, 15.0]
+    for curve in ("ls", "mmse", "hdce_classical", "hdce_quantum"):
+        assert len(results["nmse_db"][curve]) == 2
+        assert np.isfinite(results["nmse_db"][curve]).all()
+    # MMSE beats LS at both SNRs; LS improves with SNR
+    assert results["nmse_db"]["mmse"][0] < results["nmse_db"]["ls"][0]
+    assert results["nmse_db"]["ls"][1] < results["nmse_db"]["ls"][0]
+    for key in ("classical", "quantum"):
+        assert len(results["acc"][key]) == 2
+        assert all(0.0 <= a <= 1.0 for a in results["acc"][key])
+
+    path = save_results_json(results, str(tmp_path))
+    assert (tmp_path / "quantum_classical_comparison.json").exists()
+
+
+def test_sweep_without_quantum_checkpoint():
+    """Graceful fallback when no quantum classifier exists (Test.py:81-86)."""
+    cfg = _sweep_cfg()
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    results = run_snr_sweep(cfg, hdce_vars, {"params": sc_state.params}, None)
+    assert "hdce_quantum" not in results["nmse_db"]
+    assert "quantum" not in results["acc"]

@@ -1,0 +1,95 @@
+"""Model zoo: shapes, scenario isolation, loss semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.models import (
+    DCEP128,
+    FCP128,
+    QSCP128,
+    SCP128,
+    ConvP128,
+    StackedConvP128,
+    accuracy,
+    nll_loss,
+    nmse_loss,
+)
+
+KEY = jax.random.PRNGKey(0)
+X = jax.random.normal(KEY, (4, 16, 8, 2))
+
+
+def test_conv_p128_shape():
+    m = ConvP128()
+    v = m.init(KEY, X, train=False)
+    out = m.apply(v, X, train=False)
+    assert out.shape == (4, 32 * 16 * 8)  # 4096, reference Estimators...py:266
+
+
+def test_fc_and_dce_shapes():
+    feats = jnp.ones((4, 4096))
+    m = FCP128()
+    out = m.apply(m.init(KEY, feats), feats)
+    assert out.shape == (4, 2048)  # 64*16*2, reference Estimators...py:275
+    d = DCEP128()
+    v = d.init(KEY, X, train=False)
+    assert d.apply(v, X, train=False).shape == (4, 2048)
+
+
+def test_sc_p128_log_probs():
+    m = SCP128()
+    out = m.apply(m.init(KEY, X), X)
+    assert out.shape == (4, 3)
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_qsc_p128_log_probs():
+    m = QSCP128(n_qubits=4, n_layers=2)
+    v = m.init(KEY, X, train=False)
+    out = m.apply(v, X, train=False)
+    assert out.shape == (4, 3)
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_stacked_conv_scenario_isolation():
+    """Gradients from scenario s must touch only trunk slice s (the fused
+    equivalent of the reference's per-scenario optimizers, Runner...py:160-163)."""
+    m = StackedConvP128(n_scenarios=3)
+    xs = jax.random.normal(KEY, (3, 4, 16, 8, 2))
+    v = m.init(KEY, xs, train=False)
+
+    def loss(params):
+        out = m.apply({"params": params, "batch_stats": v["batch_stats"]}, xs, train=False)
+        return jnp.sum(out[0] ** 2)  # scenario 0 only
+
+    g = jax.grad(loss)(v["params"])
+    leaves = jax.tree.leaves(g)
+    assert all(l.shape[0] == 3 for l in leaves)
+    for l in leaves:
+        assert float(jnp.abs(l[0]).sum()) > 0  # slice 0 gets gradient
+        assert float(jnp.abs(l[1]).sum()) == 0  # slices 1,2 untouched
+        assert float(jnp.abs(l[2]).sum()) == 0
+
+
+def test_quantumnat_noise_changes_forward_only_in_train():
+    m = QSCP128(n_qubits=4, n_layers=2, use_quantumnat=True, noise_level=0.5)
+    v = m.init(KEY, X, train=False)
+    clean = m.apply(v, X, train=False)
+    k = jax.random.PRNGKey(7)
+    noisy = m.apply(v, X, train=True, rngs={"quantumnat": k})
+    noisy2 = m.apply(v, X, train=True, rngs={"quantumnat": k})
+    assert not np.allclose(np.asarray(clean), np.asarray(noisy))
+    np.testing.assert_array_equal(np.asarray(noisy), np.asarray(noisy2))  # deterministic in key
+
+
+def test_losses():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    xh = x + 1.0
+    np.testing.assert_allclose(float(nmse_loss(xh, x)), 4.0 / 30.0, rtol=1e-6)
+    lp = jnp.log(jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
+    labels = jnp.asarray([0, 1])
+    np.testing.assert_allclose(
+        float(nll_loss(lp, labels)), -(np.log(0.7) + np.log(0.8)) / 2, rtol=1e-6
+    )
+    assert float(accuracy(lp, labels)) == 1.0

@@ -1,0 +1,117 @@
+"""SPMD: sharded statevector vs tensor path; DP/federated step equivalence.
+
+Runs on the 8-virtual-device CPU mesh from conftest.py (the standard JAX way to
+test pjit/psum logic without a pod, SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from qdml_tpu.config import DataConfig, ExperimentConfig, MeshConfig, TrainConfig
+from qdml_tpu.data.datasets import DMLGridLoader
+from qdml_tpu.parallel import (
+    make_mesh,
+    replicate,
+    shard_grid_batch,
+    shard_hdce_state,
+)
+from qdml_tpu.quantum.circuits import run_circuit
+from qdml_tpu.quantum.sharded import run_circuit_sharded
+from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def _model_mesh(k: int) -> Mesh:
+    return Mesh(np.array(jax.devices()[:k]), ("model",))
+
+
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+def test_sharded_circuit_matches_tensor(n_devices):
+    n, layers = 6, 2
+    rng = np.random.default_rng(n_devices)
+    angles = jnp.asarray(rng.uniform(-1, 1, (5, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(-3, 3, (layers, n, 2)).astype(np.float32))
+    want = run_circuit(angles, w, n, layers, "tensor")
+    got = run_circuit_sharded(angles, w, n, layers, _model_mesh(n_devices))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_circuit_gradients_match():
+    n, layers = 5, 2
+    rng = np.random.default_rng(0)
+    angles = jnp.asarray(rng.uniform(-1, 1, (3, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(-1, 1, (layers, n, 2)).astype(np.float32))
+
+    g_ref = jax.grad(lambda w: jnp.sum(run_circuit(angles, w, n, layers, "tensor") ** 2))(w)
+    mesh = _model_mesh(4)
+    g_sh = jax.grad(
+        lambda w: jnp.sum(run_circuit_sharded(angles, w, n, layers, mesh) ** 2)
+    )(w)
+    np.testing.assert_allclose(np.asarray(g_sh), np.asarray(g_ref), rtol=1e-3, atol=1e-5)
+
+
+def _tiny_setup(batch_size=16):
+    cfg = ExperimentConfig(
+        data=DataConfig(data_len=64),
+        train=TrainConfig(batch_size=batch_size, n_epochs=1),
+    )
+    loader = DMLGridLoader(cfg.data, batch_size)
+    batch = next(iter(loader.epoch(0)))
+    model, state = init_hdce_state(cfg, loader.steps_per_epoch)
+    step = make_hdce_train_step(model, state.tx)
+    return cfg, state, step, batch
+
+
+def _first_leaf(tree):
+    return np.asarray(jax.tree.leaves(tree)[0])
+
+
+def test_dp_step_matches_single_device():
+    cfg, state, step, batch = _tiny_setup()
+    _, m_single = step(state, batch)
+    new_single, _ = step(state, batch)
+
+    mesh = make_mesh(MeshConfig(data_axis=-1, model_axis=1, fed_axis=1))
+    assert mesh.shape["data"] == 8
+    state_dp = replicate(state, mesh)
+    batch_dp = shard_grid_batch(batch, mesh)
+    new_dp, m_dp = step(state_dp, batch_dp)
+    np.testing.assert_allclose(float(m_dp["loss"]), float(m_single["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        _first_leaf(new_dp.params), _first_leaf(new_single.params), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_federated_step_matches_single_device():
+    cfg, state, step, batch = _tiny_setup()
+    new_single, m_single = step(state, batch)
+
+    mesh = make_mesh(MeshConfig(fed_axis=3, data_axis=-1, model_axis=1))
+    assert mesh.shape["fed"] == 3 and mesh.shape["data"] == 2
+    state_fed = shard_hdce_state(state, mesh)
+    batch_fed = shard_grid_batch(batch, mesh, fed=True)
+    new_fed, m_fed = step(state_fed, batch_fed)
+    np.testing.assert_allclose(float(m_fed["loss"]), float(m_single["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        _first_leaf(new_fed.params), _first_leaf(new_single.params), rtol=1e-4, atol=1e-6
+    )
+    # trunk params actually sharded over fed
+    conv_leaf = jax.tree_util.tree_leaves_with_path(new_fed.params)
+    stacked = [l for p, l in conv_leaf if "StackedConvP128" in str(p)][0]
+    assert "fed" in str(stacked.sharding.spec)
+
+
+def test_tensor_parallel_head():
+    cfg, state, step, batch = _tiny_setup()
+    _, m_single = step(state, batch)
+    mesh = make_mesh(MeshConfig(fed_axis=1, data_axis=2, model_axis=4))
+    state_tp = shard_hdce_state(state, mesh, tensor_parallel=True)
+    batch_tp = shard_grid_batch(batch, mesh)
+    _, m_tp = step(state_tp, batch_tp)
+    np.testing.assert_allclose(float(m_tp["loss"]), float(m_single["loss"]), rtol=1e-5)

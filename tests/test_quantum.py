@@ -1,0 +1,188 @@
+"""Quantum simulator: analytic gate goldens, independent numpy reference,
+tensor/dense path equivalence, differentiability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qdml_tpu.quantum import (
+    ansatz_unitary,
+    apply_1q,
+    apply_cnot,
+    apply_ry,
+    apply_rz,
+    expvals_z,
+    gate_h,
+    ring_cnot_perm,
+    run_circuit,
+    zero_state,
+)
+from qdml_tpu.utils.complexops import CArr
+
+# ---------------------------------------------------------------------------
+# Independent numpy reference simulator (dense complex matrices, MSB-first)
+# ---------------------------------------------------------------------------
+
+
+def np_ry(t):
+    c, s = np.cos(t / 2), np.sin(t / 2)
+    return np.array([[c, -s], [s, c]], dtype=np.complex128)
+
+
+def np_rz(t):
+    return np.diag([np.exp(-1j * t / 2), np.exp(1j * t / 2)])
+
+
+def np_on_wire(u, q, n):
+    m = np.eye(1, dtype=np.complex128)
+    for i in range(n):
+        m = np.kron(m, u if i == q else np.eye(2))
+    return m
+
+
+def np_cnot(c, t, n):
+    dim = 2**n
+    m = np.zeros((dim, dim), dtype=np.complex128)
+    for x in range(dim):
+        cbit = (x >> (n - 1 - c)) & 1
+        y = x ^ (cbit << (n - 1 - t))
+        m[y, x] = 1.0
+    return m
+
+
+def np_reference_circuit(angles, weights, n, n_layers):
+    psi = np.zeros(2**n, dtype=np.complex128)
+    psi[0] = 1.0
+    for q in range(n):
+        psi = np_on_wire(np_ry(angles[q]), q, n) @ psi
+    for l in range(n_layers):
+        for q in range(n):
+            psi = np_on_wire(np_ry(weights[l, q, 0]), q, n) @ psi
+            psi = np_on_wire(np_rz(weights[l, q, 1]), q, n) @ psi
+        for c in range(n - 1):
+            psi = np_cnot(c, c + 1, n) @ psi
+        psi = np_cnot(n - 1, 0, n) @ psi
+    probs = np.abs(psi) ** 2
+    bits = (np.arange(2**n)[:, None] >> (n - 1 - np.arange(n))[None, :]) & 1
+    return probs @ (1.0 - 2.0 * bits)
+
+
+# ---------------------------------------------------------------------------
+# Analytic gate goldens
+# ---------------------------------------------------------------------------
+
+
+def test_ry_on_zero():
+    """RY(t)|0> = cos(t/2)|0> + sin(t/2)|1>, <Z> = cos t."""
+    t = 0.7
+    psi = apply_ry(zero_state(1), 1, 0, jnp.float32(t))
+    np.testing.assert_allclose(psi.to_numpy(), [np.cos(t / 2), np.sin(t / 2)], rtol=1e-6)
+    np.testing.assert_allclose(expvals_z(psi, 1), [np.cos(t)], rtol=1e-5)
+
+
+def test_rz_phase():
+    """RZ on |+> rotates the relative phase."""
+    t = 1.1
+    psi = apply_1q(zero_state(1), 1, 0, gate_h())
+    psi = apply_rz(psi, 1, 0, jnp.float32(t))
+    expected = np.array([np.exp(-1j * t / 2), np.exp(1j * t / 2)]) / np.sqrt(2)
+    np.testing.assert_allclose(psi.to_numpy(), expected, rtol=1e-6, atol=1e-7)
+
+
+def test_cnot_truth_table():
+    for c, t, x, y in [(0, 1, 0b10, 0b11), (0, 1, 0b11, 0b10), (1, 0, 0b01, 0b11)]:
+        re = jnp.zeros(4).at[x].set(1.0)
+        psi = apply_cnot(CArr(re, jnp.zeros(4)), 2, c, t)
+        assert float(psi.re[y]) == 1.0
+
+
+def test_bell_state():
+    """H(0); CNOT(0,1) -> (|00> + |11>)/sqrt(2)."""
+    psi = apply_1q(zero_state(2), 2, 0, gate_h())
+    psi = apply_cnot(psi, 2, 0, 1)
+    np.testing.assert_allclose(
+        psi.to_numpy(), np.array([1, 0, 0, 1]) / np.sqrt(2), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_ring_perm_matches_sequential_cnots():
+    n = 4
+    rng = np.random.default_rng(3)
+    v = rng.standard_normal(2**n) + 1j * rng.standard_normal(2**n)
+    v /= np.linalg.norm(v)
+    psi = CArr.from_numpy(v)
+    seq = psi
+    for c in range(n - 1):
+        seq = apply_cnot(seq, n, c, c + 1)
+    seq = apply_cnot(seq, n, n - 1, 0)
+    ringed = CArr(psi.re[jnp.asarray(ring_cnot_perm(n))], psi.im[jnp.asarray(ring_cnot_perm(n))])
+    np.testing.assert_allclose(ringed.to_numpy(), seq.to_numpy(), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Full-circuit equivalence vs numpy reference; path equivalence
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,layers", [(4, 3), (6, 3), (8, 2)])
+def test_circuit_matches_numpy_reference(n, layers):
+    rng = np.random.default_rng(n)
+    angles = rng.uniform(-1, 1, (5, n)).astype(np.float32)
+    weights = rng.uniform(-np.pi, np.pi, (layers, n, 2)).astype(np.float32)
+    want = np.stack([np_reference_circuit(a, weights, n, layers) for a in angles])
+    for backend in ("tensor", "dense"):
+        got = run_circuit(jnp.asarray(angles), jnp.asarray(weights), n, layers, backend)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_unitarity():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.uniform(-3, 3, (3, 5, 2)).astype(np.float32))
+    u = ansatz_unitary(w, 5, 3).to_numpy()
+    np.testing.assert_allclose(u @ u.conj().T, np.eye(32), atol=1e-5)
+
+
+def test_norm_preserved_batched():
+    rng = np.random.default_rng(1)
+    angles = jnp.asarray(rng.uniform(-1, 1, (7, 6)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(-3, 3, (3, 6, 2)).astype(np.float32))
+    ev = run_circuit(angles, w, 6, 3, "tensor")
+    assert ev.shape == (7, 6)
+    assert np.all(np.abs(np.asarray(ev)) <= 1.0 + 1e-5)
+
+
+def test_gradients_match_finite_difference():
+    n, layers = 4, 2
+    rng = np.random.default_rng(2)
+    angles = jnp.asarray(rng.uniform(-1, 1, (3, n)).astype(np.float32))
+    w0 = rng.uniform(-1, 1, (layers, n, 2)).astype(np.float32)
+
+    def loss(w, backend):
+        return jnp.sum(run_circuit(angles, w, n, layers, backend) ** 2)
+
+    for backend in ("tensor", "dense"):
+        g = jax.grad(lambda w: loss(w, backend))(jnp.asarray(w0))
+        eps = 1e-3
+        idx = (1, 2, 0)
+        wp, wm = w0.copy(), w0.copy()
+        wp[idx] += eps
+        wm[idx] -= eps
+        fd = (float(loss(jnp.asarray(wp), backend)) - float(loss(jnp.asarray(wm), backend))) / (
+            2 * eps
+        )
+        np.testing.assert_allclose(float(g[idx]), fd, rtol=5e-2, atol=1e-3)
+
+
+def test_jit_and_vmap_compose():
+    n, layers = 6, 3
+    rng = np.random.default_rng(4)
+    angles = jnp.asarray(rng.uniform(-1, 1, (4, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(-1, 1, (layers, n, 2)).astype(np.float32))
+    f = jax.jit(lambda a, w: run_circuit(a, w, n, layers, "dense"))
+    np.testing.assert_allclose(
+        np.asarray(f(angles, w)),
+        np.asarray(run_circuit(angles, w, n, layers, "tensor")),
+        rtol=1e-4,
+        atol=1e-5,
+    )
