@@ -4,6 +4,7 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
 (``Runner_P128_QuantumNAT_onchipQNN.py:432-444``, ``Test.py:339-346``). Here:
 
     python -m qdml_tpu.cli train-hdce [--preset=NAME] [--train.lr=3e-4 ...]
+    python -m qdml_tpu.cli train-dce  [...]      # monolithic (non-HDCE) baseline
     python -m qdml_tpu.cli train-sc   [...]      # classical scenario classifier
     python -m qdml_tpu.cli train-qsc  [...]      # quantum scenario classifier
     python -m qdml_tpu.cli eval       [...]      # SNR sweep + plots + JSON
@@ -49,6 +50,10 @@ def main(argv: list[str] | None = None) -> int:
         from qdml_tpu.train.hdce import train_hdce
 
         train_hdce(cfg, logger=logger, workdir=workdir)
+    elif cmd == "train-dce":
+        from qdml_tpu.train.dce import train_dce
+
+        train_dce(cfg, logger=logger, workdir=workdir)
     elif cmd in ("train-sc", "train-qsc"):
         from qdml_tpu.train.qsc import train_classifier
 

@@ -70,6 +70,34 @@ def make_network_batch(
     }
 
 
+def _resolve_split(cfg: DataConfig, split: str) -> tuple[int, int]:
+    """(index_base, n) for a split — the reference's 90/10 train/val cut of
+    each (scenario, user) cell (``Runner...py:67-71``)."""
+    n_train = int(cfg.data_len * cfg.train_split)
+    if split == "train":
+        return 0, n_train
+    if split == "val":
+        return n_train, cfg.data_len - n_train
+    raise ValueError(f"unknown split {split!r}")
+
+
+def _epoch_perms(
+    cfg: DataConfig, n: int, index_base: int, epoch: int, shuffle: bool
+) -> np.ndarray:
+    """(S, U, n) per-cell sample indices for one epoch, deterministic in
+    ``(cfg.seed, epoch)`` — shared by both grid loaders so the on-device and
+    npy-cache data paths shuffle identically."""
+    s, u = cfg.n_scenarios, cfg.n_users
+    if shuffle:
+        rng = np.random.default_rng((cfg.seed, epoch))
+        perms = rng.permuted(
+            np.broadcast_to(np.arange(n), (s, u, n)).copy(), axis=-1
+        )
+    else:
+        perms = np.broadcast_to(np.arange(n), (s, u, n))
+    return perms + index_base
+
+
 class DMLGridLoader:
     """Iterates (shuffled) minibatches of the full 3x3 scenario/user grid.
 
@@ -88,13 +116,7 @@ class DMLGridLoader:
     ):
         self.cfg = cfg
         self.geom = geom or ChannelGeometry.from_config(cfg)
-        n_train = int(cfg.data_len * cfg.train_split)
-        if split == "train":
-            self.index_base, self.n = 0, n_train
-        elif split == "val":
-            self.index_base, self.n = n_train, cfg.data_len - n_train
-        else:
-            raise ValueError(f"unknown split {split!r}")
+        self.index_base, self.n = _resolve_split(cfg, split)
         self.batch_size = batch_size = min(batch_size, self.n)
         self.steps_per_epoch = self.n // batch_size
         s, u = cfg.n_scenarios, cfg.n_users
@@ -102,15 +124,8 @@ class DMLGridLoader:
         self._user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, batch_size))
 
     def epoch(self, epoch: int, shuffle: bool = True) -> Iterator[dict[str, jnp.ndarray]]:
-        rng = np.random.default_rng((self.cfg.seed, epoch))
-        s, u, bs = self.cfg.n_scenarios, self.cfg.n_users, self.batch_size
-        if shuffle:
-            perms = rng.permuted(
-                np.broadcast_to(np.arange(self.n), (s, u, self.n)).copy(), axis=-1
-            )
-        else:
-            perms = np.broadcast_to(np.arange(self.n), (s, u, self.n))
-        perms = perms + self.index_base
+        bs = self.batch_size
+        perms = _epoch_perms(self.cfg, self.n, self.index_base, epoch, shuffle)
         for step in range(self.steps_per_epoch):
             idx = jnp.asarray(perms[:, :, step * bs : (step + 1) * bs])
             yield make_network_batch(
@@ -206,3 +221,128 @@ def save_npy_cache(dirpath: str, cfg: DataConfig, chunk: int = 2048) -> None:
 def load_npy_cache(dirpath: str, cfg: DataConfig, scenario: int, user: int) -> dict[str, np.ndarray]:
     """Load one (scenario, user) cell from a reference-style ``.npy`` cache."""
     return {n: np.load(p) for n, p in _npy_names(dirpath, cfg, scenario, user).items()}
+
+
+class NpyGridLoader:
+    """DML grid loader over a materialised ``.npy`` cache, via the native IO
+    runtime: files are mmap'd zero-copy (:class:`~qdml_tpu.runtime.NativeNpyFile`),
+    shuffled batches are assembled by the C++ multithreaded row gather, and a
+    depth-2 pipeline overlaps the next batch's host assembly with the current
+    device step — the file-based twin of :class:`DMLGridLoader` (which
+    synthesizes on-device) and the replacement for the reference's
+    ``DataLoader(num_workers=0)`` host path (``Runner...py:24, 87-93``).
+
+    Yields the same stacked ``(S, U, bs, ...)`` batches as
+    :class:`DMLGridLoader` (``yp_img``, ``h_label``, ``h_perf``, ``indicator``).
+    """
+
+    def __init__(
+        self,
+        dirpath: str,
+        cfg: DataConfig,
+        batch_size: int,
+        split: str = "train",
+        n_threads: int = 4,
+        prefetch_depth: int = 2,
+    ):
+        from qdml_tpu.runtime import NativeNpyFile
+
+        self.cfg = cfg
+        self.geom = ChannelGeometry.from_config(cfg)
+        self.n_threads = n_threads
+        self.prefetch_depth = max(prefetch_depth, 1)
+        self._files: dict[tuple[int, int, str], NativeNpyFile] = {}
+        for s in range(cfg.n_scenarios):
+            for u in range(cfg.n_users):
+                for name, path in _npy_names(dirpath, cfg, s, u).items():
+                    self._files[(s, u, name)] = NativeNpyFile(path)
+        self.index_base, self.n = _resolve_split(cfg, split)
+        self.batch_size = min(batch_size, self.n)
+        self.steps_per_epoch = self.n // self.batch_size
+
+    @property
+    def is_native(self) -> bool:
+        return all(f.is_native for f in self._files.values())
+
+    def _assemble(self, idx_grid: np.ndarray) -> dict[str, jnp.ndarray]:
+        """Gather one (S, U, bs) step's rows from all 27 mmaps (C++ threads)."""
+        from qdml_tpu.runtime import gather_rows
+        from qdml_tpu.utils.complexops import CArr
+
+        cfg, geom = self.cfg, self.geom
+        s_n, u_n, bs = idx_grid.shape
+        grids: dict[str, np.ndarray] = {}
+        for name, dim in (("Yp", geom.pilot_num), ("Hlabel", geom.h_dim), ("Hperf", geom.h_dim)):
+            rows = np.empty((s_n, u_n, bs, dim), np.complex64)
+            for s in range(s_n):
+                for u in range(u_n):
+                    rows[s, u] = gather_rows(
+                        self._files[(s, u, name)].array, idx_grid[s, u], self.n_threads
+                    )
+            grids[name] = rows
+        yp = CArr.from_numpy(grids["Yp"])
+        h_ls = CArr.from_numpy(grids["Hlabel"])
+        h_perf = CArr.from_numpy(grids["Hperf"])
+        indicator = np.broadcast_to(
+            np.arange(s_n, dtype=np.int32)[:, None, None], (s_n, u_n, bs)
+        )
+        return {
+            "yp_img": yp_to_image(yp, geom.n_sub, geom.n_beam).astype(jnp.float32),
+            "h_label": pack_h(h_ls).astype(jnp.float32),
+            "h_perf": pack_h(h_perf).astype(jnp.float32),
+            "indicator": jnp.asarray(indicator),
+        }
+
+    def epoch(self, epoch: int, shuffle: bool = True) -> Iterator[dict[str, jnp.ndarray]]:
+        import queue
+        import threading
+
+        bs = self.batch_size
+        perms = _epoch_perms(self.cfg, self.n, self.index_base, epoch, shuffle)
+
+        # Depth-limited producer thread: the C++ gather releases the GIL, so
+        # host assembly of step k+1 overlaps the device's step k. The producer
+        # ALWAYS terminates with a sentinel — an assembly error is forwarded
+        # to the consumer (no silent hang), and consumer abandonment (early
+        # `break`) sets `stop` so the producer is never left blocked on put().
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch_depth)
+        stop = threading.Event()
+        _DONE, _ERR = object(), object()
+
+        def producer():
+            try:
+                for step in range(self.steps_per_epoch):
+                    item = self._assemble(perms[:, :, step * bs : (step + 1) * bs])
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                q.put((_DONE, None))
+            except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+                try:
+                    q.put((_ERR, e), timeout=1.0)
+                except queue.Full:
+                    pass
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, tuple) and len(item) == 2 and item[0] in (_DONE, _ERR):
+                    if item[0] is _ERR:
+                        raise item[1]
+                    break
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+    def close(self) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()

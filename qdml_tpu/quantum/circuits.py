@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from qdml_tpu.quantum import statevector as sv
 from qdml_tpu.utils.complexops import CArr, ceinsum, ckron
 
-VALID_BACKENDS = ("tensor", "dense", "sharded")
+VALID_BACKENDS = ("tensor", "dense", "sharded", "pallas", "pallas_tensor")
 
 
 def rot_gate(w_ry: jnp.ndarray, w_rz: jnp.ndarray) -> CArr:
@@ -102,6 +102,22 @@ def run_circuit(
     elif backend == "dense":
         u = ansatz_unitary(weights, n_qubits, n_layers)
         psi = ceinsum("...i,ji->...j", psi, u)
+    elif backend == "pallas":
+        # Fused Pallas kernel: unitary application + |.|^2 + <Z> contraction
+        # never leave VMEM (qdml_tpu.quantum.pallas_kernels).
+        from qdml_tpu.quantum.pallas_kernels import fused_unitary_expvals
+
+        u = ansatz_unitary(weights, n_qubits, n_layers)
+        return fused_unitary_expvals(psi, u, n_qubits)
+    elif backend == "pallas_tensor":
+        # Per-layer fused rotation kernel + ring permutation; scales past the
+        # dense path's 2^n x 2^n unitary (n ~ 10-14 single-chip).
+        from qdml_tpu.quantum.pallas_kernels import apply_rotation_layer
+
+        ring = jnp.asarray(sv.ring_cnot_perm(n_qubits))
+        for l in range(n_layers):
+            psi = apply_rotation_layer(psi, weights[l], n_qubits)
+            psi = sv.apply_perm(psi, ring)
     elif backend == "sharded":
         from qdml_tpu.quantum.sharded import run_circuit_sharded
 

@@ -1,0 +1,265 @@
+"""Pallas TPU kernels for the quantum-circuit hot path.
+
+The reference executes its variational circuit sample-by-sample on PennyLane's
+CPU ``default.qubit`` (``Estimators_QuantumNAT_onchipQNN.py:122-149``) — the
+hottest, slowest boundary in its training loop (SURVEY.md §3.1). The XLA
+"dense" path in :mod:`qdml_tpu.quantum.circuits` already turns the per-batch
+circuit cost into complex matmuls; this module fuses the remaining memory
+traffic away with a single Pallas kernel:
+
+    expvals = |psi_embedded @ U^T|^2 @ Zsigns
+
+computed per batch tile entirely in VMEM — the post-unitary statevector
+``psi'`` (batch x 2^n complex) and the probability vector never round-trip to
+HBM. The complex matmul uses the 3-multiplication Gauss trick, so the kernel
+issues three real MXU matmuls plus one more for the PauliZ contraction.
+
+Gradients are provided by a ``jax.custom_vjp`` whose backward pass is plain
+XLA matmul algebra (matmuls are what the MXU does best either way; the fusion
+win is in the forward's elided HBM round-trips).
+
+On non-TPU backends the kernel runs in Pallas interpret mode, which is how the
+CPU test suite validates it against the XLA paths (``tests/test_pallas.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from qdml_tpu.quantum import statevector as sv
+from qdml_tpu.utils.complexops import CArr
+
+# Batch tile: multiple of the f32 sublane tile (8); large enough to amortise
+# the (D, D) unitary reload across many samples.
+_TILE_B = 256
+# Lane width: pad the 2^n amplitude axis (and the n-wire output axis) to this.
+_LANES = 128
+
+
+def _pad_to(x: jnp.ndarray, axis: int, size: int) -> jnp.ndarray:
+    have = x.shape[axis]
+    if have == size:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, size - have)
+    return jnp.pad(x, pad)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fused_kernel(ar_ref, ai_ref, br_ref, bi_ref, z_ref, out_ref):
+    """One batch tile: Gauss-trick complex matmul + |.|^2 + Z contraction."""
+    ar, ai = ar_ref[:], ai_ref[:]
+    br, bi = br_ref[:], bi_ref[:]
+    # (a_r + i a_i)(b_r + i b_i) with 3 real MXU matmuls (Gauss/Karatsuba).
+    k1 = jnp.dot(ar + ai, br, preferred_element_type=jnp.float32)
+    k2 = jnp.dot(ar, bi - br, preferred_element_type=jnp.float32)
+    k3 = jnp.dot(ai, br + bi, preferred_element_type=jnp.float32)
+    cr = k1 - k3
+    ci = k1 + k2
+    probs = cr * cr + ci * ci
+    out_ref[:] = jnp.dot(probs, z_ref[:], preferred_element_type=jnp.float32)
+
+
+def _fused_forward(
+    ar: jnp.ndarray,
+    ai: jnp.ndarray,
+    bt_r: jnp.ndarray,
+    bt_i: jnp.ndarray,
+    z: jnp.ndarray,
+) -> jnp.ndarray:
+    """Padded, tiled pallas_call. a: (B, D); bt = U^T: (D, D); z: (D, n)."""
+    batch, dim = ar.shape
+    n_out = z.shape[-1]
+    dim_p = max(_LANES, ((dim + _LANES - 1) // _LANES) * _LANES)
+    n_p = max(_LANES, ((n_out + _LANES - 1) // _LANES) * _LANES)
+    tile_b = min(_TILE_B, max(8, ((batch + 7) // 8) * 8))
+    batch_p = ((batch + tile_b - 1) // tile_b) * tile_b
+
+    ar = _pad_to(_pad_to(ar, 0, batch_p), 1, dim_p)
+    ai = _pad_to(_pad_to(ai, 0, batch_p), 1, dim_p)
+    bt_r = _pad_to(_pad_to(bt_r, 0, dim_p), 1, dim_p)
+    bt_i = _pad_to(_pad_to(bt_i, 0, dim_p), 1, dim_p)
+    z = _pad_to(_pad_to(z, 0, dim_p), 1, n_p)
+
+    grid = (batch_p // tile_b,)
+    batch_spec = pl.BlockSpec((tile_b, dim_p), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    full = pl.BlockSpec((dim_p, dim_p), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    z_spec = pl.BlockSpec((dim_p, n_p), lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        _fused_kernel,
+        grid=grid,
+        in_specs=[batch_spec, batch_spec, full, full, z_spec],
+        out_specs=pl.BlockSpec((tile_b, n_p), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((batch_p, n_p), jnp.float32),
+        interpret=_interpret(),
+    )(ar, ai, bt_r, bt_i, z)
+    return out[:batch, :n_out]
+
+
+@jax.custom_vjp
+def _fused_expvals(ar, ai, bt_r, bt_i, z):
+    return _fused_forward(ar, ai, bt_r, bt_i, z)
+
+
+def _fused_fwd(ar, ai, bt_r, bt_i, z):
+    return _fused_forward(ar, ai, bt_r, bt_i, z), (ar, ai, bt_r, bt_i, z)
+
+
+def _fused_bwd(res, g):
+    """Backward in plain XLA: the heavy ops are matmuls either way.
+
+    With c = a @ B (complex), ev = (c_r^2 + c_i^2) @ z:
+      dprobs = g @ z^T;  dc_r = 2 c_r dprobs;  dc_i = 2 c_i dprobs;
+      da = dc @ conj(B)^T;  dB = conj(a)^T @ dc;  dz = probs^T @ g.
+    """
+    ar, ai, bt_r, bt_i, z = res
+    cr = ar @ bt_r - ai @ bt_i
+    ci = ar @ bt_i + ai @ bt_r
+    dprobs = g @ z.T
+    dcr = 2.0 * cr * dprobs
+    dci = 2.0 * ci * dprobs
+    dar = dcr @ bt_r.T + dci @ bt_i.T
+    dai = -dcr @ bt_i.T + dci @ bt_r.T
+    dbt_r = ar.T @ dcr + ai.T @ dci
+    dbt_i = -ai.T @ dcr + ar.T @ dci
+    dz = (cr * cr + ci * ci).T @ g
+    return dar, dai, dbt_r, dbt_i, dz
+
+
+_fused_expvals.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_unitary_expvals(psi: CArr, u: CArr, n_qubits: int) -> jnp.ndarray:
+    """``psi (..., 2^n) -> per-wire <Z> (..., n)`` through unitary ``u``.
+
+    Equivalent to ``expvals_z(psi @ u^T)`` of the XLA dense path
+    (:func:`qdml_tpu.quantum.circuits.run_circuit` with ``backend='dense'``)
+    but fused into one Pallas kernel per batch tile.
+    """
+    lead = psi.shape[:-1]
+    dim = psi.shape[-1]
+    ar = psi.re.reshape(-1, dim)
+    ai = psi.im.reshape(-1, dim)
+    z = jnp.asarray(sv.z_signs(n_qubits))
+    ev = _fused_expvals(ar, ai, u.re.T, u.im.T, z)
+    return ev.reshape(lead + (n_qubits,))
+
+
+# ---------------------------------------------------------------------------
+# Fused rotation-layer kernel (tensor path, larger n)
+# ---------------------------------------------------------------------------
+
+
+def _layer_kernel_body(ar_ref, ai_ref, cos_ref, sin_ref, or_ref, oi_ref, *, n: int):
+    """Apply one full rotation layer — RY(w[q,0]) then RZ(w[q,1]) on every
+    qubit q — to a (tile_b, 2^n) statevector block without leaving VMEM.
+
+    The XOR-partner exchange for qubit q (stride m = 2^(n-1-q) along the flat
+    amplitude axis) is built from two lane rolls plus an iota-mask select —
+    the Mosaic-friendly formulation (no lane-crossing reshapes): for a
+    position with qubit-bit 0 the partner sits at +m (roll by -m), for bit 1
+    at -m (roll by +m); circular wrap-around only ever lands on positions of
+    the opposite bit, which take the other branch.
+    """
+    ar, ai = ar_ref[:], ai_ref[:]
+    shape = ar.shape
+    lane = jax.lax.broadcasted_iota(jnp.int32, shape, dimension=1)
+    for q in range(n):
+        m = 1 << (n - 1 - q)
+        bit = (lane >> (n - 1 - q)) & 1
+        sgn = jnp.where(bit == 1, 1.0, -1.0).astype(jnp.float32)
+        # partner amplitudes (index XOR m); roll shift must be non-negative,
+        # so the -m roll is written as dim - m.
+        dim = shape[1]
+        pr = jnp.where(bit == 0, pltpu.roll(ar, dim - m, 1), pltpu.roll(ar, m, 1))
+        pi = jnp.where(bit == 0, pltpu.roll(ai, dim - m, 1), pltpu.roll(ai, m, 1))
+        # RY(t): [c, -s; s, c] (real): new = c*a + sgn*s*partner.
+        cy, sy = cos_ref[q, 0], sin_ref[q, 0]
+        br = cy * ar + sgn * sy * pr
+        bi = cy * ai + sgn * sy * pi
+        # RZ(p): diag(e^{-ip/2}, e^{+ip/2}) by bit: re' = c*re - sgn*s*im.
+        cz, sz = cos_ref[q, 1], sin_ref[q, 1]
+        ar = cz * br - sgn * sz * bi
+        ai = cz * bi + sgn * sz * br
+    or_ref[:] = ar
+    oi_ref[:] = ai
+
+
+def _xla_rotation_layer(ar: jnp.ndarray, ai: jnp.ndarray, weights_l: jnp.ndarray, n: int):
+    """XLA reference semantics of one rotation layer (used for the backward)."""
+    psi = CArr(ar, ai)
+    for q in range(n):
+        psi = sv.apply_ry(psi, n, q, weights_l[q, 0])
+        psi = sv.apply_rz(psi, n, q, weights_l[q, 1])
+    return psi.re, psi.im
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _rotation_layer(ar, ai, weights_l, n):
+    return _rotation_layer_pallas(ar, ai, weights_l, n)
+
+
+def _rotation_layer_fwd(ar, ai, weights_l, n):
+    return _rotation_layer_pallas(ar, ai, weights_l, n), (ar, ai, weights_l)
+
+
+def _rotation_layer_bwd(n, res, g):
+    """Backward by AD through the (mathematically identical) XLA layer —
+    forward stays fused in VMEM; the backward's gate chain is XLA's bread
+    and butter and reuses the residual inputs (rematerialisation)."""
+    ar, ai, weights_l = res
+    _, vjp = jax.vjp(lambda a, b, w: _xla_rotation_layer(a, b, w, n), ar, ai, weights_l)
+    return vjp(g)
+
+
+_rotation_layer.defvjp(_rotation_layer_fwd, _rotation_layer_bwd)
+
+
+def apply_rotation_layer(psi: CArr, weights_l: jnp.ndarray, n: int) -> CArr:
+    """One ansatz rotation layer (all qubits' RY+RZ) as a single fused kernel.
+
+    ``weights_l``: (n, 2) — per-qubit (RY, RZ) angles of one layer (the ring
+    CNOT that follows is a pure permutation, applied outside via
+    :func:`qdml_tpu.quantum.statevector.apply_perm`).
+    """
+    lead = psi.shape[:-1]
+    dim = psi.shape[-1]
+    assert dim == (1 << n)
+    re, im = _rotation_layer(psi.re.reshape(-1, dim), psi.im.reshape(-1, dim), weights_l, n)
+    return CArr(re.reshape(lead + (dim,)), im.reshape(lead + (dim,)))
+
+
+def _rotation_layer_pallas(ar: jnp.ndarray, ai: jnp.ndarray, weights_l: jnp.ndarray, n: int):
+    dim = 1 << n
+    batch = ar.shape[0]
+    tile_b = min(128, max(8, ((batch + 7) // 8) * 8))
+    batch_p = ((batch + tile_b - 1) // tile_b) * tile_b
+    ar = _pad_to(ar, 0, batch_p)
+    ai = _pad_to(ai, 0, batch_p)
+    cos = jnp.cos(weights_l / 2.0)
+    sin = jnp.sin(weights_l / 2.0)
+
+    spec = pl.BlockSpec((tile_b, dim), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    wspec = pl.BlockSpec((n, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    re, im = pl.pallas_call(
+        partial(_layer_kernel_body, n=n),
+        grid=(batch_p // tile_b,),
+        in_specs=[spec, spec, wspec, wspec],
+        out_specs=[spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch_p, dim), jnp.float32),
+            jax.ShapeDtypeStruct((batch_p, dim), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(ar, ai, cos, sin)
+    return re[:batch], im[:batch]

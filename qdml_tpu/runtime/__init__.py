@@ -1,0 +1,14 @@
+"""Native runtime components (C++ host-side IO, profiling hooks).
+
+The compute path of qdml_tpu is JAX/XLA/Pallas; this package holds the
+native-code runtime around it — the role the task's reference inventory
+assigns to "executors, schedulers, IO, memory management" (the reference
+itself is pure Python with a single-threaded host data path, SURVEY.md §0).
+"""
+
+from qdml_tpu.runtime.native_io import (  # noqa: F401
+    NativeNpyFile,
+    PrefetchPipeline,
+    gather_rows,
+    native_available,
+)

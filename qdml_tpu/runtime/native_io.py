@@ -1,0 +1,309 @@
+"""ctypes bindings for the native IO runtime (``native/qdml_io.cpp``).
+
+Provides three host-side primitives the reference's single-threaded torch
+DataLoader path (``Runner_P128_QuantumNAT_onchipQNN.py:24, 48-95``) lacks:
+
+- :class:`NativeNpyFile` — zero-copy mmap'd ``.npy`` access (header parsed in
+  C++, data exposed as a numpy view of the mapping; the OS page cache is the
+  buffer pool),
+- :func:`gather_rows` — multithreaded batch assembly from shuffled row
+  indices into one contiguous buffer,
+- :class:`PrefetchPipeline` — an async slot-ring: C++ worker threads fill the
+  next batches while the accelerator consumes the current one.
+
+The shared library is compiled on first use with ``g++`` (no pybind11 in this
+image — plain C ABI + ctypes). Every entry point degrades gracefully to a
+numpy implementation when the toolchain or the library is unavailable, so the
+framework never hard-depends on native code being buildable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Sequence
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "qdml_io.cpp")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+_DTYPES = {
+    ("f", 4): np.float32,
+    ("f", 8): np.float64,
+    ("c", 8): np.complex64,
+    ("c", 16): np.complex128,
+    ("i", 4): np.int32,
+    ("i", 8): np.int64,
+    ("u", 4): np.uint32,
+    ("u", 8): np.uint64,
+}
+
+
+def _build_lib() -> str | None:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    out_dir = os.environ.get("QDML_NATIVE_DIR") or os.path.join(
+        os.path.dirname(src)
+    )
+    out = os.path.join(out_dir, "libqdml_io.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        src, "-o", out,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        path = _build_lib()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.qdml_npy_open.restype = ctypes.c_void_p
+        lib.qdml_npy_open.argtypes = [ctypes.c_char_p]
+        lib.qdml_npy_info.restype = ctypes.c_int
+        lib.qdml_npy_info.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_char),
+        ]
+        lib.qdml_npy_data.restype = ctypes.c_void_p
+        lib.qdml_npy_data.argtypes = [ctypes.c_void_p]
+        lib.qdml_npy_close.argtypes = [ctypes.c_void_p]
+        lib.qdml_gather_rows.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long,
+            ctypes.c_void_p,
+            ctypes.c_int,
+        ]
+        lib.qdml_prefetch_create.restype = ctypes.c_void_p
+        lib.qdml_prefetch_create.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_long,
+            ctypes.c_int,
+            ctypes.c_long,
+            ctypes.c_int,
+        ]
+        lib.qdml_prefetch_submit.restype = ctypes.c_int
+        lib.qdml_prefetch_submit.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long,
+        ]
+        lib.qdml_prefetch_wait.restype = ctypes.c_int
+        lib.qdml_prefetch_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.qdml_prefetch_buffer.restype = ctypes.c_void_p
+        lib.qdml_prefetch_buffer.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.qdml_prefetch_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.qdml_prefetch_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    """True when the C++ library could be built and loaded."""
+    return _load() is not None
+
+
+class NativeNpyFile:
+    """mmap'd ``.npy`` file; ``.array`` is a zero-copy numpy view.
+
+    Falls back to ``np.load(mmap_mode='r')`` when the native library is
+    unavailable — same semantics, the C++ path just skips Python-level header
+    parsing and keeps the mapping under runtime control.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._handle = None
+        self._lib = _load()
+        if self._lib is not None:
+            h = self._lib.qdml_npy_open(path.encode())
+            if h:
+                self._handle = h
+                shape = (ctypes.c_long * 8)()
+                ndim = ctypes.c_int()
+                itemsize = ctypes.c_int()
+                tch = ctypes.c_char()
+                self._lib.qdml_npy_info(
+                    h, shape, ctypes.byref(ndim), ctypes.byref(itemsize), ctypes.byref(tch)
+                )
+                dtype = _DTYPES.get((tch.value.decode(), itemsize.value))
+                if dtype is None:
+                    self._lib.qdml_npy_close(h)
+                    self._handle = None
+                else:
+                    shp = tuple(shape[i] for i in range(ndim.value))
+                    n = int(np.prod(shp)) if shp else 1
+                    buf_t = ctypes.c_char * (n * itemsize.value)
+                    buf = buf_t.from_address(self._lib.qdml_npy_data(h))
+                    # The view's .base chain must keep THIS object (and so the
+                    # mapping) alive: a bare from_address buffer references the
+                    # raw pointer only, and letting the file be GC'd while the
+                    # array is reachable would be a use-after-munmap.
+                    buf._qdml_owner = self
+                    view = np.frombuffer(buf, dtype=dtype).reshape(shp)
+                    view.flags.writeable = False  # PROT_READ mapping
+                    self.array = view
+        if self._handle is None:
+            self.array = np.load(path, mmap_mode="r")
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    def close(self) -> None:
+        if self._handle is not None:
+            # Drop the numpy view before unmapping.
+            self.array = None
+            self._lib.qdml_npy_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def gather_rows(
+    src: np.ndarray, indices: Sequence[int] | np.ndarray, n_threads: int = 4
+) -> np.ndarray:
+    """Gather ``src[indices]`` into a fresh contiguous array, multithreaded in
+    C++ when available (releases the GIL for the whole copy)."""
+    src = np.ascontiguousarray(src) if not src.flags["C_CONTIGUOUS"] else src
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    lib = _load()
+    if lib is None:
+        return np.ascontiguousarray(src[idx])
+    row_shape = src.shape[1:]
+    row_bytes = int(np.prod(row_shape, dtype=np.int64)) * src.itemsize
+    out = np.empty((len(idx),) + row_shape, dtype=src.dtype)
+    lib.qdml_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p),
+        row_bytes,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(idx),
+        out.ctypes.data_as(ctypes.c_void_p),
+        int(n_threads),
+    )
+    return out
+
+
+class PrefetchPipeline:
+    """Async batch assembly over a row-major source array.
+
+    ``submit(indices)`` queues a batch fill on the C++ worker pool and returns
+    a ticket; ``get(ticket)`` blocks until that batch is ready and returns a
+    numpy view of the slot buffer (valid until ``release(ticket)``). With
+    ``n_slots >= 2`` the next batch fills while the current one is consumed.
+
+    Python-threads fallback keeps the same API when native code is absent.
+    """
+
+    def __init__(
+        self,
+        src: np.ndarray,
+        batch: int,
+        n_slots: int = 3,
+        n_threads: int = 4,
+    ):
+        assert src.flags["C_CONTIGUOUS"], "prefetch source must be C-contiguous"
+        self.src = src
+        self.batch = batch
+        self.row_shape = src.shape[1:]
+        self.row_bytes = int(np.prod(self.row_shape, dtype=np.int64)) * src.itemsize
+        self._lib = _load()
+        self._fallback: dict[int, np.ndarray] = {}
+        self._next_ticket = 0
+        if self._lib is not None:
+            self._handle = self._lib.qdml_prefetch_create(
+                src.ctypes.data_as(ctypes.c_void_p),
+                self.row_bytes,
+                int(n_slots),
+                int(batch),
+                int(n_threads),
+            )
+        else:
+            self._handle = None
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    def submit(self, indices: np.ndarray) -> int:
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        assert len(idx) <= self.batch
+        if self._handle is None:
+            t = self._next_ticket
+            self._next_ticket += 1
+            self._fallback[t] = np.ascontiguousarray(self.src[idx])
+            return t
+        slot = self._lib.qdml_prefetch_submit(
+            self._handle,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            len(idx),
+        )
+        if slot < 0:
+            raise RuntimeError(
+                "no free prefetch slot — release() consumed batches first"
+            )
+        self._counts = getattr(self, "_counts", {})
+        self._counts[slot] = len(idx)
+        return slot
+
+    def get(self, ticket: int) -> np.ndarray:
+        if self._handle is None:
+            return self._fallback[ticket]
+        self._lib.qdml_prefetch_wait(self._handle, ticket)
+        addr = self._lib.qdml_prefetch_buffer(self._handle, ticket)
+        n = self._counts[ticket]
+        buf_t = ctypes.c_char * (n * self.row_bytes)
+        buf = buf_t.from_address(addr)
+        return np.frombuffer(buf, dtype=self.src.dtype).reshape((n,) + self.row_shape)
+
+    def release(self, ticket: int) -> None:
+        if self._handle is None:
+            self._fallback.pop(ticket, None)
+        else:
+            self._lib.qdml_prefetch_release(self._handle, ticket)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.qdml_prefetch_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

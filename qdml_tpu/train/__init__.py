@@ -3,6 +3,12 @@ from qdml_tpu.train.checkpoint import (  # noqa: F401
     restore_checkpoint,
     save_checkpoint,
 )
+from qdml_tpu.train.dce import (  # noqa: F401
+    init_dce_state,
+    make_dce_eval_step,
+    make_dce_train_step,
+    train_dce,
+)
 from qdml_tpu.train.hdce import (  # noqa: F401
     HDCE,
     cell_nmse,

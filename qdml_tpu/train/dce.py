@@ -1,0 +1,125 @@
+"""DCE training: the monolithic (non-hierarchical) direct channel estimator.
+
+The reference defines ``DCE_P128`` (``Estimators_QuantumNAT_onchipQNN.py:40-75``)
+— a single Conv trunk + linear head with no per-scenario branching — as the
+baseline the hierarchical HDCE design improves on. Its snapshot ships no
+training loop for it (the shipped runner trains only Conv/FC and QSC), so this
+module provides one with the same hyperparameters as the HDCE loop
+(``Runner_P128_QuantumNAT_onchipQNN.py:20-46``): one jitted step over the
+flattened 3x3 grid batch, Adam + halving LR schedule, best/last checkpoints.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import DMLGridLoader
+from qdml_tpu.models.cnn import DCEP128
+from qdml_tpu.models.losses import nmse_loss
+from qdml_tpu.train.checkpoint import save_checkpoint
+from qdml_tpu.train.optim import get_optimizer
+from qdml_tpu.train.state import TrainState
+from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
+
+
+def make_dce_train_step(model: DCEP128) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+        label = batch["h_label"].reshape(x.shape[0], -1)
+        perf = batch["h_perf"].reshape(x.shape[0], -1)
+
+        def loss_fn(params):
+            pred, upd = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = nmse_loss(pred, label)
+            return loss, (upd["batch_stats"], nmse_loss(pred, perf))
+
+        (loss, (new_stats, loss_perf)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        state = state.apply_gradients(grads=grads)
+        state = state.replace(batch_stats=new_stats)
+        return state, {"loss": loss, "loss_perf": loss_perf}
+
+    return step
+
+
+def make_dce_eval_step(model: DCEP128) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: dict) -> dict:
+        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+        label = batch["h_label"].reshape(x.shape[0], -1)
+        pred = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats}, x, train=False
+        )
+        return {"err": jnp.sum((pred - label) ** 2), "pow": jnp.sum(label**2)}
+
+    return step
+
+
+def init_dce_state(cfg: ExperimentConfig, steps_per_epoch: int):
+    model = DCEP128(features=cfg.model.features, out_dim=cfg.model.h_out_dim)
+    dummy = jnp.zeros((2, *cfg.model.image_hw, 2), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)
+    tx = get_optimizer(cfg.train, steps_per_epoch)
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        tx=tx,
+        batch_stats=variables["batch_stats"],
+    )
+    return model, state
+
+
+def train_dce(
+    cfg: ExperimentConfig,
+    logger: MetricsLogger | None = None,
+    workdir: str | None = None,
+) -> tuple[TrainState, dict]:
+    """Train the monolithic DCE baseline over the same DML data grid."""
+    logger = logger or MetricsLogger(echo=False)
+    geom = ChannelGeometry.from_config(cfg.data)
+    train_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "train", geom)
+    val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
+    model, state = init_dce_state(cfg, train_loader.steps_per_epoch)
+    train_step = make_dce_train_step(model)
+    eval_step = make_dce_eval_step(model)
+
+    history: dict[str, list] = {"train_loss": [], "val_nmse": []}
+    best = float("inf")
+    for epoch in range(cfg.train.n_epochs):
+        tot, n = 0.0, 0
+        for batch in train_loader.epoch(epoch):
+            state, m = train_step(state, batch)
+            tot, n = tot + float(m["loss"]), n + 1
+        train_loss = tot / max(n, 1)
+
+        sums = {"err": 0.0, "pow": 0.0}
+        for batch in val_loader.epoch(epoch, shuffle=False):
+            out = eval_step(state, batch)
+            for k in sums:
+                sums[k] += float(out[k])
+        val_nmse = sums["err"] / max(sums["pow"], 1e-30)
+        history["train_loss"].append(train_loss)
+        history["val_nmse"].append(val_nmse)
+        logger.log(
+            epoch=epoch, train_loss=train_loss, val_nmse=val_nmse, val_nmse_db=nmse_db(val_nmse)
+        )
+        if workdir is not None:
+            payload = {"params": state.params, "batch_stats": state.batch_stats}
+            meta = {"epoch": epoch, "val_nmse": val_nmse, "name": cfg.name}
+            if val_nmse < best:
+                best = val_nmse
+                save_checkpoint(workdir, "dce_best", payload, meta)
+            save_checkpoint(workdir, "dce_last", payload, meta)
+    return state, history

@@ -1,0 +1,86 @@
+"""Tracing / profiling hooks (reference has none — SURVEY.md §5.1).
+
+The reference's only instrumentation is coarse wall-clock prints
+(``Runner_P128_QuantumNAT_onchipQNN.py:171-173, 437-440``). Here:
+
+- :func:`trace` — context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable trace of device execution (XLA ops, fusion, HBM),
+- :class:`StepTimer` — steady-state step timing with correct semantics for
+  tunnelled backends (forces a host transfer; ``block_until_ready`` alone
+  does not flush execution through the axon tunnel), reporting
+  samples/sec/chip — the BASELINE.json north-star metric.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """``with trace('/tmp/trace'):`` — profile the enclosed device work."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def force(x) -> float:
+    """Force execution and return a host scalar from an array pytree leaf."""
+    leaf = jax.tree.leaves(x)[0]
+    return float(leaf.reshape(-1)[0])
+
+
+class StepTimer:
+    """Throughput measurement: ``warmup`` untimed steps (compile + ramp),
+    then timed steps with a final host sync.
+
+    >>> timer = StepTimer(warmup=3)
+    >>> for _ in range(50):
+    ...     out = step(...)
+    ...     timer.tick(out)
+    >>> timer.samples_per_sec(batch_size)
+    """
+
+    def __init__(self, warmup: int = 3):
+        self.warmup = warmup
+        self._seen = 0
+        self._t0: float | None = time.perf_counter() if warmup == 0 else None
+        self._steps = 0
+        self._last = None
+        self._frozen: float | None = None
+
+    def tick(self, out=None) -> None:
+        self._seen += 1
+        self._last = out
+        self._frozen = None
+        if self._seen == self.warmup:
+            if out is not None:
+                force(out)  # drain the pipeline before starting the clock
+            self._t0 = time.perf_counter()
+        elif self._seen > self.warmup:
+            self._steps += 1
+
+    def elapsed(self) -> float:
+        """Seconds over the timed steps; frozen at the first call after the
+        last tick (so repeated reads agree)."""
+        if self._t0 is None:
+            return 0.0
+        if self._frozen is None:
+            if self._last is not None:
+                force(self._last)  # final sync
+                self._last = None
+            self._frozen = time.perf_counter() - self._t0
+        return self._frozen
+
+    def steps_per_sec(self) -> float:
+        dt = self.elapsed()
+        return self._steps / dt if dt > 0 else 0.0
+
+    def samples_per_sec(self, batch_size: int) -> float:
+        return self.steps_per_sec() * batch_size

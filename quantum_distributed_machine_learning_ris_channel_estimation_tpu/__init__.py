@@ -14,6 +14,7 @@ from qdml_tpu import (  # noqa: F401
     ops,
     parallel,
     quantum,
+    runtime,
     train,
     utils,
 )

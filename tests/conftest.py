@@ -15,3 +15,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite is dominated by XLA CPU compiles of
+# the same jitted steps across test files; caching them on disk makes repeat
+# runs fast without changing any test semantics.
+jax.config.update("jax_compilation_cache_dir", "/tmp/qdml_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -1,0 +1,32 @@
+"""DCE monolithic-baseline training path (reference ``DCE_P128``,
+``Estimators_QuantumNAT_onchipQNN.py:40-75``)."""
+
+import numpy as np
+
+from qdml_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+from qdml_tpu.train.dce import train_dce
+
+
+def test_dce_trains_and_loss_decreases(tmp_path):
+    cfg = ExperimentConfig(
+        data=DataConfig(data_len=128),
+        train=TrainConfig(batch_size=16, n_epochs=3),
+    )
+    state, history = train_dce(cfg, workdir=str(tmp_path))
+    assert len(history["train_loss"]) == 3
+    assert np.isfinite(history["train_loss"]).all()
+    assert history["train_loss"][-1] < history["train_loss"][0]
+    assert (tmp_path / "dce_best").is_dir()
+    assert (tmp_path / "dce_last").is_dir()
+
+
+def test_step_timer():
+    from qdml_tpu.utils.profiling import StepTimer
+
+    import jax.numpy as jnp
+
+    timer = StepTimer(warmup=2)
+    for i in range(6):
+        timer.tick(jnp.ones((2,)) * i)
+    assert timer.steps_per_sec() > 0
+    assert timer.samples_per_sec(32) == timer.steps_per_sec() * 32

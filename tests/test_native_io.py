@@ -1,0 +1,168 @@
+"""Native C++ IO runtime: npy mmap, threaded gather, prefetch pipeline,
+and the npy-cache grid loader built on them.
+
+All paths are validated against plain numpy; the numpy fallback keeps these
+tests meaningful even where the toolchain is unavailable (is_native is then
+asserted False, not skipped silently).
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from qdml_tpu.runtime import (
+    NativeNpyFile,
+    PrefetchPipeline,
+    gather_rows,
+    native_available,
+)
+
+HAVE_GXX = shutil.which("g++") is not None
+
+
+def test_native_builds_when_toolchain_present():
+    if HAVE_GXX:
+        assert native_available(), "g++ present but native build failed"
+
+
+@pytest.mark.parametrize(
+    "dtype,shape",
+    [(np.float32, (37, 16)), (np.complex64, (21, 8)), (np.int64, (11,)), (np.float64, (5, 3, 4))],
+)
+def test_npy_open_matches_numpy(tmp_path, dtype, shape):
+    rng = np.random.default_rng(0)
+    arr = rng.standard_normal(shape).astype(dtype)
+    path = str(tmp_path / "a.npy")
+    np.save(path, arr)
+    with NativeNpyFile(path) as f:
+        assert f.is_native == native_available()
+        np.testing.assert_array_equal(np.asarray(f.array), arr)
+
+
+def test_npy_open_large_header_v2(tmp_path):
+    # forces a v2 header via a long dtype-irrelevant shape tuple edge: big 1-d
+    arr = np.arange(1000, dtype=np.float32).reshape(100, 10)
+    path = str(tmp_path / "b.npy")
+    np.save(path, arr)
+    with NativeNpyFile(path) as f:
+        np.testing.assert_array_equal(np.asarray(f.array), arr)
+
+
+@pytest.mark.parametrize("n_threads", [1, 4])
+def test_gather_rows_matches_fancy_indexing(n_threads):
+    rng = np.random.default_rng(1)
+    src = rng.standard_normal((500, 33)).astype(np.float32)
+    idx = rng.integers(0, 500, size=301)
+    out = gather_rows(src, idx, n_threads=n_threads)
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_gather_rows_complex():
+    rng = np.random.default_rng(2)
+    src = (rng.standard_normal((64, 17)) + 1j * rng.standard_normal((64, 17))).astype(
+        np.complex64
+    )
+    idx = rng.permutation(64)
+    np.testing.assert_array_equal(gather_rows(src, idx), src[idx])
+
+
+def test_prefetch_pipeline_roundtrip():
+    rng = np.random.default_rng(3)
+    src = rng.standard_normal((256, 24)).astype(np.float32)
+    pipe = PrefetchPipeline(src, batch=32, n_slots=3, n_threads=2)
+    assert pipe.is_native == native_available()
+    batches = [rng.integers(0, 256, size=32) for _ in range(6)]
+    # pipelined: keep two in flight
+    tickets = [pipe.submit(batches[0]), pipe.submit(batches[1])]
+    for i in range(2, len(batches) + 2):
+        t = tickets.pop(0)
+        got = pipe.get(t)
+        np.testing.assert_array_equal(got.copy(), src[batches[i - 2]])
+        pipe.release(t)
+        if i < len(batches):
+            tickets.append(pipe.submit(batches[i]))
+    pipe.close()
+
+
+def test_prefetch_partial_batch():
+    src = np.arange(100, dtype=np.float32).reshape(50, 2)
+    pipe = PrefetchPipeline(src, batch=16, n_slots=2)
+    t = pipe.submit(np.array([3, 1, 4]))
+    got = pipe.get(t)
+    np.testing.assert_array_equal(got, src[[3, 1, 4]])
+    pipe.release(t)
+    pipe.close()
+
+
+def test_npy_grid_loader_early_break_and_error(tmp_path):
+    """Abandoning the epoch mid-way must not leave the producer thread stuck,
+    and assembly errors must surface instead of hanging the consumer."""
+    import threading
+
+    from qdml_tpu.config import DataConfig
+    from qdml_tpu.data.datasets import NpyGridLoader, save_npy_cache
+
+    cfg = DataConfig(data_len=40)
+    save_npy_cache(str(tmp_path), cfg, chunk=16)
+    loader = NpyGridLoader(str(tmp_path), cfg, batch_size=4)
+    before = threading.active_count()
+    for _ in range(3):
+        for _batch in loader.epoch(0):
+            break  # abandon immediately
+    assert threading.active_count() <= before + 1  # producers wound down
+
+    # error propagation: poison the assembler
+    def boom(idx):
+        raise RuntimeError("bad row")
+
+    loader._assemble = boom
+    with pytest.raises(RuntimeError, match="bad row"):
+        for _batch in loader.epoch(1):
+            pass
+    loader.close()
+
+
+def test_step_timer_zero_warmup():
+    from qdml_tpu.utils.profiling import StepTimer
+
+    timer = StepTimer(warmup=0)
+    for _ in range(3):
+        timer.tick()
+    assert timer.steps_per_sec() > 0
+
+
+def test_native_npy_view_outlives_file_object(tmp_path):
+    """The array view must keep the mapping alive (no use-after-munmap)."""
+    import gc
+
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    path = str(tmp_path / "c.npy")
+    np.save(path, arr)
+    view = NativeNpyFile(path).array  # file object immediately unreferenced
+    gc.collect()
+    np.testing.assert_array_equal(np.asarray(view), arr)  # must not crash
+    if native_available():
+        assert not view.flags.writeable
+
+
+def test_npy_grid_loader_matches_synthetic(tmp_path):
+    """NpyGridLoader over a materialised cache == DMLGridLoader on-device."""
+    from qdml_tpu.config import DataConfig
+    from qdml_tpu.data.datasets import DMLGridLoader, NpyGridLoader, save_npy_cache
+
+    cfg = DataConfig(data_len=40)
+    save_npy_cache(str(tmp_path), cfg, chunk=16)
+    ref_loader = DMLGridLoader(cfg, batch_size=8)
+    npy_loader = NpyGridLoader(str(tmp_path), cfg, batch_size=8)
+    assert npy_loader.steps_per_epoch == ref_loader.steps_per_epoch
+
+    ref_batches = list(ref_loader.epoch(0, shuffle=False))
+    npy_batches = list(npy_loader.epoch(0, shuffle=False))
+    assert len(npy_batches) == len(ref_batches)
+    for rb, nb in zip(ref_batches, npy_batches):
+        for key in ("yp_img", "h_label", "h_perf", "indicator"):
+            np.testing.assert_allclose(
+                np.asarray(nb[key]), np.asarray(rb[key]), rtol=1e-5, atol=1e-6
+            )
+    npy_loader.close()
