@@ -7,6 +7,7 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli train-dce  [...]      # monolithic (non-HDCE) baseline
     python -m qdml_tpu.cli train-sc   [...]      # classical scenario classifier
     python -m qdml_tpu.cli train-qsc  [...]      # quantum scenario classifier
+    python -m qdml_tpu.cli nat-sweep  [...]      # vmapped QuantumNAT noise-level ensemble
     python -m qdml_tpu.cli eval       [...]      # SNR sweep + plots + JSON
     python -m qdml_tpu.cli gen-data --out=DIR    # materialise .npy cache
 
@@ -58,6 +59,12 @@ def main(argv: list[str] | None = None) -> int:
         from qdml_tpu.train.qsc import train_classifier
 
         train_classifier(cfg, quantum=(cmd == "train-qsc"), logger=logger, workdir=workdir)
+    elif cmd == "nat-sweep":
+        from qdml_tpu.train.nat_sweep import train_nat_sweep
+
+        train_nat_sweep(
+            cfg, noise_levels=cfg.quantum.noise_sweep, logger=logger, workdir=workdir
+        )
     elif cmd == "eval":
         from qdml_tpu.eval.report import create_comparison_plots, save_results_json
         from qdml_tpu.eval.sweep import run_snr_sweep

@@ -78,6 +78,8 @@ class QuantumConfig:
     use_gradient_pruning: bool = False
     noise_level: float = 0.01         # QuantumNAT sigma (Estimators...py:118)
     gradient_threshold: float = 0.1   # on-chip-QNN pruning threshold (Estimators...py:119)
+    # QuantumNAT sigma grid for the vmapped noise-sweep ensemble (config 5)
+    noise_sweep: tuple[float, ...] = (0.0, 0.01, 0.05, 0.1)
     # simulator backend: "dense" builds per-layer unitaries (MXU matmuls, best
     # for n<=10), "tensor" applies gates on the (2,)*n tensor (n<=14),
     # "sharded" partitions the statevector over the mesh (n>=14).

@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 
 
-def _dtype(name: str):
+def activation_dtype(name: str):
+    """ModelConfig.dtype string -> jnp dtype for module activations (params
+    always stay float32; bfloat16 activations feed the MXU fast path)."""
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
 
 

@@ -49,3 +49,47 @@ def restore_checkpoint(workdir: str, tag: str, target: Any | None = None) -> tup
 
 def has_checkpoint(workdir: str, tag: str) -> bool:
     return os.path.isdir(os.path.join(workdir, tag))
+
+
+# ---------------------------------------------------------------------------
+# Full-train-state save/resume
+# ---------------------------------------------------------------------------
+
+
+def train_state_payload(state: Any) -> dict:
+    """Everything needed to resume: params, optimizer state, step counter,
+    and (when present) BatchNorm running statistics."""
+    payload = {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "step": jax.numpy.asarray(state.step),
+    }
+    if getattr(state, "batch_stats", None) is not None:
+        payload["batch_stats"] = state.batch_stats
+    return payload
+
+
+def save_train_state(workdir: str, tag: str, state: Any, meta: dict | None = None) -> str:
+    return save_checkpoint(workdir, tag, train_state_payload(state), meta)
+
+
+def try_resume(workdir: str | None, tag: str, state: Any) -> tuple[Any, int, dict]:
+    """Restore a full TrainState from ``workdir/tag`` if present.
+
+    Returns ``(state, start_epoch, meta)`` — ``start_epoch`` is the epoch
+    AFTER the checkpointed one (0 when nothing to resume); ``meta`` carries
+    whatever the trainer persisted (e.g. the running best metric, so resumed
+    runs do not clobber a better ``*_best`` checkpoint). The reference cannot
+    resume at all (write-only checkpoints, SURVEY.md §5.4).
+    """
+    if workdir is None or not has_checkpoint(workdir, tag):
+        return state, 0, {}
+    restored, meta = restore_checkpoint(workdir, tag, train_state_payload(state))
+    state = state.replace(
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        step=int(restored["step"]),
+    )
+    if "batch_stats" in restored:
+        state = state.replace(batch_stats=restored["batch_stats"])
+    return state, int(meta.get("epoch", -1)) + 1, meta

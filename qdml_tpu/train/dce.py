@@ -19,9 +19,9 @@ import jax.numpy as jnp
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
 from qdml_tpu.data.datasets import DMLGridLoader
-from qdml_tpu.models.cnn import DCEP128
+from qdml_tpu.models.cnn import DCEP128, activation_dtype
 from qdml_tpu.models.losses import nmse_loss
-from qdml_tpu.train.checkpoint import save_checkpoint
+from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
@@ -68,7 +68,11 @@ def make_dce_eval_step(model: DCEP128) -> Callable:
 
 
 def init_dce_state(cfg: ExperimentConfig, steps_per_epoch: int):
-    model = DCEP128(features=cfg.model.features, out_dim=cfg.model.h_out_dim)
+    model = DCEP128(
+        features=cfg.model.features,
+        out_dim=cfg.model.h_out_dim,
+        dtype=activation_dtype(cfg.model.dtype),
+    )
     dummy = jnp.zeros((2, *cfg.model.image_hw, 2), jnp.float32)
     variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)
     tx = get_optimizer(cfg.train, steps_per_epoch)
@@ -95,9 +99,14 @@ def train_dce(
     train_step = make_dce_train_step(model)
     eval_step = make_dce_eval_step(model)
 
-    history: dict[str, list] = {"train_loss": [], "val_nmse": []}
+    start_epoch = 0
     best = float("inf")
-    for epoch in range(cfg.train.n_epochs):
+    if cfg.train.resume:
+        state, start_epoch, rmeta = try_resume(workdir, "dce_resume", state)
+        best = float(rmeta.get("best", best))
+
+    history: dict[str, list] = {"train_loss": [], "val_nmse": []}
+    for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
         for batch in train_loader.epoch(epoch):
             state, m = train_step(state, batch)
@@ -116,10 +125,17 @@ def train_dce(
             epoch=epoch, train_loss=train_loss, val_nmse=val_nmse, val_nmse_db=nmse_db(val_nmse)
         )
         if workdir is not None:
-            payload = {"params": state.params, "batch_stats": state.batch_stats}
             meta = {"epoch": epoch, "val_nmse": val_nmse, "name": cfg.name}
             if val_nmse < best:
                 best = val_nmse
+                payload = {"params": state.params, "batch_stats": state.batch_stats}
                 save_checkpoint(workdir, "dce_best", payload, meta)
-            save_checkpoint(workdir, "dce_last", payload, meta)
+            save_train_state(workdir, "dce_resume", state, {**meta, "best": best})
+    if workdir is not None:
+        save_checkpoint(
+            workdir,
+            "dce_last",
+            {"params": state.params, "batch_stats": state.batch_stats},
+            {"epoch": cfg.train.n_epochs - 1, "name": cfg.name},
+        )
     return state, history

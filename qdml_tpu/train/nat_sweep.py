@@ -1,0 +1,180 @@
+"""Noise-aware training sweep: a vmapped ensemble over QuantumNAT noise levels.
+
+BASELINE.json config 5 ("noise-aware training sweep batched over TPU hosts").
+The reference can only explore noise levels by re-running its trainer with a
+different ``noise_level`` kwarg (``Estimators_QuantumNAT_onchipQNN.py:118``) —
+one sequential GPU run per level. TPU-native: every noise level is an ensemble
+member with its own (params, optimizer state, PRNG stream); ONE jitted,
+``vmap``-ed train step advances all members simultaneously — the member axis
+batches the CNN convs and the circuit matmuls onto the MXU, and under a mesh
+the same axis shards over ``data`` devices (each device trains a slice of the
+ensemble: embarrassingly parallel, zero collectives).
+
+QuantumNAT semantics per member (SURVEY.md §3.4): the loss/gradient is taken
+at ``qweights + sigma * N(0,1)`` (noisy point) while optimizer state and
+params stay clean — :func:`qdml_tpu.ops.quantumnat.perturb` applied to the
+circuit-weight leaves only.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import DMLGridLoader
+from qdml_tpu.models.losses import accuracy, nll_loss
+from qdml_tpu.models.qsc import QSCP128
+from qdml_tpu.ops.quantumnat import perturb
+from qdml_tpu.train.checkpoint import save_checkpoint
+from qdml_tpu.train.optim import get_optimizer
+from qdml_tpu.utils.metrics import MetricsLogger
+
+
+def _is_qweight(path, _leaf) -> bool:
+    return any("qweights" in str(getattr(p, "key", p)) for p in path)
+
+
+def build_sweep_model(cfg: ExperimentConfig) -> QSCP128:
+    # Noise is injected externally (per-member sigma is a traced value; the
+    # module attribute would be static), so quantumnat is OFF in the module.
+    return QSCP128(
+        n_qubits=cfg.quantum.n_qubits,
+        n_layers=cfg.quantum.n_layers,
+        n_classes=cfg.quantum.n_classes,
+        use_quantumnat=False,
+        backend=cfg.quantum.backend,
+    )
+
+
+def init_sweep(cfg: ExperimentConfig, noise_levels: Sequence[float], steps_per_epoch: int):
+    """Stacked per-member params + optimizer states (leading ensemble axis)."""
+    import dataclasses
+
+    model = build_sweep_model(cfg)
+    # Same optimizer semantics as the single-model QSC trainer: AdamW
+    # (reference ``Runner...py:320``) plus the gradient-pruning transform when
+    # the quantum config requests it.
+    train_cfg = dataclasses.replace(cfg.train, optimizer="adamw")
+    tx = get_optimizer(train_cfg, steps_per_epoch, cfg.quantum)
+    dummy = jnp.zeros((2, *cfg.model.image_hw, 2), jnp.float32)
+
+    def init_one(key):
+        params = model.init(key, dummy, train=False)["params"]
+        return params, tx.init(params)
+
+    keys = jax.random.split(jax.random.PRNGKey(cfg.train.seed), len(noise_levels))
+    params, opt_state = jax.vmap(init_one)(keys)
+    sigmas = jnp.asarray(list(noise_levels), jnp.float32)
+    return model, tx, params, opt_state, sigmas
+
+
+def make_sweep_train_step(model: QSCP128, tx) -> Callable:
+    """jit(vmap(member step)): (E-stacked params/opt/rng/sigma, shared batch)."""
+
+    def member_step(params, opt_state, rng, sigma, x, labels):
+        def loss_fn(p):
+            noisy = perturb(p, rng, sigma, where=_is_qweight)
+            log_probs = model.apply({"params": noisy}, x, train=True)
+            return nll_loss(log_probs, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    vstep = jax.vmap(member_step, in_axes=(0, 0, 0, 0, None, None))
+
+    @jax.jit
+    def step(params, opt_state, rngs, sigmas, batch):
+        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+        labels = batch["indicator"].reshape(-1)
+        return vstep(params, opt_state, rngs, sigmas, x, labels)
+
+    return step
+
+
+def make_sweep_eval_step(model: QSCP128) -> Callable:
+    def member_eval(params, x, labels):
+        log_probs = model.apply({"params": params}, x, train=False)
+        return nll_loss(log_probs, labels), accuracy(log_probs, labels)
+
+    veval = jax.vmap(member_eval, in_axes=(0, None, None))
+
+    @jax.jit
+    def step(params, batch):
+        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+        labels = batch["indicator"].reshape(-1)
+        return veval(params, x, labels)
+
+    return step
+
+
+def train_nat_sweep(
+    cfg: ExperimentConfig,
+    noise_levels: Sequence[float] = (0.0, 0.01, 0.05, 0.1),
+    logger: MetricsLogger | None = None,
+    workdir: str | None = None,
+):
+    """Train one quantum classifier per noise level, all in one vmapped step.
+
+    Returns ``(params_stacked, history)`` where history holds per-member
+    per-epoch train loss / val loss / val accuracy arrays.
+    """
+    logger = logger or MetricsLogger(echo=False)
+    geom = ChannelGeometry.from_config(cfg.data)
+    train_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "train", geom)
+    val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
+    model, tx, params, opt_state, sigmas = init_sweep(
+        cfg, noise_levels, train_loader.steps_per_epoch
+    )
+    train_step = make_sweep_train_step(model, tx)
+    eval_step = make_sweep_eval_step(model)
+    n_members = len(noise_levels)
+
+    rng = jax.random.PRNGKey(cfg.train.seed + 101)
+    history = {"train_loss": [], "val_loss": [], "val_acc": []}
+    for epoch in range(cfg.train.n_epochs):
+        tot = np.zeros(n_members)
+        n = 0
+        for batch in train_loader.epoch(epoch):
+            rng, sub = jax.random.split(rng)
+            rngs = jax.random.split(sub, n_members)
+            params, opt_state, losses = train_step(params, opt_state, rngs, sigmas, batch)
+            tot += np.asarray(losses)
+            n += 1
+        train_loss = tot / max(n, 1)
+
+        vloss = np.zeros(n_members)
+        vacc = np.zeros(n_members)
+        vn = 0
+        for batch in val_loader.epoch(epoch, shuffle=False):
+            losses, accs = eval_step(params, batch)
+            vloss += np.asarray(losses)
+            vacc += np.asarray(accs)
+            vn += 1
+        vloss /= max(vn, 1)
+        vacc /= max(vn, 1)
+        history["train_loss"].append(train_loss)
+        history["val_loss"].append(vloss)
+        history["val_acc"].append(vacc)
+        logger.log(
+            epoch=epoch,
+            **{
+                f"val_acc_sigma{s:g}": float(a)
+                for s, a in zip(noise_levels, vacc)
+            },
+        )
+    if workdir is not None:
+        save_checkpoint(
+            workdir,
+            "nat_sweep_last",
+            {"params": params},
+            {"noise_levels": list(map(float, noise_levels)), "name": cfg.name},
+        )
+    return params, history

@@ -26,7 +26,7 @@ from qdml_tpu.data.datasets import DMLGridLoader
 from qdml_tpu.models.cnn import SCP128
 from qdml_tpu.models.losses import nll_loss
 from qdml_tpu.models.qsc import QSCP128
-from qdml_tpu.train.checkpoint import save_checkpoint
+from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger
@@ -112,10 +112,17 @@ def train_classifier(
     eval_step = make_sc_eval_step(model)
     tag = "qsc" if quantum else "sc"
 
-    rng = jax.random.PRNGKey(cfg.train.seed + 1)
-    history: dict[str, list] = {"train_loss": [], "val_loss": [], "val_acc": []}
+    start_epoch = 0
     best_acc = -1.0
-    for epoch in range(cfg.train.n_epochs):
+    if cfg.train.resume:
+        state, start_epoch, rmeta = try_resume(workdir, f"{tag}_resume", state)
+        best_acc = float(rmeta.get("best", best_acc))
+
+    # Fold the start epoch into the QuantumNAT noise stream so resumed epochs
+    # draw FRESH noise instead of replaying epochs 0..start_epoch-1's draws.
+    rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), start_epoch)
+    history: dict[str, list] = {"train_loss": [], "val_loss": [], "val_acc": []}
+    for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
         for batch in train_loader.epoch(epoch):
             rng, sub = jax.random.split(rng)
@@ -136,10 +143,16 @@ def train_classifier(
         logger.log(epoch=epoch, train_loss=train_loss, val_loss=val_loss, val_acc=val_acc)
 
         if workdir is not None:
-            payload = {"params": state.params}
             meta = {"epoch": epoch, "val_acc": val_acc, "name": cfg.name}
             if val_acc > best_acc:
                 best_acc = val_acc
-                save_checkpoint(workdir, f"{tag}_best", payload, meta)
-            save_checkpoint(workdir, f"{tag}_last", payload, meta)
+                save_checkpoint(workdir, f"{tag}_best", {"params": state.params}, meta)
+            save_train_state(workdir, f"{tag}_resume", state, {**meta, "best": best_acc})
+    if workdir is not None:
+        save_checkpoint(
+            workdir,
+            f"{tag}_last",
+            {"params": state.params},
+            {"epoch": cfg.train.n_epochs - 1, "name": cfg.name},
+        )
     return state, history

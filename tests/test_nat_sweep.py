@@ -1,0 +1,99 @@
+"""Vmapped QuantumNAT noise-level ensemble (BASELINE.json config 5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.config import DataConfig, ExperimentConfig, QuantumConfig, TrainConfig
+from qdml_tpu.train.nat_sweep import (
+    init_sweep,
+    make_sweep_train_step,
+    train_nat_sweep,
+)
+
+
+def _cfg(n_epochs=1):
+    return ExperimentConfig(
+        data=DataConfig(data_len=64),
+        quantum=QuantumConfig(n_qubits=4, n_layers=2),
+        train=TrainConfig(batch_size=16, n_epochs=n_epochs),
+    )
+
+
+def test_zero_noise_member_matches_plain_qsc_step():
+    """Ensemble member with sigma=0 must evolve exactly like an unperturbed
+    single-model step (same seed, same data)."""
+    cfg = _cfg()
+    from qdml_tpu.data.datasets import DMLGridLoader
+
+    loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
+    batch = next(iter(loader.epoch(0)))
+
+    model, tx, params, opt_state, sigmas = init_sweep(cfg, [0.0, 0.1], loader.steps_per_epoch)
+    step = make_sweep_train_step(model, tx)
+    rngs = jax.random.split(jax.random.PRNGKey(7), 2)
+    new_params, _, losses = step(params, opt_state, rngs, sigmas, batch)
+
+    # independent plain step on member 0's params
+    import optax
+
+    from qdml_tpu.models.losses import nll_loss
+
+    p0 = jax.tree.map(lambda x: x[0], params)
+    x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+    labels = batch["indicator"].reshape(-1)
+
+    def loss_fn(p):
+        return nll_loss(model.apply({"params": p}, x, train=False), labels)
+
+    loss0, grads = jax.value_and_grad(loss_fn)(p0)
+    updates, _ = tx.update(grads, tx.init(p0), p0)
+    want = optax.apply_updates(p0, updates)
+    np.testing.assert_allclose(float(losses[0]), float(loss0), rtol=1e-5)
+    # Adam's first-step update is lr * g/(sqrt(g^2)+eps): for near-zero
+    # gradient elements this is numerically ill-conditioned, so vmapped vs
+    # plain execution can differ by up to the update scale (lr=1e-3) on
+    # isolated elements — compare at that granularity.
+    for la, lb in zip(
+        jax.tree.leaves(jax.tree.map(lambda x: x[0], new_params)), jax.tree.leaves(want)
+    ):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-3, atol=2e-3)
+
+
+def test_noise_perturbs_only_qweights():
+    """Nonzero sigma changes the loss only through the circuit weights; the
+    two members start from different seeds so just check both train finitely
+    and the sigma=0.5 member sees a different loss than sigma=0 with SAME
+    params."""
+    cfg = _cfg()
+    from qdml_tpu.data.datasets import DMLGridLoader
+
+    loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
+    batch = next(iter(loader.epoch(0)))
+    model, tx, params, opt_state, _ = init_sweep(cfg, [0.0, 0.5], loader.steps_per_epoch)
+    # share member 0's params across both members
+    shared = jax.tree.map(lambda x: jnp.stack([x[0], x[0]]), params)
+    shared_opt = jax.tree.map(
+        lambda x: jnp.stack([x[0], x[0]]) if hasattr(x, "ndim") and x.ndim > 0 else x,
+        opt_state,
+    )
+    step = make_sweep_train_step(model, tx)
+    rng = jax.random.split(jax.random.PRNGKey(3), 2)
+    rng = jnp.stack([rng[0], rng[0]])  # same noise draw for both
+    _, _, losses = step(shared, shared_opt, rng, jnp.asarray([0.0, 0.5]), batch)
+    assert abs(float(losses[0]) - float(losses[1])) > 1e-6
+
+
+def test_train_nat_sweep_end_to_end(tmp_path):
+    cfg = _cfg(n_epochs=2)
+    params, history = train_nat_sweep(
+        cfg, noise_levels=(0.0, 0.05), workdir=str(tmp_path)
+    )
+    assert len(history["train_loss"]) == 2
+    assert history["train_loss"][0].shape == (2,)
+    assert np.isfinite(history["train_loss"][-1]).all()
+    assert np.isfinite(history["val_acc"][-1]).all()
+    # stacked params carry the ensemble axis
+    leaf = jax.tree.leaves(params)[0]
+    assert leaf.shape[0] == 2
+    assert (tmp_path / "nat_sweep_last").is_dir()

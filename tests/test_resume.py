@@ -1,0 +1,89 @@
+"""Checkpoint/resume: split training must reproduce uninterrupted training.
+
+The reference's checkpoints are write-only (no resume path at all,
+SURVEY.md §5.4); here the full TrainState (params + optimizer moments + step)
+round-trips through orbax, so 2+2 resumed epochs equal 4 straight epochs
+bit-for-bit (data shuffling is deterministic per (seed, epoch)).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from qdml_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+from qdml_tpu.train.dce import train_dce
+from qdml_tpu.train.hdce import train_hdce
+from qdml_tpu.train.qsc import train_classifier
+
+
+def _cfg(n_epochs: int, resume: bool = False) -> ExperimentConfig:
+    return ExperimentConfig(
+        data=DataConfig(data_len=96),
+        train=TrainConfig(batch_size=16, n_epochs=n_epochs, resume=resume),
+    )
+
+
+def _assert_trees_close(a, b, rtol=1e-5, atol=1e-6):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=rtol, atol=atol)
+
+
+def test_hdce_resume_matches_straight_run(tmp_path):
+    straight, _ = train_hdce(_cfg(4), workdir=str(tmp_path / "straight"))
+
+    wd = str(tmp_path / "split")
+    train_hdce(_cfg(2), workdir=wd)
+    resumed, hist = train_hdce(_cfg(4, resume=True), workdir=wd)
+    assert len(hist["train_loss"]) == 2  # epochs 2..3 only
+    assert int(resumed.step) == int(straight.step)
+    _assert_trees_close(resumed.params, straight.params)
+    _assert_trees_close(resumed.batch_stats, straight.batch_stats)
+
+
+def test_sc_resume_matches_straight_run(tmp_path):
+    straight, _ = train_classifier(_cfg(4), quantum=False, workdir=str(tmp_path / "s"))
+    wd = str(tmp_path / "r")
+    train_classifier(_cfg(2), quantum=False, workdir=wd)
+    resumed, hist = train_classifier(_cfg(4, resume=True), quantum=False, workdir=wd)
+    assert len(hist["train_loss"]) == 2
+    _assert_trees_close(resumed.params, straight.params)
+
+
+def test_dce_resume_continues(tmp_path):
+    wd = str(tmp_path)
+    _, h1 = train_dce(_cfg(2), workdir=wd)
+    resumed, h2 = train_dce(_cfg(3, resume=True), workdir=wd)
+    assert len(h2["train_loss"]) == 1  # only epoch 2 runs
+    steps_per_epoch = int(96 * 0.9) // 16
+    assert int(resumed.step) == 3 * steps_per_epoch
+
+
+def test_resume_does_not_clobber_better_best(tmp_path):
+    """The running best metric persists in the resume meta; a resumed run with
+    worse validation must NOT overwrite the *_best checkpoint."""
+    import json
+
+    wd = str(tmp_path)
+    train_dce(_cfg(2), workdir=wd)
+    with open(wd + "/dce_resume.meta.json") as fh:
+        meta = json.load(fh)
+    assert "best" in meta
+
+    # Pretend an earlier run achieved an unbeatable best.
+    meta["best"] = 1e-9
+    with open(wd + "/dce_resume.meta.json", "w") as fh:
+        json.dump(meta, fh)
+    with open(wd + "/dce_best.meta.json") as fh:
+        best_meta_before = json.load(fh)
+
+    train_dce(_cfg(3, resume=True), workdir=wd)
+    with open(wd + "/dce_best.meta.json") as fh:
+        best_meta_after = json.load(fh)
+    assert best_meta_after == best_meta_before  # untouched
+
+
+def test_resume_without_checkpoint_starts_fresh(tmp_path):
+    cfg = _cfg(1, resume=True)
+    _, hist = train_dce(cfg, workdir=str(tmp_path / "empty"))
+    assert len(hist["train_loss"]) == 1

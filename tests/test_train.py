@@ -104,3 +104,25 @@ def test_checkpoint_best_last_and_restore(tmp_path):
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-6)
     assert (tmp_path / "hdce_best").is_dir()
+
+
+def test_hdce_bf16_activation_path():
+    """ModelConfig.dtype='bfloat16' runs the MXU fast path; params stay f32."""
+    import jax
+
+    from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
+    from qdml_tpu.data.datasets import DMLGridLoader
+    from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
+
+    cfg = ExperimentConfig(
+        data=DataConfig(data_len=64),
+        model=ModelConfig(dtype="bfloat16"),
+        train=TrainConfig(batch_size=8, n_epochs=1),
+    )
+    loader = DMLGridLoader(cfg.data, 8)
+    batch = next(iter(loader.epoch(0)))
+    model, state = init_hdce_state(cfg, loader.steps_per_epoch)
+    step = make_hdce_train_step(model, state.tx)
+    state, m = step(state, batch)
+    assert float(m["loss"]) > 0 and float(m["loss"]) < 1e4
+    assert all(l.dtype == "float32" for l in jax.tree.leaves(state.params))
