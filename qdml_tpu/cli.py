@@ -10,6 +10,8 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli nat-sweep  [...]      # vmapped QuantumNAT noise-level ensemble
     python -m qdml_tpu.cli eval       [...]      # SNR sweep + plots + JSON
     python -m qdml_tpu.cli gen-data --out=DIR    # materialise .npy cache
+    python -m qdml_tpu.cli import-torch --out=SRCDIR  # reference .pth -> orbax
+    python -m qdml_tpu.cli export-torch --out=DSTDIR  # orbax -> reference .pth
 
 Dotted-path overrides map onto :mod:`qdml_tpu.config` dataclasses; presets are
 the five BASELINE.json benchmark configs.
@@ -85,6 +87,33 @@ def main(argv: list[str] | None = None) -> int:
         out = next((e.split("=", 1)[1] for e in extra), "available_data")
         save_npy_cache(out, cfg.data)
         print(f"wrote npy cache to {out}")
+    elif cmd == "import-torch":
+        from qdml_tpu.train.checkpoint import save_checkpoint
+        from qdml_tpu.train.torch_interop import import_reference_dir
+
+        src = next((e.split("=", 1)[1] for e in extra), ".")
+        trees = import_reference_dir(
+            src, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db)
+        )
+        for name, tree in trees.items():
+            save_checkpoint(workdir, f"{name}_best", tree, {"source": src})
+        print(f"imported {sorted(trees)} from {src} -> {workdir}")
+    elif cmd == "export-torch":
+        from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint
+        from qdml_tpu.train.torch_interop import export_reference_dir
+
+        out = next((e.split("=", 1)[1] for e in extra), "torch_ckpts")
+        kwargs = {}
+        if has_checkpoint(workdir, "hdce_best"):
+            kwargs["hdce_vars"], _ = restore_checkpoint(workdir, "hdce_best")
+        if has_checkpoint(workdir, "sc_best"):
+            kwargs["sc_params"] = restore_checkpoint(workdir, "sc_best")[0]["params"]
+        if has_checkpoint(workdir, "qsc_best"):
+            kwargs["qsc_params"] = restore_checkpoint(workdir, "qsc_best")[0]["params"]
+        written = export_reference_dir(
+            out, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db), **kwargs
+        )
+        print("wrote:\n  " + "\n  ".join(written))
     else:
         print(f"unknown command {cmd!r}")
         return 2

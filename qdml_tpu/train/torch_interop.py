@@ -1,0 +1,379 @@
+"""Reference (PyTorch) checkpoint interop: import/export ``.pth`` state dicts.
+
+A user of the reference repo has checkpoints saved by ``torch.save`` under
+filename-encoded names (``Runner_P128_QuantumNAT_onchipQNN.py:237-266,
+417-426``) in one of three dict formats, possibly with DataParallel
+``module.`` prefixes (the loader quirks live in ``Test.py:23-62``). This
+module converts those state dicts to/from the Flax variable trees of the
+equivalent qdml_tpu models so trained weights move across frameworks in both
+directions.
+
+Reference layer naming (``Estimators_QuantumNAT_onchipQNN.py``):
+
+- ``Conv_P128``  (:237-268): ``cnn.{0,3,6}.weight`` convs (O,I,kh,kw),
+  ``cnn.{1,4,7}.*`` BatchNorms.
+- ``FC_P128``    (:272-279): ``FC.weight`` (2048, 4096), ``FC.bias``.
+- ``SC_P128``    (:79-101):  ``conv1/conv2`` (bias-free), ``FC``.
+- ``QSC_P128``   (:107-228): ``preprocess.{0,3}`` convs (with bias),
+  ``preprocess.7`` linear, ``qlayer.weights`` (L, n, 2), ``classifier``.
+
+Layout conversions (torch NCHW / C-major flatten -> Flax NHWC / H-major
+flatten): conv kernels transpose (O,I,kh,kw)->(kh,kw,I,O); every Linear that
+consumes a flattened conv map needs its input axis permuted because torch
+flattens (C,H,W) C-major while NHWC flattens (H,W,C) H-major.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# State-dict normalisation (the Test.py:23-62 quirks)
+# ---------------------------------------------------------------------------
+
+
+def normalize_state_dict(obj: Any, fallback_key: str | None = None) -> dict[str, np.ndarray]:
+    """Accept the three reference checkpoint formats and strip ``module.``.
+
+    Formats: ``{fallback_key: sd}``, ``{'state_dict': sd}``, or a raw state
+    dict; values may be torch tensors or numpy arrays.
+    """
+    sd = obj
+    if isinstance(obj, Mapping):
+        if fallback_key is not None and fallback_key in obj and isinstance(
+            obj[fallback_key], Mapping
+        ):
+            sd = obj[fallback_key]
+        elif "state_dict" in obj and isinstance(obj["state_dict"], Mapping):
+            sd = obj["state_dict"]
+    out = {}
+    for k, v in sd.items():
+        if k.startswith("module."):
+            k = k[len("module.") :]
+        if hasattr(v, "detach"):  # torch tensor without importing torch
+            v = v.detach().cpu().numpy()
+        out[k] = np.asarray(v)
+    return out
+
+
+def load_pth(path: str, fallback_key: str | None = None) -> dict[str, np.ndarray]:
+    """``torch.load`` a reference checkpoint file and normalise it."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    return normalize_state_dict(obj, fallback_key)
+
+
+def save_pth(path: str, sd: dict[str, np.ndarray]) -> None:
+    """Save a state dict as a reference-loadable ``.pth``."""
+    import torch
+
+    torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()}, path)
+
+
+# ---------------------------------------------------------------------------
+# Flatten-order permutations
+# ---------------------------------------------------------------------------
+
+
+def _flat_perm(h: int, w: int, c: int) -> np.ndarray:
+    """perm[k_nhwc] = k_torch for a flattened (C,H,W)->(H,W,C) feature map."""
+    k = np.arange(h * w * c)
+    hh = k // (w * c)
+    ww = (k // c) % w
+    cc = k % c
+    return cc * (h * w) + hh * w + ww
+
+
+def _linear_to_kernel(weight: np.ndarray, perm: np.ndarray | None) -> np.ndarray:
+    """torch Linear weight (out, in) -> Flax Dense kernel (in, out), with an
+    optional input-axis permutation for flattened conv inputs."""
+    kernel = weight.T.copy()
+    if perm is not None:
+        kernel = kernel[perm]
+    return kernel
+
+
+def _kernel_to_linear(kernel: np.ndarray, perm: np.ndarray | None) -> np.ndarray:
+    w = np.asarray(kernel)
+    if perm is not None:
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(len(perm))
+        w = w[inv]
+    return w.T.copy()
+
+
+def _conv_to_flax(weight: np.ndarray) -> np.ndarray:
+    return np.transpose(weight, (2, 3, 1, 0)).copy()  # (O,I,kh,kw)->(kh,kw,I,O)
+
+
+def _conv_to_torch(kernel: np.ndarray) -> np.ndarray:
+    return np.transpose(np.asarray(kernel), (3, 2, 0, 1)).copy()
+
+
+# ---------------------------------------------------------------------------
+# Conv_P128 trunk  (cnn.{0,3,6} convs + cnn.{1,4,7} BNs)
+# ---------------------------------------------------------------------------
+
+
+def import_conv_trunk(sd: dict[str, np.ndarray]) -> tuple[dict, dict]:
+    """Reference ``Conv_P128`` state dict -> (params, batch_stats) matching
+    :class:`qdml_tpu.models.cnn.ConvP128`."""
+    params: dict = {}
+    stats: dict = {}
+    for i, idx in enumerate((0, 3, 6)):
+        block = f"ConvBlock_{i}"
+        params[block] = {
+            "Conv_0": {"kernel": _conv_to_flax(sd[f"cnn.{idx}.weight"])},
+            "BatchNorm_0": {
+                "scale": sd[f"cnn.{idx + 1}.weight"].copy(),
+                "bias": sd[f"cnn.{idx + 1}.bias"].copy(),
+            },
+        }
+        stats[block] = {
+            "BatchNorm_0": {
+                "mean": sd[f"cnn.{idx + 1}.running_mean"].copy(),
+                "var": sd[f"cnn.{idx + 1}.running_var"].copy(),
+            }
+        }
+    return params, stats
+
+
+def export_conv_trunk(params: dict, stats: dict) -> dict[str, np.ndarray]:
+    sd: dict[str, np.ndarray] = {}
+    for i, idx in enumerate((0, 3, 6)):
+        block_p = params[f"ConvBlock_{i}"]
+        block_s = stats[f"ConvBlock_{i}"]
+        sd[f"cnn.{idx}.weight"] = _conv_to_torch(block_p["Conv_0"]["kernel"])
+        sd[f"cnn.{idx + 1}.weight"] = np.asarray(block_p["BatchNorm_0"]["scale"]).copy()
+        sd[f"cnn.{idx + 1}.bias"] = np.asarray(block_p["BatchNorm_0"]["bias"]).copy()
+        sd[f"cnn.{idx + 1}.running_mean"] = np.asarray(
+            block_s["BatchNorm_0"]["mean"]
+        ).copy()
+        sd[f"cnn.{idx + 1}.running_var"] = np.asarray(block_s["BatchNorm_0"]["var"]).copy()
+        sd[f"cnn.{idx + 1}.num_batches_tracked"] = np.asarray(0, np.int64)
+    return sd
+
+
+# ---------------------------------------------------------------------------
+# HDCE  (3 Conv_P128 state dicts + 1 FC_P128 state dict <-> stacked variables)
+# ---------------------------------------------------------------------------
+
+_TRUNK_HW = (16, 8)
+
+
+def import_hdce(
+    conv_sds: list[dict[str, np.ndarray]], fc_sd: dict[str, np.ndarray]
+) -> dict:
+    """Reference per-scenario ``Conv{0,1,2}_*`` + shared ``Linear_*`` dicts ->
+    ``{"params": ..., "batch_stats": ...}`` for :class:`qdml_tpu.train.hdce.HDCE`."""
+    per = [import_conv_trunk(sd) for sd in conv_sds]
+
+    def stack(trees):
+        return _tree_stack([t for t in trees])
+
+    params = {
+        "StackedConvP128_0": {"VmapConvP128_0": stack([p for p, _ in per])},
+        "FCP128_0": {
+            "Dense_0": {
+                "kernel": _linear_to_kernel(
+                    fc_sd["FC.weight"], _flat_perm(*_TRUNK_HW, 32)
+                ),
+                "bias": fc_sd["FC.bias"].copy(),
+            }
+        },
+    }
+    batch_stats = {"StackedConvP128_0": {"VmapConvP128_0": stack([s for _, s in per])}}
+    return {"params": params, "batch_stats": batch_stats}
+
+
+def export_hdce(variables: dict) -> tuple[list[dict[str, np.ndarray]], dict[str, np.ndarray]]:
+    """Inverse of :func:`import_hdce`: stacked Flax variables -> (3 trunk
+    state dicts, 1 head state dict) in reference naming."""
+    stacked_p = variables["params"]["StackedConvP128_0"]["VmapConvP128_0"]
+    stacked_s = variables["batch_stats"]["StackedConvP128_0"]["VmapConvP128_0"]
+    n_scen = np.asarray(
+        stacked_p["ConvBlock_0"]["Conv_0"]["kernel"]
+    ).shape[0]
+    conv_sds = []
+    for s in range(n_scen):
+        p = _tree_index(stacked_p, s)
+        st = _tree_index(stacked_s, s)
+        conv_sds.append(export_conv_trunk(p, st))
+    dense = variables["params"]["FCP128_0"]["Dense_0"]
+    fc_sd = {
+        "FC.weight": _kernel_to_linear(dense["kernel"], _flat_perm(*_TRUNK_HW, 32)),
+        "FC.bias": np.asarray(dense["bias"]).copy(),
+    }
+    return conv_sds, fc_sd
+
+
+# ---------------------------------------------------------------------------
+# SC_P128  (conv1, conv2, FC)
+# ---------------------------------------------------------------------------
+
+_SC_HW = (4, 2)  # feature map after two maxpools of (16, 8)
+
+
+def import_sc(sd: dict[str, np.ndarray]) -> dict:
+    """Reference ``SC_P128`` state dict -> params for :class:`SCP128`."""
+    return {
+        "Conv_0": {"kernel": _conv_to_flax(sd["conv1.weight"])},
+        "Conv_1": {"kernel": _conv_to_flax(sd["conv2.weight"])},
+        "Dense_0": {
+            "kernel": _linear_to_kernel(sd["FC.weight"], _flat_perm(*_SC_HW, 32)),
+            "bias": sd["FC.bias"].copy(),
+        },
+    }
+
+
+def export_sc(params: dict) -> dict[str, np.ndarray]:
+    return {
+        "conv1.weight": _conv_to_torch(params["Conv_0"]["kernel"]),
+        "conv2.weight": _conv_to_torch(params["Conv_1"]["kernel"]),
+        "FC.weight": _kernel_to_linear(
+            params["Dense_0"]["kernel"], _flat_perm(*_SC_HW, 32)
+        ),
+        "FC.bias": np.asarray(params["Dense_0"]["bias"]).copy(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# QSC_P128  (preprocess.{0,3,7}, qlayer.weights, classifier)
+# ---------------------------------------------------------------------------
+
+
+def import_qsc(sd: dict[str, np.ndarray]) -> dict:
+    """Reference ``QSC_P128`` state dict -> params for :class:`QSCP128`."""
+    return {
+        "QSCPreprocess_0": {
+            "Conv_0": {
+                "kernel": _conv_to_flax(sd["preprocess.0.weight"]),
+                "bias": sd["preprocess.0.bias"].copy(),
+            },
+            "Conv_1": {
+                "kernel": _conv_to_flax(sd["preprocess.3.weight"]),
+                "bias": sd["preprocess.3.bias"].copy(),
+            },
+            "Dense_0": {
+                "kernel": _linear_to_kernel(
+                    sd["preprocess.7.weight"], _flat_perm(*_SC_HW, 32)
+                ),
+                "bias": sd["preprocess.7.bias"].copy(),
+            },
+        },
+        "qweights": sd["qlayer.weights"].copy(),
+        "Dense_0": {
+            "kernel": sd["classifier.weight"].T.copy(),
+            "bias": sd["classifier.bias"].copy(),
+        },
+    }
+
+
+def export_qsc(params: dict) -> dict[str, np.ndarray]:
+    pre = params["QSCPreprocess_0"]
+    return {
+        "preprocess.0.weight": _conv_to_torch(pre["Conv_0"]["kernel"]),
+        "preprocess.0.bias": np.asarray(pre["Conv_0"]["bias"]).copy(),
+        "preprocess.3.weight": _conv_to_torch(pre["Conv_1"]["kernel"]),
+        "preprocess.3.bias": np.asarray(pre["Conv_1"]["bias"]).copy(),
+        "preprocess.7.weight": _kernel_to_linear(
+            pre["Dense_0"]["kernel"], _flat_perm(*_SC_HW, 32)
+        ),
+        "preprocess.7.bias": np.asarray(pre["Dense_0"]["bias"]).copy(),
+        "qlayer.weights": np.asarray(params["qweights"]).copy(),
+        "classifier.weight": np.asarray(params["Dense_0"]["kernel"]).T.copy(),
+        "classifier.bias": np.asarray(params["Dense_0"]["bias"]).copy(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Reference checkpoint-file naming + high-level conversion
+# ---------------------------------------------------------------------------
+
+
+def reference_ckpt_name(role: str, batch_size: int, snr_db: int, tag: str) -> str:
+    """Filename-encoded reference checkpoint scheme
+    (``Runner...py:237-266, 417-426``): role in {Conv0, Conv1, Conv2, Linear,
+    QSC_OPT, SC}; tag in {'best', 'epochN'}."""
+    return f"{role}_{batch_size}_{snr_db}dB_{tag}_DML.pth"
+
+
+def import_reference_dir(
+    src_dir: str, batch_size: int = 256, snr_db: int = 10, tag: str = "best"
+) -> dict[str, dict]:
+    """Load every reference checkpoint present in ``src_dir`` -> Flax trees.
+
+    Returns a dict with any of "hdce", "sc", "qsc" keys (missing files are
+    skipped, mirroring the eval harness's graceful fallback, ``Test.py:81-86``).
+    """
+    import os
+
+    out: dict[str, dict] = {}
+    convs = []
+    for i in range(3):
+        p = os.path.join(src_dir, reference_ckpt_name(f"Conv{i}", batch_size, snr_db, tag))
+        if os.path.exists(p):
+            convs.append(load_pth(p, fallback_key=f"cnn{i}"))
+    fc_path = os.path.join(src_dir, reference_ckpt_name("Linear", batch_size, snr_db, tag))
+    if len(convs) == 3 and os.path.exists(fc_path):
+        out["hdce"] = import_hdce(convs, load_pth(fc_path, fallback_key="CE"))
+    sc_path = os.path.join(src_dir, reference_ckpt_name("SC", batch_size, snr_db, tag))
+    if os.path.exists(sc_path):
+        out["sc"] = {"params": import_sc(load_pth(sc_path, fallback_key="SC"))}
+    qsc_path = os.path.join(src_dir, reference_ckpt_name("QSC_OPT", batch_size, snr_db, tag))
+    if os.path.exists(qsc_path):
+        out["qsc"] = {"params": import_qsc(load_pth(qsc_path, fallback_key="QSC"))}
+    return out
+
+
+def export_reference_dir(
+    out_dir: str,
+    hdce_vars: dict | None = None,
+    sc_params: dict | None = None,
+    qsc_params: dict | None = None,
+    batch_size: int = 256,
+    snr_db: int = 10,
+    tag: str = "best",
+) -> list[str]:
+    """Write reference-named ``.pth`` files for whatever models are given."""
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+
+    def put(role, sd):
+        path = os.path.join(out_dir, reference_ckpt_name(role, batch_size, snr_db, tag))
+        save_pth(path, sd)
+        written.append(path)
+
+    if hdce_vars is not None:
+        conv_sds, fc_sd = export_hdce(hdce_vars)
+        for i, sd in enumerate(conv_sds):
+            put(f"Conv{i}", sd)
+        put("Linear", fc_sd)
+    if sc_params is not None:
+        put("SC", export_sc(sc_params))
+    if qsc_params is not None:
+        put("QSC_OPT", export_qsc(qsc_params))
+    return written
+
+
+# ---------------------------------------------------------------------------
+# small tree helpers (stack/index a leading scenario axis)
+# ---------------------------------------------------------------------------
+
+
+def _tree_stack(trees: list) -> Any:
+    if isinstance(trees[0], Mapping):
+        return {k: _tree_stack([t[k] for t in trees]) for k in trees[0]}
+    return np.stack([np.asarray(t) for t in trees])
+
+
+def _tree_index(tree: Any, i: int) -> Any:
+    if isinstance(tree, Mapping):
+        return {k: _tree_index(v, i) for k, v in tree.items()}
+    return np.asarray(tree)[i]

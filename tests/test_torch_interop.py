@@ -1,0 +1,195 @@
+"""Torch checkpoint interop: Flax <-> reference-named state dicts.
+
+The strong check here is FORWARD EQUIVALENCE: random Flax weights exported to
+a reference-named torch state dict, loaded into torch modules built with the
+reference architecture (Conv/BN/ReLU trunk, C-major flatten, shared linear
+head — ``Estimators_QuantumNAT_onchipQNN.py:40-101, 237-279``), must produce
+the same outputs on the same inputs (NHWC vs NCHW transposed). That proves
+both the weight mapping and that our modules ARE the reference architecture.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from torch import nn  # noqa: E402
+
+from qdml_tpu.models.cnn import SCP128, QSCPreprocess  # noqa: E402
+from qdml_tpu.train.hdce import HDCE  # noqa: E402
+from qdml_tpu.train.torch_interop import (  # noqa: E402
+    export_hdce,
+    export_qsc,
+    export_sc,
+    import_hdce,
+    import_qsc,
+    import_sc,
+    normalize_state_dict,
+)
+
+
+def _nchw(x_nhwc: np.ndarray) -> torch.Tensor:
+    return torch.from_numpy(np.transpose(x_nhwc, (0, 3, 1, 2)).copy())
+
+
+class _TorchTrunk(nn.Module):
+    """Reference Conv_P128 architecture (fresh implementation for the test)."""
+
+    def __init__(self):
+        super().__init__()
+        blocks = []
+        ch = 2
+        for _ in range(3):
+            blocks += [
+                nn.Conv2d(ch, 32, 3, padding=1, bias=False),
+                nn.BatchNorm2d(32),
+                nn.ReLU(),
+            ]
+            ch = 32
+        self.cnn = nn.Sequential(*blocks)
+
+    def forward(self, x):
+        return self.cnn(x).flatten(1)  # C-major flatten
+
+
+class _TorchHead(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.FC = nn.Linear(32 * 16 * 8, 2048)
+
+    def forward(self, x):
+        return self.FC(x)
+
+
+class _TorchSC(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(2, 32, 3, padding=1, bias=False)
+        self.conv2 = nn.Conv2d(32, 32, 3, padding=1, bias=False)
+        self.FC = nn.Linear(32 * 4 * 2, 3)
+
+    def forward(self, x):
+        x = torch.relu(self.conv1(x))
+        x = torch.max_pool2d(x, 2, 2)
+        x = torch.relu(self.conv2(x))
+        x = torch.max_pool2d(x, 2, 2)
+        return torch.log_softmax(self.FC(x.flatten(1)), dim=1)
+
+
+class _TorchQSCPreprocess(nn.Module):
+    def __init__(self, n_qubits=6):
+        super().__init__()
+        self.preprocess = nn.Sequential(
+            nn.Conv2d(2, 16, 3, padding=1),
+            nn.ReLU(),
+            nn.MaxPool2d(2),
+            nn.Conv2d(16, 32, 3, padding=1),
+            nn.ReLU(),
+            nn.MaxPool2d(2),
+            nn.Flatten(),
+            nn.Linear(32 * 4 * 2, n_qubits),
+            nn.Tanh(),
+        )
+
+    def forward(self, x):
+        return self.preprocess(x)
+
+
+def _rand_x(batch=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((batch, 16, 8, 2)).astype(np.float32)
+
+
+def test_hdce_export_forward_equivalence():
+    model = HDCE()
+    x = _rand_x()
+    xs = jnp.broadcast_to(jnp.asarray(x)[None], (3,) + x.shape)
+    variables = model.init(jax.random.PRNGKey(0), xs, train=False)
+    # make batch_stats non-trivial so BN mapping is actually exercised
+    variables = jax.tree.map(lambda v: v, variables)
+    want = np.asarray(model.apply(variables, xs, train=False))  # (3, B, 2048)
+
+    conv_sds, fc_sd = export_hdce(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]}
+    )
+    head = _TorchHead()
+    head.load_state_dict({k: torch.from_numpy(v) for k, v in fc_sd.items()})
+    head.eval()
+    for s in range(3):
+        trunk = _TorchTrunk()
+        trunk.load_state_dict(
+            {k: torch.from_numpy(np.asarray(v)) for k, v in conv_sds[s].items()}
+        )
+        trunk.eval()
+        with torch.no_grad():
+            got = head(trunk(_nchw(x))).numpy()
+        np.testing.assert_allclose(got, want[s], rtol=1e-4, atol=1e-4)
+
+
+def test_hdce_import_roundtrip():
+    model = HDCE()
+    xs = jnp.zeros((3, 2, 16, 8, 2))
+    variables = model.init(jax.random.PRNGKey(1), xs, train=False)
+    conv_sds, fc_sd = export_hdce(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]}
+    )
+    back = import_hdce(conv_sds, fc_sd)
+    for la, lb in zip(jax.tree.leaves(back), jax.tree.leaves(dict(variables))):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6)
+
+
+def test_sc_export_forward_equivalence():
+    model = SCP128()
+    x = _rand_x(batch=7, seed=2)
+    params = model.init(jax.random.PRNGKey(2), jnp.asarray(x), train=False)["params"]
+    want = np.asarray(model.apply({"params": params}, jnp.asarray(x), train=False))
+
+    tm = _TorchSC()
+    tm.load_state_dict({k: torch.from_numpy(v) for k, v in export_sc(params).items()})
+    tm.eval()
+    with torch.no_grad():
+        got = tm(_nchw(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sc_import_handles_reference_formats():
+    model = SCP128()
+    params = model.init(jax.random.PRNGKey(3), jnp.zeros((1, 16, 8, 2)), train=False)[
+        "params"
+    ]
+    sd = export_sc(params)
+    # DataParallel 'module.' prefix + {'state_dict': ...} wrapper (Test.py:23-62)
+    wrapped = {"state_dict": {f"module.{k}": v for k, v in sd.items()}}
+    back = import_sc(normalize_state_dict(wrapped))
+    for la, lb in zip(jax.tree.leaves(back), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6)
+
+
+def test_qsc_preprocess_forward_equivalence_and_roundtrip():
+    from qdml_tpu.models.qsc import QSCP128
+
+    model = QSCP128(n_qubits=4, n_layers=2)
+    x = _rand_x(batch=3, seed=4)
+    params = model.init(jax.random.PRNGKey(4), jnp.asarray(x), train=False)["params"]
+    sd = export_qsc(params)
+
+    # preprocess (angles) must agree with the torch reference preprocess
+    pre = QSCPreprocess(n_qubits=4)
+    angles_flax = np.asarray(
+        pre.apply({"params": params["QSCPreprocess_0"]}, jnp.asarray(x))
+    )
+    tp = _TorchQSCPreprocess(n_qubits=4)
+    tp.load_state_dict(
+        {k: torch.from_numpy(v) for k, v in sd.items() if k.startswith("preprocess")}
+    )
+    tp.eval()
+    with torch.no_grad():
+        angles_torch = tp(_nchw(x)).numpy()
+    np.testing.assert_allclose(angles_torch, angles_flax, rtol=1e-4, atol=1e-5)
+
+    # full round trip
+    back = import_qsc(sd)
+    for la, lb in zip(jax.tree.leaves(back), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6)
