@@ -61,9 +61,6 @@ class ModelConfig:
     features: int = 32       # conv channels (reference self.features=32)
     kernel_size: int = 3
     n_conv_layers: int = 3   # Conv_P128/DCE_P128 trunk depth
-    # input image = (n_sub, n_beam) spatial with 2 (re/im) channels, NHWC
-    image_hw: tuple[int, int] = (16, 8)
-    h_out_dim: int = 2048    # 64*16*2 real outputs (reference Linear(4096, 2048))
     dtype: str = "float32"   # activation dtype ("bfloat16" for the MXU fast path)
 
 
@@ -144,6 +141,27 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
+
+    # Geometry-derived model dimensions. Single-sourced from DataConfig so a
+    # non-default geometry (e.g. the tiny multichip dryrun) can never silently
+    # desynchronize the CNN input image and head width from the channel shape
+    # (reference hardcodes (2,16,8) and Linear(4096, 2048):
+    # ``Runner...py:108``, ``Estimators...py:275``).
+
+    @property
+    def image_hw(self) -> tuple[int, int]:
+        """CNN input spatial dims: (n_sub, n_beam) with 2 (re/im) channels."""
+        return (self.data.n_sub, self.data.n_beam)
+
+    @property
+    def h_out_dim(self) -> int:
+        """Estimation-head width: n_ant * n_sub * 2 real outputs."""
+        return self.data.h_dim * 2
+
+    @property
+    def feat_dim(self) -> int:
+        """Flattened trunk feature width: features * n_sub * n_beam."""
+        return self.model.features * self.data.n_sub * self.data.n_beam
 
 
 # ---------------------------------------------------------------------------

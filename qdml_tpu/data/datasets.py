@@ -321,7 +321,15 @@ class NpyGridLoader:
                             continue
                     if stop.is_set():
                         return
-                q.put((_DONE, None))
+                # Same stop-aware put loop as data items: an unconditional
+                # blocking put could outlive the consumer's 5s join if the
+                # queue is full when the epoch is abandoned.
+                while not stop.is_set():
+                    try:
+                        q.put((_DONE, None), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
             except BaseException as e:  # noqa: BLE001 — forwarded to consumer
                 try:
                     q.put((_ERR, e), timeout=1.0)

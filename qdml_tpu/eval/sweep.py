@@ -56,7 +56,7 @@ def make_sweep_step(
     hdce = HDCE(
         n_scenarios=cfg.data.n_scenarios,
         features=cfg.model.features,
-        out_dim=cfg.model.h_out_dim,
+        out_dim=cfg.h_out_dim,
     )
     sc = SCP128(n_classes=cfg.quantum.n_classes)
     qsc = (

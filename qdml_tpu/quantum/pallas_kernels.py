@@ -241,6 +241,13 @@ def apply_rotation_layer(psi: CArr, weights_l: jnp.ndarray, n: int) -> CArr:
 
 def _rotation_layer_pallas(ar: jnp.ndarray, ai: jnp.ndarray, weights_l: jnp.ndarray, n: int):
     dim = 1 << n
+    if dim < _LANES:
+        # The kernel's XOR-partner rolls need the amplitude axis to BE the
+        # lane axis; below one 128-lane tile, Mosaic would have to pad, and a
+        # circular roll over padding corrupts the exchange. Use the
+        # mathematically identical XLA layer instead (n >= 7 engages the
+        # kernel with naturally lane-aligned 2^n >= 128).
+        return _xla_rotation_layer(ar, ai, weights_l, n)
     batch = ar.shape[0]
     tile_b = min(128, max(8, ((batch + 7) // 8) * 8))
     batch_p = ((batch + tile_b - 1) // tile_b) * tile_b

@@ -244,6 +244,7 @@ class PrefetchPipeline:
         self.row_bytes = int(np.prod(self.row_shape, dtype=np.int64)) * src.itemsize
         self._lib = _load()
         self._fallback: dict[int, np.ndarray] = {}
+        self._counts: dict[int, int] = {}
         self._next_ticket = 0
         if self._lib is not None:
             self._handle = self._lib.qdml_prefetch_create(
@@ -277,7 +278,6 @@ class PrefetchPipeline:
             raise RuntimeError(
                 "no free prefetch slot — release() consumed batches first"
             )
-        self._counts = getattr(self, "_counts", {})
         self._counts[slot] = len(idx)
         return slot
 
@@ -296,6 +296,9 @@ class PrefetchPipeline:
             self._fallback.pop(ticket, None)
         else:
             self._lib.qdml_prefetch_release(self._handle, ticket)
+            # Drop the count so a stale ticket can't silently read a reused
+            # slot's buffer with the wrong length.
+            self._counts.pop(ticket, None)
 
     def close(self) -> None:
         if self._handle is not None:

@@ -70,10 +70,10 @@ def make_dce_eval_step(model: DCEP128) -> Callable:
 def init_dce_state(cfg: ExperimentConfig, steps_per_epoch: int):
     model = DCEP128(
         features=cfg.model.features,
-        out_dim=cfg.model.h_out_dim,
+        out_dim=cfg.h_out_dim,
         dtype=activation_dtype(cfg.model.dtype),
     )
-    dummy = jnp.zeros((2, *cfg.model.image_hw, 2), jnp.float32)
+    dummy = jnp.zeros((2, *cfg.image_hw, 2), jnp.float32)
     variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)
     tx = get_optimizer(cfg.train, steps_per_epoch)
     state = TrainState.create(

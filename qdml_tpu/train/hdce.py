@@ -117,11 +117,11 @@ def init_hdce_state(cfg: ExperimentConfig, steps_per_epoch: int) -> tuple[HDCE, 
     model = HDCE(
         n_scenarios=cfg.data.n_scenarios,
         features=cfg.model.features,
-        out_dim=cfg.model.h_out_dim,
+        out_dim=cfg.h_out_dim,
         dtype=activation_dtype(cfg.model.dtype),
     )
     dummy = jnp.zeros(
-        (cfg.data.n_scenarios, 2, *cfg.model.image_hw, 2), jnp.float32
+        (cfg.data.n_scenarios, 2, *cfg.image_hw, 2), jnp.float32
     )
     variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)
     tx = get_optimizer(cfg.train, steps_per_epoch)

@@ -62,7 +62,7 @@ def init_sweep(cfg: ExperimentConfig, noise_levels: Sequence[float], steps_per_e
     # the quantum config requests it.
     train_cfg = dataclasses.replace(cfg.train, optimizer="adamw")
     tx = get_optimizer(train_cfg, steps_per_epoch, cfg.quantum)
-    dummy = jnp.zeros((2, *cfg.model.image_hw, 2), jnp.float32)
+    dummy = jnp.zeros((2, *cfg.image_hw, 2), jnp.float32)
 
     def init_one(key):
         params = model.init(key, dummy, train=False)["params"]

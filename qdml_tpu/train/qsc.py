@@ -82,7 +82,7 @@ def make_sc_eval_step(model: nn.Module) -> Callable:
 
 def init_sc_state(cfg: ExperimentConfig, quantum: bool, steps_per_epoch: int):
     model = build_classifier(cfg, quantum)
-    dummy = jnp.zeros((2, *cfg.model.image_hw, 2), jnp.float32)
+    dummy = jnp.zeros((2, *cfg.image_hw, 2), jnp.float32)
     variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)
     train_cfg = cfg.train
     if quantum:

