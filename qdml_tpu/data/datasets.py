@@ -331,10 +331,12 @@ class NpyGridLoader:
                     except queue.Full:
                         continue
             except BaseException as e:  # noqa: BLE001 — forwarded to consumer
-                try:
-                    q.put((_ERR, e), timeout=1.0)
-                except queue.Full:
-                    pass
+                while not stop.is_set():
+                    try:
+                        q.put((_ERR, e), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

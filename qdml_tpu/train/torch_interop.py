@@ -67,11 +67,16 @@ def load_pth(path: str, fallback_key: str | None = None) -> dict[str, np.ndarray
     return normalize_state_dict(obj, fallback_key)
 
 
-def save_pth(path: str, sd: dict[str, np.ndarray]) -> None:
-    """Save a state dict as a reference-loadable ``.pth``."""
+def save_pth(path: str, sd: dict[str, np.ndarray], wrap_key: str | None = None) -> None:
+    """Save a state dict as a reference-loadable ``.pth``, optionally wrapped
+    as ``{wrap_key: sd}`` (the reference wraps HDCE checkpoints that way,
+    ``Runner...py:237-264``)."""
     import torch
 
-    torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()}, path)
+    obj: dict = {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()}
+    if wrap_key is not None:
+        obj = {wrap_key: obj}
+    torch.save(obj, path)
 
 
 # ---------------------------------------------------------------------------
@@ -365,26 +370,33 @@ def export_reference_dir(
     snr_db: int = 10,
     tag: str = "best",
 ) -> list[str]:
-    """Write reference-named ``.pth`` files for whatever models are given."""
+    """Write ``.pth`` files the reference's own loaders accept: HDCE parts
+    wrapped ``{'conv'|'linear': sd}`` (``Runner...py:237-264``), the SC under
+    the ``..._DML_SC.pth`` scheme with key ``'cnn'`` (``Test.py:71-73``), and
+    the QSC both raw under ``QSC_OPT_*`` (``Runner...py:417-426``) and as the
+    ``QSC_optimized_best.pth``/``model_state_dict`` form Test.py probes
+    (``Test.py:79-84``)."""
     import os
 
     os.makedirs(out_dir, exist_ok=True)
     written = []
 
-    def put(role, sd):
-        path = os.path.join(out_dir, reference_ckpt_name(role, batch_size, snr_db, tag))
-        save_pth(path, sd)
+    def put(filename, sd, wrap_key=None):
+        path = os.path.join(out_dir, filename)
+        save_pth(path, sd, wrap_key)
         written.append(path)
 
     if hdce_vars is not None:
         conv_sds, fc_sd = export_hdce(hdce_vars)
         for i, sd in enumerate(conv_sds):
-            put(f"Conv{i}", sd)
-        put("Linear", fc_sd)
+            put(reference_ckpt_name(f"Conv{i}", batch_size, snr_db, tag), sd, "conv")
+        put(reference_ckpt_name("Linear", batch_size, snr_db, tag), fc_sd, "linear")
     if sc_params is not None:
-        put("SC", export_sc(sc_params))
+        put(reference_sc_ckpt_name(batch_size, snr_db, tag), export_sc(sc_params), "cnn")
     if qsc_params is not None:
-        put("QSC_OPT", export_qsc(qsc_params))
+        qsc_sd = export_qsc(qsc_params)
+        put(reference_ckpt_name("QSC_OPT", batch_size, snr_db, tag), qsc_sd)
+        put("QSC_optimized_best.pth", qsc_sd, "model_state_dict")
     return written
 
 

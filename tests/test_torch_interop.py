@@ -251,3 +251,43 @@ def test_import_reference_dir_stale_qsc_name(tmp_path):
     assert set(out) == {"qsc"}
     for la, lb in zip(jax.tree.leaves(out["qsc"]["params"]), jax.tree.leaves(params)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6)
+
+
+def test_export_import_reference_dir_roundtrip(tmp_path):
+    """export_reference_dir writes artifacts the reference's loaders accept;
+    import_reference_dir (which enforces those genuine formats) reads them
+    back bit-for-bit."""
+    from qdml_tpu.models.qsc import QSCP128
+    from qdml_tpu.train.torch_interop import export_reference_dir, import_reference_dir
+
+    model = HDCE()
+    xs = jnp.zeros((3, 2, 16, 8, 2))
+    variables = model.init(jax.random.PRNGKey(8), xs, train=False)
+    hdce_vars = {"params": variables["params"], "batch_stats": variables["batch_stats"]}
+    sc_params = SCP128().init(
+        jax.random.PRNGKey(9), jnp.zeros((1, 16, 8, 2)), train=False
+    )["params"]
+    qsc_params = QSCP128(n_qubits=4, n_layers=2).init(
+        jax.random.PRNGKey(10), jnp.zeros((1, 16, 8, 2)), train=False
+    )["params"]
+
+    written = export_reference_dir(
+        str(tmp_path), hdce_vars=hdce_vars, sc_params=sc_params, qsc_params=qsc_params
+    )
+    names = sorted(p.split("/")[-1] for p in written)
+    assert "256_10dB_best_DML_SC.pth" in names          # Test.py:72 scheme
+    assert "QSC_optimized_best.pth" in names            # Test.py:80 probe
+    # wrapper keys are what the reference reads (Test.py:100-106)
+    obj = torch.load(tmp_path / "Conv0_256_10dB_best_DML.pth", weights_only=False)
+    assert set(obj) == {"conv"}
+    obj = torch.load(tmp_path / "Linear_256_10dB_best_DML.pth", weights_only=False)
+    assert set(obj) == {"linear"}
+
+    out = import_reference_dir(str(tmp_path))
+    assert set(out) == {"hdce", "sc", "qsc"}
+    for la, lb in zip(jax.tree.leaves(out["hdce"]), jax.tree.leaves(hdce_vars)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6)
+    for la, lb in zip(jax.tree.leaves(out["sc"]["params"]), jax.tree.leaves(sc_params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6)
+    for la, lb in zip(jax.tree.leaves(out["qsc"]["params"]), jax.tree.leaves(qsc_params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-6)
