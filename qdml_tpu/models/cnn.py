@@ -34,15 +34,27 @@ def activation_dtype(name: str):
 
 
 class ConvBlock(nn.Module):
-    """Conv3x3(no bias) + BatchNorm + ReLU (reference trunk block)."""
+    """Conv3x3(no bias) + BatchNorm + ReLU (reference trunk block).
+
+    ``bn_momentum``: running-stat decay per update. The reference's torch
+    ``BatchNorm2d`` uses momentum=0.1, i.e. per-update decay 0.9
+    (``Estimators...py:52``) — that is this module's default. The fused HDCE
+    step sees ONE BN update per train step where the reference's per-cell
+    loop applies ``n_users`` sequential updates (``Runner...py:181-199``);
+    passing ``0.9 ** n_users`` matches the reference's per-step warm-up
+    timescale (measured in ``tests/test_bn_semantics.py``).
+    """
 
     features: int = 32
     dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
-        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=self.bn_momentum, dtype=jnp.float32
+        )(x)
         return nn.relu(x)
 
 
@@ -55,11 +67,12 @@ class ConvP128(nn.Module):
     features: int = 32
     n_layers: int = 3
     dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         for _ in range(self.n_layers):
-            x = ConvBlock(self.features, self.dtype)(x, train=train)
+            x = ConvBlock(self.features, self.dtype, self.bn_momentum)(x, train=train)
         return x.reshape(x.shape[0], -1).astype(jnp.float32)
 
 
@@ -123,6 +136,7 @@ class StackedConvP128(nn.Module):
     n_scenarios: int = 3
     features: int = 32
     dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -135,7 +149,7 @@ class StackedConvP128(nn.Module):
             methods=["__call__"],
         )
         # NOTE: train must be positional — flax nn.vmap drops kwargs.
-        return vconv(self.features, dtype=self.dtype)(x, train)
+        return vconv(self.features, dtype=self.dtype, bn_momentum=self.bn_momentum)(x, train)
 
 
 class QSCPreprocess(nn.Module):

@@ -1,0 +1,18 @@
+"""Persistent XLA compilation cache, shared by every entry point.
+
+One definition of the cache location/thresholds so bench.py,
+``__graft_entry__`` and the test suite can never desynchronize (compile time
+dominates every cold run on both the 1-CPU driver host and the tunnelled TPU).
+"""
+
+from __future__ import annotations
+
+CACHE_DIR = "/tmp/qdml_jax_cache"
+
+
+def enable_compile_cache() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -19,6 +19,6 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the suite is dominated by XLA CPU compiles of
 # the same jitted steps across test files; caching them on disk makes repeat
 # runs fast without changing any test semantics.
-jax.config.update("jax_compilation_cache_dir", "/tmp/qdml_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+from qdml_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

@@ -97,3 +97,41 @@ def test_train_nat_sweep_end_to_end(tmp_path):
     leaf = jax.tree.leaves(params)[0]
     assert leaf.shape[0] == 2
     assert (tmp_path / "nat_sweep_last").is_dir()
+    # best-member checkpoint is a SINGLE model's params (no ensemble axis)
+    # loadable into one QSCP128, with the winning sigma in its metadata
+    import json
+
+    from qdml_tpu.train.checkpoint import restore_checkpoint
+
+    best, meta = restore_checkpoint(str(tmp_path), "nat_sweep_best")
+    assert jax.tree.leaves(best["params"])[0].shape == jax.tree.leaves(params)[0].shape[1:]
+    assert meta["sigma"] in (0.0, 0.05)
+    assert 0.0 <= meta["val_acc"] <= 1.0
+    with open(tmp_path / "nat_sweep_best.meta.json") as fh:
+        assert json.load(fh)["member"] in (0, 1)
+
+
+def test_train_nat_sweep_resume(tmp_path):
+    """A 1-epoch run + resumed 2nd epoch ends at exactly the same params as an
+    uninterrupted 2-epoch run (same seeds, same data; fresh noise per epoch)."""
+    import dataclasses
+
+    full_params, full_hist = train_nat_sweep(
+        _cfg(n_epochs=2), noise_levels=(0.0, 0.05), workdir=str(tmp_path / "full")
+    )
+
+    part_dir = str(tmp_path / "part")
+    train_nat_sweep(_cfg(n_epochs=1), noise_levels=(0.0, 0.05), workdir=part_dir)
+    cfg2 = _cfg(n_epochs=2)
+    cfg2 = dataclasses.replace(cfg2, train=dataclasses.replace(cfg2.train, resume=True))
+    res_params, res_hist = train_nat_sweep(
+        cfg2, noise_levels=(0.0, 0.05), workdir=part_dir
+    )
+    assert len(res_hist["train_loss"]) == 1  # only the resumed epoch ran
+    np.testing.assert_allclose(
+        np.asarray(res_hist["train_loss"][0]),
+        np.asarray(full_hist["train_loss"][1]),
+        rtol=1e-6,
+    )
+    for la, lb in zip(jax.tree.leaves(res_params), jax.tree.leaves(full_params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5, atol=1e-6)
