@@ -7,6 +7,7 @@ from qdml_tpu.data.baselines import (  # noqa: F401
 from qdml_tpu.data.channels import (  # noqa: F401
     ChannelGeometry,
     generate_samples,
+    label_noise_var,
     make_sample_key,
     noise_var,
     sample_channel,

@@ -29,7 +29,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from qdml_tpu.config import DataConfig
-from qdml_tpu.data.baselines import ls_estimate
 from qdml_tpu.data.channels import ChannelGeometry, generate_samples
 from qdml_tpu.utils.complexops import pack_h, yp_to_image
 
@@ -48,9 +47,11 @@ def make_network_batch(
     ``(N,)`` vectors).
 
     Fields: ``yp_img (..., n_sub, n_beam, 2) f32``, ``h_label (..., 2*h_dim) f32``
-    (packed LS target — the reference trains against the LS label,
-    ``Runner...py:112``), ``h_perf (..., 2*h_dim) f32``, ``indicator (...) i32``,
-    plus complex ``yp``/``h_ls``/``h_perf_c`` for the classical baselines.
+    (the packed full-pilot LS observation the reference trains against,
+    ``Runner...py:112`` — an independent noisy view of H, see
+    :func:`qdml_tpu.data.channels.label_noise_var`), ``h_perf (..., 2*h_dim)
+    f32``, ``indicator (...) i32``, plus complex ``yp``/``h_ls``/``h_perf_c``
+    for the classical baselines.
     """
     lead = scenarios.shape
     flat = generate_samples(
@@ -58,7 +59,7 @@ def make_network_batch(
     )
     yp = flat["yp"].reshape(lead + (geom.pilot_num,))
     h_perf = flat["h_perf"].reshape(lead + (geom.h_dim,))
-    h_ls = ls_estimate(yp, geom)
+    h_ls = flat["h_ls"].reshape(lead + (geom.h_dim,))
     return {
         "yp": yp,
         "h_ls": h_ls,
@@ -123,17 +124,32 @@ class DMLGridLoader:
         self._scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, batch_size))
         self._user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, batch_size))
 
+    def _step_snr(self, epoch: int, step: int) -> float:
+        """Per-step training SNR: fixed ``cfg.snr_db`` (reference protocol,
+        SNRdb=10) or, with ``cfg.snr_jitter=(lo, hi)``, drawn uniformly per
+        batch — deterministic in ``(seed, epoch, step)``. Jitter trains one
+        estimator that generalizes across the eval SNR grid, the robustness
+        the reference's published curves exhibit."""
+        lo_hi = self.cfg.snr_jitter
+        if lo_hi is None:
+            return float(self.cfg.snr_db)
+        rng = np.random.default_rng((self.cfg.seed, 7, epoch, step))
+        return float(rng.uniform(lo_hi[0], lo_hi[1]))
+
     def epoch(self, epoch: int, shuffle: bool = True) -> Iterator[dict[str, jnp.ndarray]]:
         bs = self.batch_size
         perms = _epoch_perms(self.cfg, self.n, self.index_base, epoch, shuffle)
         for step in range(self.steps_per_epoch):
             idx = jnp.asarray(perms[:, :, step * bs : (step + 1) * bs])
+            # jitter applies to shuffled (training) epochs only: validation
+            # iterates with shuffle=False and stays at the fixed cfg.snr_db
+            snr = self._step_snr(epoch, step) if shuffle else float(self.cfg.snr_db)
             yield make_network_batch(
                 jnp.uint32(self.cfg.seed),
                 self._scen,
                 self._user,
                 idx,
-                jnp.float32(self.cfg.snr_db),
+                jnp.float32(snr),
                 self.geom,
             )
 
@@ -247,6 +263,12 @@ class NpyGridLoader:
     ):
         from qdml_tpu.runtime import NativeNpyFile
 
+        if cfg.snr_jitter is not None:
+            raise ValueError(
+                "snr_jitter is impossible on a materialised npy cache (files "
+                "were generated at the fixed cfg.snr_db); use DMLGridLoader "
+                "for the jittered protocol"
+            )
         self.cfg = cfg
         self.geom = ChannelGeometry.from_config(cfg)
         self.n_threads = n_threads

@@ -16,6 +16,7 @@ from typing import Any
 _CURVE_LABELS = {
     "ls": "LS",
     "mmse": "MMSE",
+    "mmse_oracle": "MMSE (oracle prior)",
     "hdce_classical": "HDCE (classical SC)",
     "hdce_quantum": "HDCE (quantum SC)",
 }

@@ -3,7 +3,8 @@
 For each SNR in the grid (default ``{5,7,9,11,13,15}`` dB, ``Test.py:66``) over
 ``test_len`` fresh samples (``Test.py:20,127``):
 
-- classical baselines: LS back-projection and LMMSE (``Test.py:141-147``),
+- classical baselines: the full-pilot LS observation (``HLS``) and its LMMSE
+  refinement (``Test.py:141-147``),
 - scenario classification with the classical CNN and (optionally) the quantum
   classifier (``Test.py:158-164``),
 - HDCE estimation with each sample routed through the trunk matching its
@@ -28,9 +29,9 @@ from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.baselines import (
     beam_delay_profile,
     mmse_estimate,
-    sigma2_for_snr,
+    mmse_generic_estimate,
 )
-from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.channels import ChannelGeometry, label_noise_var
 from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.models.cnn import SCP128
 from qdml_tpu.models.qsc import QSCP128
@@ -83,9 +84,15 @@ def make_sweep_step(
         h = batch["h_perf_c"]
         x = batch["yp_img"]
 
-        # classical baselines
+        # classical baselines: the full-pilot LS observation IS the LS
+        # estimator (Test.py's HLS); MMSE is its Wiener refinement
+        # (Test.py:145) — generic site-agnostic covariance for the headline
+        # curve, plus the empirical beam-delay oracle prior as a strictly
+        # stronger genie variant.
         h_ls = batch["h_ls"]
-        h_mmse = mmse_estimate(h_ls, sigma2_for_snr(geom, snr_db), profile, geom)
+        sigma2 = label_noise_var(geom, snr_db)
+        h_mmse = mmse_generic_estimate(h_ls, sigma2, geom)
+        h_mmse_oracle = mmse_estimate(h_ls, sigma2, profile, geom)
 
         # stacked-trunk HDCE outputs for every scenario hypothesis
         xs = jnp.broadcast_to(x[None], (n_scen,) + x.shape)
@@ -95,6 +102,7 @@ def make_sweep_step(
             "pow": _sum_sq(h),
             "err_ls": _sum_sq(h_ls - h),
             "err_mmse": _sum_sq(h_mmse - h),
+            "err_mmse_oracle": _sum_sq(h_mmse_oracle - h),
             "count": jnp.asarray(bs, jnp.float32),
         }
 

@@ -83,7 +83,11 @@ def make_sc_eval_step(model: nn.Module) -> Callable:
 def init_sc_state(cfg: ExperimentConfig, quantum: bool, steps_per_epoch: int):
     model = build_classifier(cfg, quantum)
     dummy = jnp.zeros((2, *cfg.image_hw, 2), jnp.float32)
-    variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)
+    # Compiled init: the quantum backends (sharded above all) are minutes of
+    # eager per-op dispatch at large n_qubits but seconds compiled.
+    variables = jax.jit(lambda key, x: model.init(key, x, train=False))(
+        jax.random.PRNGKey(cfg.train.seed), dummy
+    )
     train_cfg = cfg.train
     if quantum:
         # Reference QSC training uses AdamW (Runner...py:320).

@@ -1,0 +1,11 @@
+#!/bin/bash
+# VERDICT r1 #3: reproduce the reference's published curves end-to-end.
+# Reference protocol: 100 epochs, bs 256, lr 1e-3 (halved/30), train SNR 10.
+set -e
+cd /root/repo
+for cmd in train-hdce train-sc train-qsc; do
+  echo "=== $cmd ==="
+  python -m qdml_tpu.cli $cmd --train.workdir=runs/science
+done
+echo "=== eval ==="
+python -m qdml_tpu.cli eval --train.workdir=runs/science --eval.results_dir=results

@@ -45,11 +45,13 @@ def test_snr_sweep_structure(tmp_path):
 
     results = run_snr_sweep(qcfg, hdce_vars, sc_vars, qsc_vars)
     assert results["snr"] == [5.0, 15.0]
-    for curve in ("ls", "mmse", "hdce_classical", "hdce_quantum"):
+    for curve in ("ls", "mmse", "mmse_oracle", "hdce_classical", "hdce_quantum"):
         assert len(results["nmse_db"][curve]) == 2
         assert np.isfinite(results["nmse_db"][curve]).all()
-    # MMSE beats LS at both SNRs; LS improves with SNR
+    # MMSE beats LS at both SNRs; the oracle-prior MMSE beats the generic one;
+    # LS improves with SNR
     assert results["nmse_db"]["mmse"][0] < results["nmse_db"]["ls"][0]
+    assert results["nmse_db"]["mmse_oracle"][0] < results["nmse_db"]["mmse"][0]
     assert results["nmse_db"]["ls"][1] < results["nmse_db"]["ls"][0]
     for key in ("classical", "quantum"):
         assert len(results["acc"][key]) == 2
