@@ -9,6 +9,9 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli train-qsc  [...]      # quantum scenario classifier
     python -m qdml_tpu.cli nat-sweep  [...]      # vmapped QuantumNAT noise-level ensemble
     python -m qdml_tpu.cli eval       [...]      # SNR sweep + plots + JSON
+    python -m qdml_tpu.cli loss-curves --curves=LABEL:metrics.jsonl[,...]
+                                                 # reference Loss Curve figure
+    python -m qdml_tpu.cli profile [--out=DIR]   # jax.profiler trace + samples/sec
     python -m qdml_tpu.cli gen-data --out=DIR    # materialise .npy cache
     python -m qdml_tpu.cli import-torch --out=SRCDIR  # reference .pth -> orbax
     python -m qdml_tpu.cli export-torch --out=DSTDIR  # orbax -> reference .pth
@@ -27,9 +30,12 @@ from qdml_tpu import config as cfg_mod
 from qdml_tpu.utils.metrics import MetricsLogger
 
 
+_PASSTHROUGH = ("--out=", "--curves=")  # command args, not config overrides
+
+
 def _cfg(argv):
-    extra = [a for a in argv if a.startswith("--out=")]
-    rest = [a for a in argv if not a.startswith("--out=")]
+    extra = [a for a in argv if a.startswith(_PASSTHROUGH)]
+    rest = [a for a in argv if not a.startswith(_PASSTHROUGH)]
     return cfg_mod.from_args(rest), extra
 
 
@@ -81,17 +87,70 @@ def main(argv: list[str] | None = None) -> int:
         out_json = save_results_json(results, cfg.eval.results_dir)
         out_png = create_comparison_plots(results, cfg.eval.results_dir)
         print(f"results: {out_json} plot: {out_png}")
+    elif cmd == "loss-curves":
+        from qdml_tpu.eval.loss_curves import (
+            create_loss_curve_plot,
+            parse_curve_spec,
+            read_loss_history,
+        )
+
+        spec = next(
+            (a.split("=", 1)[1] for a in extra if a.startswith("--curves=")), None
+        )
+        if spec is None:
+            raise SystemExit("loss-curves requires --curves=LABEL:PATH[,LABEL:PATH...]")
+        curves = [
+            (label, read_loss_history(path)) for label, path in parse_curve_spec(spec)
+        ]
+        out = create_loss_curve_plot(curves, cfg.eval.results_dir)
+        print(f"loss curves: {out}")
+    elif cmd == "profile":
+        # Captured-trace evidence for SURVEY.md §5.1: a TensorBoard-loadable
+        # jax.profiler trace of real train steps plus steady-state
+        # samples/sec from StepTimer.
+        import json
+
+        from qdml_tpu.data.datasets import DMLGridLoader
+        from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
+        from qdml_tpu.utils.profiling import StepTimer, trace
+
+        out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "results/tpu_trace")
+        loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
+        batch = next(iter(loader.epoch(0)))
+        model, state = init_hdce_state(cfg, loader.steps_per_epoch)
+        step = make_hdce_train_step(model, state.tx)
+        state, m = step(state, batch)  # compile outside the trace
+        timer = StepTimer(warmup=2)
+        n_steps = 12
+        with trace(out):
+            for _ in range(n_steps):
+                state, m = step(state, batch)
+                timer.tick(m["loss"])
+        import jax as _jax
+
+        grid = cfg.data.n_scenarios * cfg.data.n_users
+        summary = {
+            "backend": _jax.default_backend(),
+            "steps_traced": n_steps,
+            "samples_per_sec": round(
+                timer.samples_per_sec(cfg.train.batch_size * grid), 1
+            ),
+            "trace_dir": out,
+        }
+        with open(os.path.join(out, "summary.json"), "w") as fh:
+            json.dump(summary, fh, indent=2)
+        print(json.dumps(summary))
     elif cmd == "gen-data":
         from qdml_tpu.data.datasets import save_npy_cache
 
-        out = next((e.split("=", 1)[1] for e in extra), "available_data")
+        out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "available_data")
         save_npy_cache(out, cfg.data)
         print(f"wrote npy cache to {out}")
     elif cmd == "import-torch":
         from qdml_tpu.train.checkpoint import save_checkpoint
         from qdml_tpu.train.torch_interop import import_reference_dir
 
-        src = next((e.split("=", 1)[1] for e in extra), ".")
+        src = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), ".")
         trees = import_reference_dir(
             src, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db)
         )
@@ -102,7 +161,7 @@ def main(argv: list[str] | None = None) -> int:
         from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint
         from qdml_tpu.train.torch_interop import export_reference_dir
 
-        out = next((e.split("=", 1)[1] for e in extra), "torch_ckpts")
+        out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "torch_ckpts")
         kwargs = {}
         if has_checkpoint(workdir, "hdce_best"):
             kwargs["hdce_vars"], _ = restore_checkpoint(workdir, "hdce_best")

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Science phase 2: finish QSC (6q, resume), add 4q/8q runs for the Loss-Curve
+# figure, then the SNR-sweep eval and both published-figure artifacts.
+set -e
+cd /root/repo
+python -m qdml_tpu.cli train-qsc --train.workdir=runs/science --train.resume=true
+python -m qdml_tpu.cli train-qsc --train.workdir=runs/science_q4 --quantum.n_qubits=4 --train.resume=true
+python -m qdml_tpu.cli train-qsc --train.workdir=runs/science_q8 --quantum.n_qubits=8 --train.resume=true
+python -m qdml_tpu.cli eval --train.workdir=runs/science --eval.results_dir=results
+python -m qdml_tpu.cli loss-curves --eval.results_dir=results --curves="CNN (classical SC):runs/science/Pn_128/default/train-sc.metrics.jsonl,QML 4 qubits:runs/science_q4/Pn_128/default/train-qsc.metrics.jsonl,QML 6 qubits:runs/science/Pn_128/default/train-qsc.metrics.jsonl,QML 8 qubits:runs/science_q8/Pn_128/default/train-qsc.metrics.jsonl"
+echo "SCIENCE PHASE 2 DONE"

@@ -3,13 +3,14 @@
 
 import numpy as np
 
-from qdml_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
 from qdml_tpu.train.dce import train_dce
 
 
 def test_dce_trains_and_loss_decreases(tmp_path):
     cfg = ExperimentConfig(
-        data=DataConfig(data_len=128),
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=128),
+        model=ModelConfig(features=16),
         train=TrainConfig(batch_size=16, n_epochs=3),
     )
     state, history = train_dce(cfg, workdir=str(tmp_path))

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from qdml_tpu.config import DataConfig, EvalConfig, ExperimentConfig, TrainConfig
+from qdml_tpu.config import DataConfig, EvalConfig, ExperimentConfig, ModelConfig, TrainConfig
 from qdml_tpu.eval import run_snr_sweep, save_results_json
 from qdml_tpu.ops import one_hot_dispatch, select_expert
 from qdml_tpu.train.hdce import init_hdce_state
@@ -27,7 +27,8 @@ def test_select_expert_and_one_hot_agree():
 
 def _sweep_cfg():
     return ExperimentConfig(
-        data=DataConfig(data_len=64),
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=16),
         train=TrainConfig(batch_size=16, n_epochs=1),
         eval=EvalConfig(snr_grid=(5.0, 15.0), test_len=60, batch_size=30),
     )
@@ -70,3 +71,29 @@ def test_sweep_without_quantum_checkpoint():
     results = run_snr_sweep(cfg, hdce_vars, {"params": sc_state.params}, None)
     assert "hdce_quantum" not in results["nmse_db"]
     assert "quantum" not in results["acc"]
+
+
+def test_loss_curves_roundtrip(tmp_path):
+    """Loss-curve post-processing: JSONL epoch records -> figure + JSON twin."""
+    import json
+
+    from qdml_tpu.eval.loss_curves import (
+        create_loss_curve_plot,
+        parse_curve_spec,
+        read_loss_history,
+    )
+
+    p = tmp_path / "m.jsonl"
+    with open(p, "w") as fh:
+        for e, loss in enumerate([1.0, 0.5, 0.25]):
+            fh.write(json.dumps({"epoch": e, "train_loss": loss}) + "\n")
+            fh.write(json.dumps({"step": e * 10, "loss": loss}) + "\n")  # batch rec
+    assert read_loss_history(str(p)) == [1.0, 0.5, 0.25]
+    spec = parse_curve_spec(f"CNN:{p},QML 4q:{p}")
+    assert [s[0] for s in spec] == ["CNN", "QML 4q"]
+    out = create_loss_curve_plot(
+        [(label, read_loss_history(path)) for label, path in spec], str(tmp_path)
+    )
+    assert out is None or (tmp_path / "Loss_Curve.png").exists()
+    with open(tmp_path / "loss_curves.json") as fh:
+        assert json.load(fh)["CNN"] == [1.0, 0.5, 0.25]

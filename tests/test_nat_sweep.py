@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from qdml_tpu.config import DataConfig, ExperimentConfig, QuantumConfig, TrainConfig
+from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, QuantumConfig, TrainConfig
 from qdml_tpu.train.nat_sweep import (
     init_sweep,
     make_sweep_train_step,
@@ -14,7 +14,8 @@ from qdml_tpu.train.nat_sweep import (
 
 def _cfg(n_epochs=1):
     return ExperimentConfig(
-        data=DataConfig(data_len=64),
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=16),
         quantum=QuantumConfig(n_qubits=4, n_layers=2),
         train=TrainConfig(batch_size=16, n_epochs=n_epochs),
     )

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
-from qdml_tpu.config import DataConfig, ExperimentConfig, MeshConfig, TrainConfig
+from qdml_tpu.config import DataConfig, ExperimentConfig, MeshConfig, ModelConfig, TrainConfig
 from qdml_tpu.data.datasets import DMLGridLoader
 from qdml_tpu.parallel import (
     make_mesh,
@@ -33,12 +33,15 @@ def _model_mesh(k: int) -> Mesh:
 
 @pytest.mark.parametrize("n_devices", [2, 4, 8])
 def test_sharded_circuit_matches_tensor(n_devices):
+    # jit both paths: eager per-op dispatch through shard_map on the 1-CPU
+    # 8-virtual-device host costs minutes; compiled it is seconds
     n, layers = 6, 2
     rng = np.random.default_rng(n_devices)
     angles = jnp.asarray(rng.uniform(-1, 1, (5, n)).astype(np.float32))
     w = jnp.asarray(rng.uniform(-3, 3, (layers, n, 2)).astype(np.float32))
-    want = run_circuit(angles, w, n, layers, "tensor")
-    got = run_circuit_sharded(angles, w, n, layers, _model_mesh(n_devices))
+    mesh = _model_mesh(n_devices)
+    want = jax.jit(lambda a, w: run_circuit(a, w, n, layers, "tensor"))(angles, w)
+    got = jax.jit(lambda a, w: run_circuit_sharded(a, w, n, layers, mesh))(angles, w)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
 
 
@@ -48,10 +51,12 @@ def test_sharded_circuit_gradients_match():
     angles = jnp.asarray(rng.uniform(-1, 1, (3, n)).astype(np.float32))
     w = jnp.asarray(rng.uniform(-1, 1, (layers, n, 2)).astype(np.float32))
 
-    g_ref = jax.grad(lambda w: jnp.sum(run_circuit(angles, w, n, layers, "tensor") ** 2))(w)
+    g_ref = jax.jit(
+        jax.grad(lambda w: jnp.sum(run_circuit(angles, w, n, layers, "tensor") ** 2))
+    )(w)
     mesh = _model_mesh(4)
-    g_sh = jax.grad(
-        lambda w: jnp.sum(run_circuit_sharded(angles, w, n, layers, mesh) ** 2)
+    g_sh = jax.jit(
+        jax.grad(lambda w: jnp.sum(run_circuit_sharded(angles, w, n, layers, mesh) ** 2))
     )(w)
     np.testing.assert_allclose(np.asarray(g_sh), np.asarray(g_ref), rtol=1e-3, atol=1e-5)
 
@@ -109,7 +114,8 @@ def test_sharded_16q_preset_one_train_step():
 
 def _tiny_setup(batch_size=16):
     cfg = ExperimentConfig(
-        data=DataConfig(data_len=64),
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=16),
         train=TrainConfig(batch_size=batch_size, n_epochs=1),
     )
     loader = DMLGridLoader(cfg.data, batch_size)

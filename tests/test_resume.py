@@ -11,7 +11,7 @@ import dataclasses
 import jax
 import numpy as np
 
-from qdml_tpu.config import DataConfig, ExperimentConfig, TrainConfig
+from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
 from qdml_tpu.train.dce import train_dce
 from qdml_tpu.train.hdce import train_hdce
 from qdml_tpu.train.qsc import train_classifier
@@ -19,7 +19,8 @@ from qdml_tpu.train.qsc import train_classifier
 
 def _cfg(n_epochs: int, resume: bool = False) -> ExperimentConfig:
     return ExperimentConfig(
-        data=DataConfig(data_len=96),
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=96),
+        model=ModelConfig(features=16),
         train=TrainConfig(batch_size=16, n_epochs=n_epochs, resume=resume),
     )
 

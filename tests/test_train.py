@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from qdml_tpu.config import DataConfig, ExperimentConfig, TrainConfig, override
+from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig, override
 from qdml_tpu.ops import gradient_prune
 from qdml_tpu.train import (
     lr_schedule,
@@ -18,8 +18,12 @@ from qdml_tpu.train import (
 
 
 def tiny_cfg(**train_overrides) -> ExperimentConfig:
+    # reduced channel geometry (model dims derive from it) keeps the suite in
+    # its wall-clock budget on the 1-CPU host (VERDICT r1 #7); full geometry
+    # is covered by the science run and the data-contract tests
     cfg = ExperimentConfig(
-        data=DataConfig(data_len=80),
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=80),
+        model=ModelConfig(features=16),
         train=TrainConfig(batch_size=16, n_epochs=2, print_freq=1000),
     )
     for k, v in train_overrides.items():
@@ -118,8 +122,8 @@ def test_hdce_bf16_activation_path():
     from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
 
     cfg = ExperimentConfig(
-        data=DataConfig(data_len=64),
-        model=ModelConfig(dtype="bfloat16"),
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=16, dtype="bfloat16"),
         train=TrainConfig(batch_size=8, n_epochs=1),
     )
     loader = DMLGridLoader(cfg.data, 8)
