@@ -1,6 +1,8 @@
 #!/bin/bash
 # Science phase 2: finish QSC (6q, resume), add 4q/8q runs for the Loss-Curve
 # figure, then the SNR-sweep eval and both published-figure artifacts.
+# Runs on whatever backend JAX_PLATFORMS selects - the science curves are
+# backend-independent; only throughput evidence needs the chip.
 set -e
 cd /root/repo
 python -m qdml_tpu.cli train-qsc --train.workdir=runs/science --train.resume=true
