@@ -99,6 +99,12 @@ def main(argv: list[str] | None = None) -> int:
         results = run_snr_sweep(cfg, hdce_vars, sc_vars, qsc_vars)
         out_json = save_results_json(results, cfg.eval.results_dir)
         out_png = create_comparison_plots(results, cfg.eval.results_dir)
+        from qdml_tpu.eval.report import results_markdown_table
+
+        table = results_markdown_table(results)
+        with open(os.path.join(cfg.eval.results_dir, "results_table.md"), "w") as fh:
+            fh.write(table + "\n")
+        print(table)
         print(f"results: {out_json} plot: {out_png}")
     elif cmd == "loss-curves":
         from qdml_tpu.eval.loss_curves import (

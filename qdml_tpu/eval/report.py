@@ -30,6 +30,39 @@ def save_results_json(results: dict[str, Any], results_dir: str) -> str:
     return path
 
 
+# Reference-published values read off its figures (BASELINE.md) for the
+# side-by-side README table; keys match the sweep's curve names.
+_REFERENCE_PUBLISHED = {
+    "ls": {5.0: -2.2, 15.0: -12.0},
+    "mmse": {5.0: -3.5, 15.0: -13.5},
+    "hdce_classical": {5.0: -9.0, 15.0: -17.5},
+    "hdce_quantum": {5.0: -9.0, 15.0: -17.5},
+}
+_REFERENCE_ACC = {5.0: 0.79, 15.0: 0.95}
+
+
+def results_markdown_table(results: dict[str, Any]) -> str:
+    """Markdown table of NMSE (dB) per curve at each SNR vs the reference's
+    published figure values, plus classifier accuracies."""
+    snrs = results["snr"]
+    lines = [
+        "| Curve | " + " | ".join(f"{s:g} dB" for s in snrs) + " | reference @5/@15 |",
+        "|---|" + "---|" * (len(snrs) + 1),
+    ]
+    for key, vals in results["nmse_db"].items():
+        ref = _REFERENCE_PUBLISHED.get(key)
+        ref_s = f"{ref[5.0]:g} / {ref[15.0]:g}" if ref else "—"
+        row = " | ".join(f"{v:.1f}" for v in vals)
+        lines.append(f"| {_CURVE_LABELS.get(key, key)} | {row} | {ref_s} |")
+    for key, vals in results.get("acc", {}).items():
+        row = " | ".join(f"{v:.3f}" for v in vals)
+        lines.append(
+            f"| accuracy ({key} SC) | {row} | "
+            f"{_REFERENCE_ACC[5.0]:g} / {_REFERENCE_ACC[15.0]:g} |"
+        )
+    return "\n".join(lines)
+
+
 def create_comparison_plots(results: dict[str, Any], results_dir: str) -> str | None:
     """Two-panel comparison figure; returns the PNG path (None if matplotlib
     is unavailable)."""

@@ -37,9 +37,24 @@ def save_checkpoint(workdir: str, tag: str, payload: Any, meta: dict | None = No
 
 
 def restore_checkpoint(workdir: str, tag: str, target: Any | None = None) -> tuple[Any, dict]:
-    """Restore ``workdir/tag``; returns (pytree, meta dict)."""
+    """Restore ``workdir/tag``; returns (pytree, meta dict).
+
+    Device-agnostic: without a ``target`` the arrays restore as host numpy
+    (a checkpoint written on the TPU stores its device sharding, which would
+    otherwise fail to restore in a CPU process — e.g. eval on a host whose
+    accelerator tunnel is down). jax ops consume numpy leaves transparently.
+    """
     path = os.path.abspath(os.path.join(workdir, tag))
-    restored = _ckptr().restore(path, target)
+    ckptr = _ckptr()
+    if target is not None:
+        restored = ckptr.restore(path, target)
+    else:
+        import numpy as np
+
+        meta_tree = ckptr.metadata(path).item_metadata.tree
+        restored = ckptr.restore(
+            path, jax.tree.map(lambda m: np.zeros(m.shape, m.dtype), meta_tree)
+        )
     meta: dict = {}
     if os.path.exists(path + ".meta.json"):
         with open(path + ".meta.json") as fh:

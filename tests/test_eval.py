@@ -97,3 +97,17 @@ def test_loss_curves_roundtrip(tmp_path):
     assert out is None or (tmp_path / "Loss_Curve.png").exists()
     with open(tmp_path / "loss_curves.json") as fh:
         assert json.load(fh)["CNN"] == [1.0, 0.5, 0.25]
+
+
+def test_results_markdown_table():
+    from qdml_tpu.eval.report import results_markdown_table
+
+    results = {
+        "snr": [5.0, 15.0],
+        "nmse_db": {"ls": [-2.3, -12.3], "mmse": [-6.8, -13.5], "hdce_classical": [-10.0, -16.0]},
+        "acc": {"classical": [0.8, 0.95]},
+    }
+    table = results_markdown_table(results)
+    assert "| LS | -2.3 | -12.3 | -2.2 / -12 |" in table
+    assert "accuracy (classical SC)" in table
+    assert table.count("\n") >= 5
