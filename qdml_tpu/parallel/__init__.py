@@ -12,3 +12,8 @@ from qdml_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     single_device_mesh,
 )
+from qdml_tpu.parallel.multihost import (  # noqa: F401
+    init_distributed_from_env,
+    local_grid_batch_to_global,
+    process_batch_slice,
+)

@@ -1,0 +1,85 @@
+"""Multi-host SPMD: process-local data generation -> global sharded arrays.
+
+The reference's distribution never leaves one process
+(``torch.nn.DataParallel``, ``Runner_P128_QuantumNAT_onchipQNN.py:144-148``).
+The TPU-native multi-host design (SURVEY.md §5.8): every host runs the same
+program under ``jax.distributed``; the mesh spans all hosts' devices (ICI
+within a slice, DCN across slices); each host synthesizes ONLY its slice of
+the global batch (the generator is deterministic in the sample index, so no
+coordination or data exchange is needed); and
+``jax.make_array_from_process_local_data`` assembles the global ``jax.Array``
+without any host ever materializing the full batch.
+
+Single-process (tests, the one-chip dev loop) is the degenerate case: the
+local slice IS the global batch, and the assembly reduces to a device_put —
+verified equivalent in ``tests/test_parallel.py``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from qdml_tpu.parallel.dp import _pad
+
+
+def init_distributed_from_env() -> bool:
+    """``jax.distributed.initialize`` from the standard env triple
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``);
+    on TPU pods jax autodetects all three from the metadata server, so plain
+    ``initialize()`` is attempted when only a coordinator is set. Returns
+    whether a multi-process runtime was initialised (False = single process,
+    a no-op)."""
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if addr is None:
+        return False
+    try:
+        if nproc is not None and pid is not None:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=int(nproc),
+                process_id=int(pid),
+            )
+        else:
+            jax.distributed.initialize(coordinator_address=addr)
+        return jax.process_count() > 1
+    except RuntimeError:
+        return jax.process_count() > 1  # already initialised
+
+
+def process_batch_slice(global_bs: int, mesh: Mesh, axis: str = "data") -> tuple[int, int]:
+    """(start, length) of THIS process's slice of the global batch axis.
+
+    The data axis is laid out contiguously over processes (each host owns the
+    devices ``jax.local_devices()``), so with P processes each generates
+    ``global_bs / P`` consecutive sample indices of every (scenario, user)
+    cell — the deterministic index-seeded generator makes the slices globally
+    consistent with zero coordination.
+    """
+    nproc = jax.process_count()
+    if global_bs % nproc:
+        raise ValueError(f"global batch {global_bs} not divisible by {nproc} processes")
+    local = global_bs // nproc
+    return jax.process_index() * local, local
+
+
+def local_grid_batch_to_global(batch: dict, mesh: Mesh, fed: bool = False) -> dict:
+    """Assemble per-process local ``(S, U, local_B, ...)`` grid batches into
+    global arrays with B sharded over ``data`` (and optionally S over ``fed``)
+    — the multi-host twin of :func:`qdml_tpu.parallel.dp.shard_grid_batch`.
+    """
+    s_axis = "fed" if fed and mesh.shape.get("fed", 1) > 1 else None
+
+    def put(x):
+        x = np.asarray(x)
+        spec = _pad((s_axis, None, "data"), x.ndim)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(put, batch)

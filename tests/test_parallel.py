@@ -172,3 +172,25 @@ def test_tensor_parallel_head():
     batch_tp = shard_grid_batch(batch, mesh)
     _, m_tp = step(state_tp, batch_tp)
     np.testing.assert_allclose(float(m_tp["loss"]), float(m_single["loss"]), rtol=1e-5)
+
+
+def test_multihost_local_batch_assembly_degenerates_single_process():
+    """local_grid_batch_to_global on one process must equal shard_grid_batch
+    (same data, same shardings) and run the SAME train step unchanged."""
+    from qdml_tpu.parallel import local_grid_batch_to_global, process_batch_slice
+
+    cfg, state, step, batch = _tiny_setup()
+    mesh = make_mesh(MeshConfig(data_axis=-1, model_axis=1, fed_axis=1))
+    start, local = process_batch_slice(cfg.train.batch_size, mesh)
+    assert (start, local) == (0, cfg.train.batch_size)  # single process
+
+    host_np = jax.tree.map(lambda x: np.asarray(x), batch)
+    global_batch = local_grid_batch_to_global(host_np, mesh)
+    ref = shard_grid_batch(batch, mesh)
+    for a, b in zip(jax.tree.leaves(global_batch), jax.tree.leaves(ref)):
+        assert a.sharding == b.sharding
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    state_dp = replicate(state, mesh)
+    _, m = step(state_dp, global_batch)
+    assert np.isfinite(float(m["loss"]))
