@@ -28,6 +28,7 @@ import time
 
 from qdml_tpu import config as cfg_mod
 from qdml_tpu.utils.metrics import MetricsLogger
+from qdml_tpu.utils.platform import honor_platform_env
 
 
 _PASSTHROUGH = ("--out=", "--curves=")  # command args, not config overrides
@@ -44,24 +45,15 @@ def _workdir(cfg) -> str:
     return os.path.join(cfg.train.workdir, f"Pn_{cfg.data.pilot_num}", cfg.name)
 
 
-def _honor_platform_env() -> None:
-    """Make ``JAX_PLATFORMS=cpu python -m qdml_tpu.cli ...`` actually select
-    the CPU backend: the axon plugin's registration hook rewrites
-    ``jax_platforms`` to "axon,cpu" at interpreter start, so the env var
-    alone is not enough (same gotcha tests/conftest.py handles)."""
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        import jax
-
-        jax.config.update("jax_platforms", want)
-
-
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0
-    _honor_platform_env()
+    # Make JAX_PLATFORMS=cpu actually select the CPU backend (the plugin
+    # rewrites jax_platforms at interpreter start; qdml_tpu.utils.platform
+    # is the single home for the workaround).
+    honor_platform_env()
     cmd, rest = argv[0], argv[1:]
     cfg, extra = _cfg(rest)
     workdir = _workdir(cfg)

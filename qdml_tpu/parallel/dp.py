@@ -22,13 +22,22 @@ def _pad(spec: tuple, ndim: int) -> P:
     return P(*(spec + (None,) * (ndim - len(spec))))
 
 
+def grid_batch_spec(mesh: Mesh, fed: bool, ndim: int) -> P:
+    """PartitionSpec for one ``(S, U, B, ...)`` DML grid-batch leaf: B over
+    ``data``, optionally S over ``fed``. Single source for both the
+    single-process placement (:func:`shard_grid_batch`) and the multi-host
+    assembly (:func:`qdml_tpu.parallel.multihost.local_grid_batch_to_global`),
+    so the two paths cannot drift apart on the grid layout."""
+    s_axis = "fed" if fed and mesh.shape.get("fed", 1) > 1 else None
+    return _pad((s_axis, None, "data"), ndim)
+
+
 def shard_grid_batch(batch: dict, mesh: Mesh, fed: bool = False) -> dict:
     """Place a DML grid batch ``(S, U, B, ...)``: B over ``data``; optionally
     S over ``fed`` (federated training, see :mod:`qdml_tpu.parallel.federated`)."""
-    s_axis = "fed" if fed and mesh.shape.get("fed", 1) > 1 else None
 
     def put(x):
-        spec = _pad((s_axis, None, "data"), jax.numpy.ndim(x))
+        spec = grid_batch_spec(mesh, fed, jax.numpy.ndim(x))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, batch)

@@ -26,11 +26,19 @@ from qdml_tpu.config import MeshConfig
 
 
 def init_distributed(**kwargs) -> None:
-    """Multi-host init (no-op on a single process)."""
+    """Idempotent multi-host init. Delegates to
+    :func:`qdml_tpu.parallel.multihost.ensure_initialized`: benign repeat
+    calls are no-ops, but genuine coordinator failures propagate instead of
+    silently degrading a pod run to independent single-process trainings."""
+    from qdml_tpu.parallel.multihost import ensure_initialized
+
     try:
-        jax.distributed.initialize(**kwargs)
-    except (RuntimeError, ValueError):
-        pass  # already initialised or single-process
+        ensure_initialized(**kwargs)
+    except ValueError:
+        # "coordinator_address should be defined": no cluster configured —
+        # the documented single-process no-op. Coordinator *failures* are
+        # RuntimeError and still propagate.
+        pass
 
 
 def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:

@@ -22,9 +22,37 @@ import os
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
-from jax.sharding import PartitionSpec as P
 
-from qdml_tpu.parallel.dp import _pad
+from qdml_tpu.parallel.dp import grid_batch_spec
+
+
+def _runtime_initialized() -> bool:
+    """Whether ``jax.distributed`` already has a live coordination client."""
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
+def ensure_initialized(**kwargs) -> None:
+    """Idempotent ``jax.distributed.initialize``: a no-op when the runtime is
+    already live (probed, with a message-matched RuntimeError fallback in case
+    the private probe API moves), while genuine failures — unreachable
+    coordinator, barrier timeout — still propagate."""
+    if _runtime_initialized():
+        return
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # Benign repeat call. jax's message is "distributed.initialize should
+        # only be called once." (jax/_src/distributed.py); "already" covers
+        # older/newer phrasings.
+        msg = str(e).lower()
+        if "already" in msg or "only be called once" in msg:
+            return
+        raise
 
 
 def init_distributed_from_env() -> bool:
@@ -33,38 +61,60 @@ def init_distributed_from_env() -> bool:
     on TPU pods jax autodetects all three from the metadata server, so plain
     ``initialize()`` is attempted when only a coordinator is set. Returns
     whether a multi-process runtime was initialised (False = single process,
-    a no-op)."""
+    a no-op).
+
+    A genuine initialize failure (unreachable coordinator, barrier timeout)
+    propagates: swallowing it would silently degrade a pod run to N
+    independent single-process trainings on identical data."""
     addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES")
     pid = os.environ.get("JAX_PROCESS_ID")
     if addr is None:
         return False
-    try:
-        if nproc is not None and pid is not None:
-            jax.distributed.initialize(
-                coordinator_address=addr,
-                num_processes=int(nproc),
-                process_id=int(pid),
-            )
-        else:
-            jax.distributed.initialize(coordinator_address=addr)
-        return jax.process_count() > 1
-    except RuntimeError:
-        return jax.process_count() > 1  # already initialised
+    if nproc is not None and pid is not None:
+        ensure_initialized(
+            coordinator_address=addr,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+    else:
+        ensure_initialized(coordinator_address=addr)
+    return jax.process_count() > 1
 
 
 def process_batch_slice(global_bs: int, mesh: Mesh, axis: str = "data") -> tuple[int, int]:
     """(start, length) of THIS process's slice of the global batch axis.
 
-    The data axis is laid out contiguously over processes (each host owns the
-    devices ``jax.local_devices()``), so with P processes each generates
-    ``global_bs / P`` consecutive sample indices of every (scenario, user)
-    cell — the deterministic index-seeded generator makes the slices globally
-    consistent with zero coordination.
+    The contract (validated below, not assumed): the mesh lays the ``axis``
+    coordinates out process-contiguously and no OTHER mesh axis crosses a
+    process boundary — then with P processes each generates ``global_bs / P``
+    consecutive sample indices of every (scenario, user) cell, and the
+    deterministic index-seeded generator makes the slices globally consistent
+    with zero coordination. A mesh that interleaves processes along ``axis``
+    (e.g. a hybrid DCN mesh with reordered devices) would silently permute
+    the global batch, so it is rejected here.
     """
     nproc = jax.process_count()
     if global_bs % nproc:
         raise ValueError(f"global batch {global_bs} not divisible by {nproc} processes")
+    if nproc > 1:
+        rows = np.moveaxis(mesh.devices, list(mesh.axis_names).index(axis), 0)
+        n_coord = rows.shape[0]
+        if n_coord % nproc:
+            raise ValueError(
+                f"mesh axis {axis!r} has {n_coord} coordinates over {nproc} "
+                "processes — uneven ownership breaks the equal per-process "
+                "slice contract"
+            )
+        for i in range(n_coord):
+            procs = {d.process_index for d in rows[i].flat}
+            expect = {i * nproc // n_coord}
+            if procs != expect:
+                raise ValueError(
+                    f"mesh axis {axis!r} is not process-contiguous: coordinate "
+                    f"{i} lives on processes {sorted(procs)}, expected {expect} "
+                    "— process-local generation would permute the global batch"
+                )
     local = global_bs // nproc
     return jax.process_index() * local, local
 
@@ -72,14 +122,13 @@ def process_batch_slice(global_bs: int, mesh: Mesh, axis: str = "data") -> tuple
 def local_grid_batch_to_global(batch: dict, mesh: Mesh, fed: bool = False) -> dict:
     """Assemble per-process local ``(S, U, local_B, ...)`` grid batches into
     global arrays with B sharded over ``data`` (and optionally S over ``fed``)
-    — the multi-host twin of :func:`qdml_tpu.parallel.dp.shard_grid_batch`.
+    — the multi-host twin of :func:`qdml_tpu.parallel.dp.shard_grid_batch`
+    (both derive their layout from :func:`qdml_tpu.parallel.dp.grid_batch_spec`).
     """
-    s_axis = "fed" if fed and mesh.shape.get("fed", 1) > 1 else None
 
     def put(x):
         x = np.asarray(x)
-        spec = _pad((s_axis, None, "data"), x.ndim)
-        sharding = NamedSharding(mesh, spec)
+        sharding = NamedSharding(mesh, grid_batch_spec(mesh, fed, x.ndim))
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree.map(put, batch)

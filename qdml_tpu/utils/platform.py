@@ -1,0 +1,65 @@
+"""Backend-platform pinning helpers.
+
+The TPU accelerator plugin's registration hook rewrites jax's
+``jax_platforms`` config to "axon,cpu" at interpreter start, so setting the
+``JAX_PLATFORMS`` env var alone does not pin a backend — the config value
+must be re-applied after ``import jax`` and before the first backend init.
+This is the single home for that workaround (used by the CLI, the test
+conftest, and the driver entry's multi-chip dryrun).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Re-apply ``JAX_PLATFORMS`` from the environment to jax's config so an
+    explicit env choice (e.g. ``JAX_PLATFORMS=cpu``) actually selects that
+    backend. No-op when the env var is unset."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
+def backend_initialized() -> bool:
+    """True once jax has committed to a backend (after which neither the
+    platform nor the virtual device count can be changed)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        # Private API moved: report "not initialized" so callers still
+        # attempt the pin. The site hook pre-imports jax in every process, so
+        # any sys.modules-based fallback would be always-True and turn
+        # force_cpu into a silent permanent no-op; a pin attempted too late
+        # instead fails loudly at the caller's device-count check.
+        return False
+
+
+def force_cpu(n_virtual_devices: int | None = None) -> bool:
+    """Pin the CPU platform (optionally with N virtual devices) if the
+    backend choice is still open. Returns True when the pin was applied.
+
+    Must be called before any jax computation; safe to call when jax is
+    already imported, since the plugin pre-imports jax at interpreter start
+    without initializing a backend.
+    """
+    if backend_initialized():
+        return False
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_virtual_devices is not None:
+        # Replace (not merely append to) any ambient device-count flag: a
+        # stale count would surface later as an opaque mesh reshape error.
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_virtual_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    honor_platform_env()
+    return True

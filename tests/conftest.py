@@ -2,19 +2,14 @@
 
 This is the standard JAX way to test pjit/psum/mesh logic without a real pod
 (SURVEY.md §4): multi-chip sharding tests see an 8-device mesh backed by host
-CPU. Must run before any ``import jax`` in test modules.
+CPU. Must run before any backend init; the pinning itself (env var + config
+update, because the TPU plugin rewrites ``jax_platforms`` at interpreter
+start) lives in :mod:`qdml_tpu.utils.platform`.
 """
 
-import os
+from qdml_tpu.utils.platform import force_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)
 
 # Persistent compilation cache: the suite is dominated by XLA CPU compiles of
 # the same jitted steps across test files; caching them on disk makes repeat

@@ -194,3 +194,16 @@ def test_multihost_local_batch_assembly_degenerates_single_process():
     state_dp = replicate(state, mesh)
     _, m = step(state_dp, global_batch)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_platform_helpers():
+    """conftest pinned CPU via force_cpu(8); once a backend is live the pin
+    reports inapplicable instead of silently half-applying."""
+    import jax
+
+    from qdml_tpu.utils.platform import backend_initialized, force_cpu
+
+    assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
+    assert backend_initialized()
+    assert force_cpu(4) is False  # too late to repin — and says so
+    assert len(jax.devices()) == 8
