@@ -207,3 +207,54 @@ def test_platform_helpers():
     assert backend_initialized()
     assert force_cpu(4) is False  # too late to repin — and says so
     assert len(jax.devices()) == 8
+
+
+def test_ensure_initialized_idempotent_and_strict(monkeypatch):
+    """Benign repeat-init messages are swallowed; genuine coordinator
+    failures propagate (a pod run must not silently degrade to independent
+    single-process trainings)."""
+    from qdml_tpu.parallel import multihost
+
+    monkeypatch.setattr(multihost, "_runtime_initialized", lambda: False)
+
+    calls = []
+
+    def fake_init(**kw):
+        calls.append(kw)
+        raise RuntimeError("jax.distributed.initialize should only be called once.")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    multihost.ensure_initialized(coordinator_address="h:1")  # no raise
+    assert calls
+
+    def fail_init(**kw):
+        raise RuntimeError("barrier timed out waiting for coordinator")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fail_init)
+    with pytest.raises(RuntimeError, match="barrier"):
+        multihost.ensure_initialized(coordinator_address="h:1")
+
+
+def test_process_batch_slice_rejects_interleaved_mesh(monkeypatch):
+    """The process-contiguity contract is validated, not assumed: a mesh that
+    interleaves processes along the data axis (as a hybrid DCN layout can)
+    would silently permute the global batch, so it must be rejected."""
+    from types import SimpleNamespace
+
+    from qdml_tpu.parallel.multihost import process_batch_slice
+
+    def fake_mesh(proc_of_coord):
+        devs = np.array(
+            [[SimpleNamespace(process_index=p)] for p in proc_of_coord], dtype=object
+        )
+        return SimpleNamespace(devices=devs, axis_names=("data", "model"))
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+
+    start, local = process_batch_slice(8, fake_mesh([0, 0, 1, 1]))
+    assert (start, local) == (4, 4)
+    with pytest.raises(ValueError, match="not process-contiguous"):
+        process_batch_slice(8, fake_mesh([0, 1, 0, 1]))
+    with pytest.raises(ValueError, match="uneven|coordinates"):
+        process_batch_slice(8, fake_mesh([0, 0, 1]))
