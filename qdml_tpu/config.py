@@ -89,6 +89,9 @@ class QuantumConfig:
     # for n<=10), "tensor" applies gates on the (2,)*n tensor (n<=14),
     # "sharded" partitions the statevector over the mesh (n>=14).
     backend: str = "dense"
+    # Per-sample RMS input normalization (scale-invariant angle encoding;
+    # fixes low-SNR collapse of the raw-pilot QSC). OFF = reference parity.
+    input_norm: bool = False
 
 
 # ---------------------------------------------------------------------------

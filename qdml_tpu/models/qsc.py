@@ -41,10 +41,21 @@ class QSCP128(nn.Module):
     use_quantumnat: bool = False   # reference ships with this OFF (Runner...py:313-316)
     noise_level: float = 0.01      # QuantumNAT sigma (Estimators...py:118)
     backend: str = "dense"
+    # Per-sample RMS normalization of the pilot image before the CNN. OFF by
+    # default (reference parity: QSC_P128 consumes raw pilots). The raw-pilot
+    # angle encoding is scale-sensitive — a classifier trained at SNR 10
+    # collapses at SNR 5 (0.45 vs the classical CNN's 0.88 accuracy in
+    # results/quantum_classical_comparison.json) because the input power
+    # shift pushes the tanh angles off their trained range; normalizing makes
+    # the encoding scale-invariant.
+    input_norm: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.input_norm:
+            rms = jnp.sqrt(jnp.mean(x**2, axis=(1, 2, 3), keepdims=True) + 1e-12)
+            x = x / rms
         angles = QSCPreprocess(self.n_qubits, dtype=self.dtype)(x)
 
         # PennyLane TorchLayer initialises circuit weights uniform in [0, 2pi).

@@ -53,6 +53,7 @@ def build_sweep_model(cfg: ExperimentConfig) -> QSCP128:
         n_classes=cfg.quantum.n_classes,
         use_quantumnat=False,
         backend=cfg.quantum.backend,
+        input_norm=cfg.quantum.input_norm,
     )
 
 

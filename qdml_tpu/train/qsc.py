@@ -41,6 +41,7 @@ def build_classifier(cfg: ExperimentConfig, quantum: bool) -> nn.Module:
             use_quantumnat=cfg.quantum.use_quantumnat,
             noise_level=cfg.quantum.noise_level,
             backend=cfg.quantum.backend,
+            input_norm=cfg.quantum.input_norm,
         )
     return SCP128(n_classes=cfg.quantum.n_classes)
 

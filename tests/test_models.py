@@ -93,3 +93,14 @@ def test_losses():
         float(nll_loss(lp, labels)), -(np.log(0.7) + np.log(0.8)) / 2, rtol=1e-6
     )
     assert float(accuracy(lp, labels)) == 1.0
+
+
+def test_qsc_input_norm_scale_invariant():
+    """With input_norm the log-probs are invariant to input power — the
+    low-SNR robustness property the raw-pilot encoding lacks."""
+    m = QSCP128(n_qubits=4, n_layers=2, input_norm=True)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 16, 8, 2)), jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(
+        np.asarray(m.apply(v, x)), np.asarray(m.apply(v, 7.5 * x)), rtol=1e-4
+    )
