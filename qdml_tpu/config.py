@@ -87,7 +87,9 @@ class QuantumConfig:
     noise_sweep: tuple[float, ...] = (0.0, 0.01, 0.05, 0.1)
     # simulator backend: "dense" builds per-layer unitaries (MXU matmuls, best
     # for n<=10), "tensor" applies gates on the (2,)*n tensor (n<=14),
-    # "sharded" partitions the statevector over the mesh (n>=14).
+    # "sharded" partitions the statevector over the mesh (n>=14), "auto"
+    # picks dense/tensor by qubit count; plus "pallas"/"pallas_tensor"
+    # kernel paths (see qdml_tpu.quantum.circuits.VALID_BACKENDS).
     backend: str = "dense"
     # Per-sample RMS input normalization (scale-invariant angle encoding;
     # fixes low-SNR collapse of the raw-pilot QSC). OFF = reference parity.

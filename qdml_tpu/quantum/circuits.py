@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from qdml_tpu.quantum import statevector as sv
 from qdml_tpu.utils.complexops import CArr, ceinsum, ckron
 
-VALID_BACKENDS = ("tensor", "dense", "sharded", "pallas", "pallas_tensor")
+VALID_BACKENDS = ("auto", "tensor", "dense", "sharded", "pallas", "pallas_tensor")
 
 
 def rot_gate(w_ry: jnp.ndarray, w_rz: jnp.ndarray) -> CArr:
@@ -95,6 +95,13 @@ def run_circuit(
     backend: str = "dense",
 ) -> jnp.ndarray:
     """Full reference circuit: angles (..., n) -> per-wire <Z> (..., n)."""
+    if backend == "auto":
+        # Pick by qubit count: the dense per-ansatz unitary (MXU matmuls) wins
+        # up to ~10 qubits; past that its 2^n x 2^n build dominates and the
+        # gate-wise tensor path wins; from ~14 qubits the statevector should
+        # be mesh-sharded instead (select "sharded" explicitly — it needs a
+        # multi-device mesh this helper cannot assume).
+        backend = "dense" if n_qubits <= 10 else "tensor"
     psi = sv.zero_state(n_qubits, angles.shape[:-1])
     psi = angle_embed(psi, angles, n_qubits)
     if backend == "tensor":

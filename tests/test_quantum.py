@@ -186,3 +186,17 @@ def test_jit_and_vmap_compose():
         rtol=1e-4,
         atol=1e-5,
     )
+
+
+def test_auto_backend_matches_explicit():
+    """backend="auto" picks a working path at both small and mid n and agrees
+    with the tensor reference."""
+    rng = np.random.default_rng(7)
+    # At n=4 auto resolves to "dense", at n=11 to "tensor" — comparing each
+    # against the OTHER explicit path keeps both assertions cross-path.
+    for n, other in ((4, "tensor"), (11, "dense")):
+        angles = jnp.asarray(rng.uniform(-1, 1, (2, n)).astype(np.float32))
+        w = jnp.asarray(rng.uniform(0, 2 * np.pi, (1, n, 2)).astype(np.float32))
+        a = run_circuit(angles, w, n, 1, "auto")
+        b = run_circuit(angles, w, n, 1, other)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
