@@ -87,7 +87,10 @@ def main(argv: list[str] | None = None) -> int:
         sc_vars, _ = restore_checkpoint(workdir, "sc_best")
         qsc_vars = None
         if has_checkpoint(workdir, "qsc_best"):  # graceful fallback (Test.py:81-86)
-            qsc_vars, _ = restore_checkpoint(workdir, "qsc_best")
+            from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
+
+            qsc_vars, qsc_meta = restore_checkpoint(workdir, "qsc_best")
+            cfg = reconcile_quantum_cfg(cfg, qsc_meta)
         results = run_snr_sweep(cfg, hdce_vars, sc_vars, qsc_vars)
         out_json = save_results_json(results, cfg.eval.results_dir)
         out_png = create_comparison_plots(results, cfg.eval.results_dir)
@@ -166,7 +169,20 @@ def main(argv: list[str] | None = None) -> int:
             src, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db)
         )
         for name, tree in trees.items():
-            save_checkpoint(workdir, f"{name}_best", tree, {"source": src})
+            meta: dict = {"source": src}
+            if name == "qsc":
+                # Architecture facts from the imported params themselves so
+                # eval rebuilds the right model (reference QSCs are raw-pilot:
+                # no input normalization).
+                qw = tree["params"]["qweights"]
+                meta["quantum"] = {
+                    "n_qubits": int(qw.shape[1]),
+                    "n_layers": int(qw.shape[0]),
+                    "n_classes": int(tree["params"]["Dense_0"]["bias"].shape[0]),
+                    "backend": cfg.quantum.backend,
+                    "input_norm": False,
+                }
+            save_checkpoint(workdir, f"{name}_best", tree, meta)
         print(f"imported {sorted(trees)} from {src} -> {workdir}")
     elif cmd == "export-torch":
         from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint

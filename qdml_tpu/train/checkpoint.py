@@ -23,6 +23,28 @@ def _ckptr() -> ocp.StandardCheckpointer:
     return ocp.StandardCheckpointer()
 
 
+def reconcile_quantum_cfg(cfg, meta: dict):
+    """Rebuild the quantum-model config a checkpoint was trained for.
+
+    QSC checkpoints store their architecture facts in ``meta['quantum']``
+    (n_qubits/n_layers/n_classes/backend/input_norm). Flags like
+    ``input_norm`` carry no params of their own, so evaluating with a
+    mismatched config would silently change behavior; shape-bearing fields
+    would crash later with an opaque error. Every qsc-checkpoint consumer
+    should pass its restored meta through here. No-op when the checkpoint
+    predates the meta (or came from a source that has none)."""
+    import dataclasses
+
+    stored = (meta or {}).get("quantum")
+    if not stored:
+        return cfg
+    mismatch = {k: v for k, v in stored.items() if getattr(cfg.quantum, k) != v}
+    if mismatch:
+        print(f"using checkpoint quantum config {mismatch}")
+        cfg = dataclasses.replace(cfg, quantum=dataclasses.replace(cfg.quantum, **mismatch))
+    return cfg
+
+
 def save_checkpoint(workdir: str, tag: str, payload: Any, meta: dict | None = None) -> str:
     """Save a pytree under ``workdir/tag`` (tag in {'best', 'last', ...})."""
     path = os.path.abspath(os.path.join(workdir, tag))

@@ -149,6 +149,17 @@ def train_classifier(
 
         if workdir is not None:
             meta = {"epoch": epoch, "val_acc": val_acc, "name": cfg.name}
+            if quantum:
+                # Architecture facts eval needs to rebuild the model the
+                # params were trained for (input_norm has no params of its
+                # own, so a mismatch would otherwise be silent).
+                meta["quantum"] = {
+                    "n_qubits": cfg.quantum.n_qubits,
+                    "n_layers": cfg.quantum.n_layers,
+                    "n_classes": cfg.quantum.n_classes,
+                    "backend": cfg.quantum.backend,
+                    "input_norm": cfg.quantum.input_norm,
+                }
             if val_acc > best_acc:
                 best_acc = val_acc
                 save_checkpoint(workdir, f"{tag}_best", {"params": state.params}, meta)

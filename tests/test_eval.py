@@ -111,3 +111,16 @@ def test_results_markdown_table():
     assert "| LS | -2.3 | -12.3 | -2.2 / -12 |" in table
     assert "accuracy (classical SC)" in table
     assert table.count("\n") >= 5
+
+
+def test_reconcile_quantum_cfg():
+    from qdml_tpu.config import ExperimentConfig
+    from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
+
+    cfg = ExperimentConfig()
+    assert reconcile_quantum_cfg(cfg, {}) is cfg  # no meta: unchanged
+    out = reconcile_quantum_cfg(
+        cfg, {"quantum": {"n_qubits": 4, "input_norm": True}}
+    )
+    assert out.quantum.n_qubits == 4 and out.quantum.input_norm is True
+    assert out.quantum.n_layers == cfg.quantum.n_layers  # untouched field
