@@ -54,6 +54,12 @@ def main(argv: list[str] | None = None) -> int:
     # rewrites jax_platforms at interpreter start; qdml_tpu.utils.platform
     # is the single home for the workaround).
     honor_platform_env()
+    # Multi-host: jax.distributed must initialize BEFORE any JAX computation
+    # touches the backend (loaders/model init do); no-op without
+    # JAX_COORDINATOR_ADDRESS.
+    from qdml_tpu.parallel.multihost import init_distributed_from_env
+
+    init_distributed_from_env()
     cmd, rest = argv[0], argv[1:]
     cfg, extra = _cfg(rest)
     workdir = _workdir(cfg)

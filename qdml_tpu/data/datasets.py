@@ -120,9 +120,26 @@ class DMLGridLoader:
         self.index_base, self.n = _resolve_split(cfg, split)
         self.batch_size = batch_size = min(batch_size, self.n)
         self.steps_per_epoch = self.n // batch_size
+        self._pslice: tuple[int, int] | None = None
         s, u = cfg.n_scenarios, cfg.n_users
         self._scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, batch_size))
         self._user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, batch_size))
+
+    def set_process_slice(self, start: int, length: int) -> None:
+        """Multi-host data path: generate only ``[start, start+length)`` of
+        each global batch window — every host synthesizes its own slice and
+        the global array is assembled by
+        :func:`qdml_tpu.parallel.multihost.local_grid_batch_to_global`, so no
+        host ever materializes the full batch."""
+        if not (0 <= start and start + length <= self.batch_size):
+            raise ValueError(
+                f"process slice [{start}, {start + length}) outside batch "
+                f"window of {self.batch_size}"
+            )
+        s, u = self.cfg.n_scenarios, self.cfg.n_users
+        self._pslice = (start, length)
+        self._scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, length))
+        self._user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, length))
 
     def _step_snr(self, epoch: int, step: int) -> float:
         """Per-step training SNR: fixed ``cfg.snr_db`` (reference protocol,
@@ -140,7 +157,11 @@ class DMLGridLoader:
         bs = self.batch_size
         perms = _epoch_perms(self.cfg, self.n, self.index_base, epoch, shuffle)
         for step in range(self.steps_per_epoch):
-            idx = jnp.asarray(perms[:, :, step * bs : (step + 1) * bs])
+            window = perms[:, :, step * bs : (step + 1) * bs]
+            if self._pslice is not None:
+                p0, plen = self._pslice
+                window = window[:, :, p0 : p0 + plen]
+            idx = jnp.asarray(window)
             # jitter applies to shuffled (training) epochs only: validation
             # iterates with shuffle=False and stays at the fixed cfg.snr_db
             snr = self._step_snr(epoch, step) if shuffle else float(self.cfg.snr_db)

@@ -54,4 +54,9 @@ def shard_flat_batch(batch: Any, mesh: Mesh) -> Any:
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Fully replicate a pytree over the mesh (params, opt state for pure DP)."""
+    if jax.process_count() > 1:
+        # device_put rejects non-addressable shardings; a jitted identity
+        # with out_shardings is the multi-controller way to place state.
+        sharding = NamedSharding(mesh, P())
+        return jax.jit(lambda t: t, out_shardings=jax.tree.map(lambda _: sharding, tree))(tree)
     return jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)

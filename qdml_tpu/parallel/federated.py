@@ -62,4 +62,8 @@ def shard_hdce_state(
     state: TrainState, mesh: Mesh, n_scenarios: int = 3, tensor_parallel: bool = False
 ) -> TrainState:
     shardings = hdce_state_shardings(state, mesh, n_scenarios, tensor_parallel)
+    if jax.process_count() > 1:
+        # device_put rejects non-addressable shardings; a jitted identity
+        # with out_shardings is the multi-controller way to place state.
+        return jax.jit(lambda s: s, out_shardings=shardings)(state)
     return jax.tree.map(jax.device_put, state, shardings)

@@ -62,5 +62,44 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
     return Mesh(arr, (cfg.fed_axis_name, cfg.data_axis_name, cfg.model_axis_name))
 
 
+def training_mesh(cfg) -> Mesh | None:
+    """Mesh for the production trainers, or ``None`` on a single device.
+
+    Validates the layout against the run's geometry up front so a bad
+    combination fails with a clear message instead of an opaque device_put
+    error mid-epoch: the batch must split evenly over the ``data`` axis and a
+    federated axis must match the scenario count exactly.
+
+    Multi-process runs must call
+    :func:`qdml_tpu.parallel.multihost.init_distributed_from_env` BEFORE any
+    JAX computation (the CLI does this at startup) — jax.distributed cannot
+    be initialized once the XLA backend is live, and by the time a trainer
+    reaches this function its loaders/model init have already touched jax.
+    """
+    names = (cfg.mesh.fed_axis_name, cfg.mesh.data_axis_name, cfg.mesh.model_axis_name)
+    if names != ("fed", "data", "model"):
+        raise ValueError(
+            f"mesh axis names are fixed to ('fed', 'data', 'model'); got {names} — "
+            "the sharding specs in qdml_tpu.parallel use the names literally"
+        )
+    devices = jax.devices()
+    if len(devices) == 1:
+        return None
+    mesh = make_mesh(cfg.mesh, devices)
+    data = mesh.shape[cfg.mesh.data_axis_name]
+    if cfg.train.batch_size % data:
+        raise ValueError(
+            f"batch_size {cfg.train.batch_size} not divisible by the mesh "
+            f"data axis ({data}); adjust train.batch_size or mesh.data_axis"
+        )
+    fed = mesh.shape[cfg.mesh.fed_axis_name]
+    if fed > 1 and fed != cfg.data.n_scenarios:
+        raise ValueError(
+            f"mesh fed axis ({fed}) must equal data.n_scenarios "
+            f"({cfg.data.n_scenarios}) to shard the scenario grid"
+        )
+    return mesh
+
+
 def single_device_mesh() -> Mesh:
     return Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1), ("fed", "data", "model"))

@@ -119,6 +119,49 @@ def process_batch_slice(global_bs: int, mesh: Mesh, axis: str = "data") -> tuple
     return jax.process_index() * local, local
 
 
+def make_grid_placer(loader, mesh: Mesh | None, fed: bool = False):
+    """Batch-placement policy shared by the production trainers.
+
+    Returns a callable ``batch -> batch`` for one ``DMLGridLoader``:
+
+    - no mesh: identity (single-device);
+    - batch divides the ``data`` axis: the multi-host assembly path — under
+      multiple processes the loader is switched to per-process slice
+      generation first (:meth:`DMLGridLoader.set_process_slice`), and
+      single-process degenerates to a plain sharded device_put (equivalence
+      covered in ``tests/test_parallel.py``);
+    - batch does NOT divide (split-clamped tiny validation loaders): stay
+      host-side replicated on one process — and refuse outright on several,
+      where replicated placement cannot work.
+    """
+    if mesh is None:
+        return lambda b: b
+    bs = loader.batch_size
+    data = mesh.shape["data"]
+    nproc = jax.process_count()
+    if bs % data:
+        if nproc > 1:
+            raise ValueError(
+                f"batch {bs} (split-clamped) not divisible by the mesh data "
+                f"axis ({data}); cannot place it on a multi-process mesh"
+            )
+        print(
+            f"note: batch {bs} not divisible by mesh data axis ({data}); "
+            "running this loader replicated (no data parallelism)"
+        )
+        return lambda b: b
+    if nproc == 1:
+        # Plain sharded device_put: batches are already on-device jitted
+        # outputs; the process-local assembly path below would round-trip
+        # them through host numpy every step for nothing.
+        from qdml_tpu.parallel.dp import shard_grid_batch
+
+        return lambda b: shard_grid_batch(b, mesh, fed=fed)
+    start, local = process_batch_slice(bs, mesh)
+    loader.set_process_slice(start, local)
+    return lambda b: local_grid_batch_to_global(b, mesh, fed=fed)
+
+
 def local_grid_batch_to_global(batch: dict, mesh: Mesh, fed: bool = False) -> dict:
     """Assemble per-process local ``(S, U, local_B, ...)`` grid batches into
     global arrays with B sharded over ``data`` (and optionally S over ``fed``)

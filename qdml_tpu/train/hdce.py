@@ -176,11 +176,31 @@ def train_hdce(
         state, start_epoch, rmeta = try_resume(workdir, "hdce_resume", state)
         best = float(rmeta.get("best", best))  # don't clobber a better *_best
 
+    # Multi-device: place state and batches over the (fed, data, model) mesh;
+    # the jitted step then runs SPMD (computation follows the shardings, XLA
+    # inserts the collectives). Under multiple processes the placer switches
+    # the loaders to per-host slice generation. Single device: no-op.
+    from qdml_tpu.parallel.federated import shard_hdce_state
+    from qdml_tpu.parallel.mesh import training_mesh
+    from qdml_tpu.parallel.multihost import make_grid_placer
+
+    mesh = training_mesh(cfg)
+    if mesh is not None:
+        state = shard_hdce_state(
+            state,
+            mesh,
+            n_scenarios=cfg.data.n_scenarios,
+            tensor_parallel=mesh.shape[cfg.mesh.model_axis_name] > 1,
+        )
+    fed = mesh is not None and mesh.shape[cfg.mesh.fed_axis_name] > 1
+    place_train = make_grid_placer(train_loader, mesh, fed=fed)
+    place_val = make_grid_placer(val_loader, mesh, fed=fed)
+
     history: dict[str, list] = {"train_loss": [], "val_nmse": [], "val_nmse_perf": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
         for batch in train_loader.epoch(epoch):
-            state, m = train_step(state, batch)
+            state, m = train_step(state, place_train(batch))
             tot, n = tot + float(m["loss"]), n + 1
             if n % cfg.train.print_freq == 0:
                 logger.log(step=int(state.step), epoch=epoch, loss=float(m["loss"]))
@@ -188,7 +208,7 @@ def train_hdce(
 
         sums = {"err": 0.0, "pow": 0.0, "err_perf": 0.0, "pow_perf": 0.0}
         for batch in val_loader.epoch(epoch, shuffle=False):
-            out = eval_step(state, batch)
+            out = eval_step(state, place_val(batch))
             for k in sums:
                 sums[k] += float(out[k])
         val_nmse = sums["err"] / max(sums["pow"], 1e-30)

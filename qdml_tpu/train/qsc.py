@@ -123,6 +123,19 @@ def train_classifier(
         state, start_epoch, rmeta = try_resume(workdir, f"{tag}_resume", state)
         best_acc = float(rmeta.get("best", best_acc))
 
+    # Multi-device: replicate params, shard batches over the data axis (the
+    # statevector itself shards only under the "sharded" backend). Same
+    # placement policy as train_hdce (qdml_tpu.parallel.multihost).
+    from qdml_tpu.parallel.dp import replicate
+    from qdml_tpu.parallel.mesh import training_mesh
+    from qdml_tpu.parallel.multihost import make_grid_placer
+
+    mesh = training_mesh(cfg)
+    if mesh is not None:
+        state = replicate(state, mesh)
+    place_train = make_grid_placer(train_loader, mesh)
+    place_val = make_grid_placer(val_loader, mesh)
+
     # Fold the start epoch into the QuantumNAT noise stream so resumed epochs
     # draw FRESH noise instead of replaying epochs 0..start_epoch-1's draws.
     rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), start_epoch)
@@ -131,13 +144,13 @@ def train_classifier(
         tot, n = 0.0, 0
         for batch in train_loader.epoch(epoch):
             rng, sub = jax.random.split(rng)
-            state, m = train_step(state, batch, sub)
+            state, m = train_step(state, place_train(batch), sub)
             tot, n = tot + float(m["loss"]), n + 1
         train_loss = tot / max(n, 1)
 
         sums = {"nll_sum": 0.0, "correct": 0.0, "count": 0.0}
         for batch in val_loader.epoch(epoch, shuffle=False):
-            out = eval_step(state, batch)
+            out = eval_step(state, place_val(batch))
             for k in sums:
                 sums[k] += float(out[k])
         val_loss = sums["nll_sum"] / max(sums["count"], 1)

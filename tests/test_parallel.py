@@ -258,3 +258,23 @@ def test_process_batch_slice_rejects_interleaved_mesh(monkeypatch):
         process_batch_slice(8, fake_mesh([0, 1, 0, 1]))
     with pytest.raises(ValueError, match="uneven|coordinates"):
         process_batch_slice(8, fake_mesh([0, 0, 1]))
+
+
+def test_training_mesh_validation():
+    """training_mesh builds a mesh on multi-device hosts and rejects layouts
+    that would fail mid-epoch with opaque errors."""
+    import dataclasses
+
+    from qdml_tpu.parallel.mesh import training_mesh
+
+    cfg = ExperimentConfig(train=TrainConfig(batch_size=16))
+    mesh = training_mesh(cfg)
+    assert mesh is not None and mesh.shape["data"] == 8
+
+    bad_bs = dataclasses.replace(cfg, train=TrainConfig(batch_size=12))
+    with pytest.raises(ValueError, match="not divisible"):
+        training_mesh(bad_bs)
+
+    bad_fed = dataclasses.replace(cfg, mesh=MeshConfig(fed_axis=2))
+    with pytest.raises(ValueError, match="n_scenarios"):
+        training_mesh(bad_fed)
