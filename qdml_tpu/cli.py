@@ -55,11 +55,14 @@ def main(argv: list[str] | None = None) -> int:
     # is the single home for the workaround).
     honor_platform_env()
     # Multi-host: jax.distributed must initialize BEFORE any JAX computation
-    # touches the backend (loaders/model init do); no-op without
-    # JAX_COORDINATOR_ADDRESS.
+    # touches the backend (loaders/model init do). Explicit env triple first;
+    # otherwise pod autodetection (fails fast with a swallowed ValueError on
+    # a non-cluster host, so plain single-host runs are unaffected).
+    from qdml_tpu.parallel.mesh import init_distributed
     from qdml_tpu.parallel.multihost import init_distributed_from_env
 
-    init_distributed_from_env()
+    if not init_distributed_from_env():
+        init_distributed()
     cmd, rest = argv[0], argv[1:]
     cfg, extra = _cfg(rest)
     workdir = _workdir(cfg)

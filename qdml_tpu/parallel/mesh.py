@@ -65,16 +65,15 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
 def training_mesh(cfg) -> Mesh | None:
     """Mesh for the production trainers, or ``None`` on a single device.
 
-    Validates the layout against the run's geometry up front so a bad
-    combination fails with a clear message instead of an opaque device_put
-    error mid-epoch: the batch must split evenly over the ``data`` axis and a
-    federated axis must match the scenario count exactly.
+    Validates what is knowable up front with clear messages (axis names, the
+    federated axis vs the scenario count); batch divisibility is judged
+    per-loader by :func:`qdml_tpu.parallel.multihost.make_grid_placer`, which
+    sees the split-clamped batch size this function cannot know.
 
-    Multi-process runs must call
-    :func:`qdml_tpu.parallel.multihost.init_distributed_from_env` BEFORE any
-    JAX computation (the CLI does this at startup) — jax.distributed cannot
-    be initialized once the XLA backend is live, and by the time a trainer
-    reaches this function its loaders/model init have already touched jax.
+    Multi-process runs must initialize ``jax.distributed`` BEFORE any JAX
+    computation (the CLI does this at startup) — it cannot be initialized
+    once the XLA backend is live, and by the time a trainer reaches this
+    function its loaders/model init have already touched jax.
     """
     names = (cfg.mesh.fed_axis_name, cfg.mesh.data_axis_name, cfg.mesh.model_axis_name)
     if names != ("fed", "data", "model"):
@@ -86,12 +85,6 @@ def training_mesh(cfg) -> Mesh | None:
     if len(devices) == 1:
         return None
     mesh = make_mesh(cfg.mesh, devices)
-    data = mesh.shape[cfg.mesh.data_axis_name]
-    if cfg.train.batch_size % data:
-        raise ValueError(
-            f"batch_size {cfg.train.batch_size} not divisible by the mesh "
-            f"data axis ({data}); adjust train.batch_size or mesh.data_axis"
-        )
     fed = mesh.shape[cfg.mesh.fed_axis_name]
     if fed > 1 and fed != cfg.data.n_scenarios:
         raise ValueError(

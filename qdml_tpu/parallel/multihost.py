@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
@@ -170,8 +171,10 @@ def local_grid_batch_to_global(batch: dict, mesh: Mesh, fed: bool = False) -> di
     """
 
     def put(x):
-        x = np.asarray(x)
-        sharding = NamedSharding(mesh, grid_batch_spec(mesh, fed, x.ndim))
+        # Pass jax arrays straight through — np.asarray would force a
+        # device-to-host transfer of every leaf every step; the assembly
+        # slices device-to-device where it can.
+        sharding = NamedSharding(mesh, grid_batch_spec(mesh, fed, jnp.ndim(x)))
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree.map(put, batch)

@@ -50,9 +50,11 @@ def save_checkpoint(workdir: str, tag: str, payload: Any, meta: dict | None = No
     path = os.path.abspath(os.path.join(workdir, tag))
     payload = jax.tree.map(lambda x: x, payload)  # shallow copy
     ckptr = _ckptr()
+    # Multi-host: orbax coordinates the array save across processes itself;
+    # the plain-JSON sidecar must be written by exactly one.
     ckptr.save(path, payload, force=True)
     ckptr.wait_until_finished()
-    if meta is not None:
+    if meta is not None and jax.process_index() == 0:
         with open(path + ".meta.json", "w") as fh:
             json.dump(meta, fh)
     return path

@@ -6,9 +6,10 @@ different ``noise_level`` kwarg (``Estimators_QuantumNAT_onchipQNN.py:118``) —
 one sequential GPU run per level. TPU-native: every noise level is an ensemble
 member with its own (params, optimizer state, PRNG stream); ONE jitted,
 ``vmap``-ed train step advances all members simultaneously — the member axis
-batches the CNN convs and the circuit matmuls onto the MXU, and under a mesh
-the same axis shards over ``data`` devices (each device trains a slice of the
-ensemble: embarrassingly parallel, zero collectives).
+batches the CNN convs and the circuit matmuls onto the MXU. Under a mesh the
+stacked ensemble is replicated and the BATCH shards over ``data`` (the same
+placement policy as the other trainers; the per-member gradients all-reduce
+alongside each other in one fused collective).
 
 QuantumNAT semantics per member (SURVEY.md §3.4): the loss/gradient is taken
 at ``qweights + sigma * N(0,1)`` (noisy point) while optimizer state and
@@ -164,6 +165,18 @@ def train_nat_sweep(
         start_epoch = int(rmeta.get("epoch", -1)) + 1
         best_acc = float(rmeta.get("best_acc", best_acc))
 
+    # Multi-device: replicate the stacked ensemble, shard batches over the
+    # data axis (same placement policy as the other trainers).
+    from qdml_tpu.parallel.dp import replicate
+    from qdml_tpu.parallel.mesh import training_mesh
+    from qdml_tpu.parallel.multihost import make_grid_placer
+
+    mesh = training_mesh(cfg)
+    if mesh is not None:
+        params, opt_state = replicate((params, opt_state), mesh)
+    place_train = make_grid_placer(train_loader, mesh)
+    place_val = make_grid_placer(val_loader, mesh)
+
     # Per-epoch noise keys derived from (seed, epoch): a resumed epoch draws
     # exactly the noise an uninterrupted run would have drawn, so resume is
     # bit-reproducible (tests/test_nat_sweep.py::test_train_nat_sweep_resume).
@@ -176,7 +189,7 @@ def train_nat_sweep(
         for batch in train_loader.epoch(epoch):
             rng, sub = jax.random.split(rng)
             rngs = jax.random.split(sub, n_members)
-            params, opt_state, losses = train_step(params, opt_state, rngs, sigmas, batch)
+            params, opt_state, losses = train_step(params, opt_state, rngs, sigmas, place_train(batch))
             tot += np.asarray(losses)
             n += 1
         train_loss = tot / max(n, 1)
@@ -185,7 +198,7 @@ def train_nat_sweep(
         vacc = np.zeros(n_members)
         vn = 0
         for batch in val_loader.epoch(epoch, shuffle=False):
-            losses, accs = eval_step(params, batch)
+            losses, accs = eval_step(params, place_val(batch))
             vloss += np.asarray(losses)
             vacc += np.asarray(accs)
             vn += 1

@@ -18,6 +18,16 @@ from typing import Any, IO
 import jax.numpy as jnp
 
 
+def _is_primary() -> bool:
+    """True on the single process that should write shared files."""
+    import jax
+
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
 def nmse(x_hat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """Whole-batch NMSE over real arrays (reference ``NMSE_cuda``)."""
     return jnp.sum((x_hat - x) ** 2) / jnp.sum(x**2)
@@ -38,7 +48,9 @@ class MetricsLogger:
     def __init__(self, path: str | None = None, echo: bool = True):
         self._fh: IO[str] | None = None
         self.echo = echo
-        if path is not None:
+        if path is not None and _is_primary():
+            # Multi-host: only process 0 writes (every host runs the same
+            # loop; concurrent appends to a shared file would interleave).
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a", buffering=1)
 

@@ -271,10 +271,22 @@ def test_training_mesh_validation():
     mesh = training_mesh(cfg)
     assert mesh is not None and mesh.shape["data"] == 8
 
-    bad_bs = dataclasses.replace(cfg, train=TrainConfig(batch_size=12))
-    with pytest.raises(ValueError, match="not divisible"):
-        training_mesh(bad_bs)
-
     bad_fed = dataclasses.replace(cfg, mesh=MeshConfig(fed_axis=2))
     with pytest.raises(ValueError, match="n_scenarios"):
         training_mesh(bad_fed)
+
+    bad_names = dataclasses.replace(cfg, mesh=MeshConfig(data_axis_name="dp"))
+    with pytest.raises(ValueError, match="axis names"):
+        training_mesh(bad_names)
+
+    # Batch divisibility is judged per-loader by the placer (it sees the
+    # split-clamped size): indivisible batches degrade to replicated on one
+    # process instead of crashing at startup.
+    from qdml_tpu.config import DataConfig
+    from qdml_tpu.data.datasets import DMLGridLoader
+    from qdml_tpu.parallel.multihost import make_grid_placer
+
+    loader = DMLGridLoader(DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64), 12)
+    place = make_grid_placer(loader, mesh)
+    batch = next(iter(loader.epoch(0)))
+    assert place(batch)["indicator"].shape == batch["indicator"].shape
