@@ -17,7 +17,7 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli export-torch --out=DSTDIR  # orbax -> reference .pth
 
 Dotted-path overrides map onto :mod:`qdml_tpu.config` dataclasses; presets are
-the five BASELINE.json benchmark configs.
+the five BASELINE.json benchmark configs plus robust_qsc.
 """
 
 from __future__ import annotations

@@ -3,9 +3,9 @@
 The reference has no config/flag system at all -- every hyperparameter is a
 hardcoded class attribute (``Runner_P128_QuantumNAT_onchipQNN.py:20-38``,
 ``Test.py:13-21``) or constructor kwarg (``Estimators_QuantumNAT_onchipQNN.py:108``).
-This module centralises all of them as frozen dataclasses, provides the five
-BASELINE.json benchmark presets, and a small CLI override layer
-(``--train.lr=3e-4`` style dotted flags).
+This module centralises all of them as frozen dataclasses, provides the
+BASELINE.json benchmark presets (plus the beyond-reference ``robust_qsc``),
+and a small CLI override layer (``--train.lr=3e-4`` style dotted flags).
 """
 
 from __future__ import annotations
@@ -190,7 +190,8 @@ def _preset(name: str, **overrides: Any) -> ExperimentConfig:
 
 
 def presets() -> dict[str, ExperimentConfig]:
-    """The five benchmark configurations from ``/root/repo/BASELINE.json``."""
+    """The five ``BASELINE.json`` benchmark configurations plus the
+    beyond-reference ``robust_qsc`` config (results/robust/)."""
     return {
         # 1. Runner_P128 single-worker, 4-qubit QuantumNAT classifier (CPU ref)
         "single_4q": _preset(
@@ -214,6 +215,14 @@ def presets() -> dict[str, ExperimentConfig]:
         # 5. Noise-aware training sweep batched over hosts
         "nat_sweep": _preset(
             "nat_sweep", **{"quantum.use_quantumnat": True, "quantum.use_gradient_pruning": True}
+        ),
+        # 6. (beyond BASELINE.json) robust quantum classifier: scale-invariant
+        # angle encoding + SNR-jittered training — fixes the raw-pilot QSC's
+        # low-SNR collapse and beats the classical CNN at SNR 5
+        # (results/robust/).
+        "robust_qsc": _preset(
+            "robust_qsc",
+            **{"quantum.input_norm": True, "data.snr_jitter": (5.0, 15.0)},
         ),
     }
 

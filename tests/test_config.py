@@ -59,7 +59,8 @@ def test_small_geometry_trains_one_step():
 
 def test_presets_cover_baseline_configs():
     p = presets()
-    assert set(p) == {"single_4q", "dp_8q", "sharded_16q", "federated", "nat_sweep"}
+    assert set(p) == {"single_4q", "dp_8q", "sharded_16q", "federated", "nat_sweep", "robust_qsc"}
+    assert p["robust_qsc"].quantum.input_norm and p["robust_qsc"].data.snr_jitter == (5.0, 15.0)
     assert p["sharded_16q"].quantum.n_qubits == 16
     assert p["sharded_16q"].quantum.backend == "sharded"
     assert p["federated"].mesh.fed_axis == 3
