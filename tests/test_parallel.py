@@ -290,3 +290,35 @@ def test_training_mesh_validation():
     place = make_grid_placer(loader, mesh)
     batch = next(iter(loader.epoch(0)))
     assert place(batch)["indicator"].shape == batch["indicator"].shape
+
+
+def test_make_grid_placer_multiprocess_decisions(monkeypatch):
+    """Under multiple processes the placer slices the loader (divisible) or
+    refuses outright (split-clamped indivisible batch)."""
+    from qdml_tpu.config import DataConfig
+    from qdml_tpu.data.datasets import DMLGridLoader
+    from qdml_tpu.parallel import multihost
+
+    from types import SimpleNamespace
+
+    # A stub 2-process mesh: 8 data coordinates, first half owned by process
+    # 0, second by process 1 (the real single-process mesh cannot express
+    # multi-process ownership).
+    devs = np.array(
+        [[SimpleNamespace(process_index=i // 4)] for i in range(8)], dtype=object
+    )
+    mesh = SimpleNamespace(
+        shape={"data": 8, "model": 1}, devices=devs, axis_names=("data", "model")
+    )
+    dcfg = DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+
+    loader = DMLGridLoader(dcfg, 16)
+    multihost.make_grid_placer(loader, mesh)
+    assert loader._pslice == (8, 8)  # second host generates the upper half
+
+    bad = DMLGridLoader(dcfg, 12)
+    with pytest.raises(ValueError, match="multi-process"):
+        multihost.make_grid_placer(bad, mesh)
