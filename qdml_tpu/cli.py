@@ -55,13 +55,15 @@ def main(argv: list[str] | None = None) -> int:
     # is the single home for the workaround).
     honor_platform_env()
     # Multi-host: jax.distributed must initialize BEFORE any JAX computation
-    # touches the backend (loaders/model init do). Explicit env triple first;
-    # otherwise pod autodetection (fails fast with a swallowed ValueError on
-    # a non-cluster host, so plain single-host runs are unaffected).
+    # touches the backend (loaders/model init do). Explicit env triple first
+    # (JAX_COORDINATOR_ADDRESS et al. — the strict path: failures propagate);
+    # pod autodetection only when the environment carries a pod-worker hint,
+    # so plain single-host startup never pays for (or depends on the failure
+    # mode of) a cluster probe.
     from qdml_tpu.parallel.mesh import init_distributed
-    from qdml_tpu.parallel.multihost import init_distributed_from_env
+    from qdml_tpu.parallel.multihost import init_distributed_from_env, pod_env_hint
 
-    if not init_distributed_from_env():
+    if not init_distributed_from_env() and pod_env_hint():
         init_distributed()
     cmd, rest = argv[0], argv[1:]
     cfg, extra = _cfg(rest)
@@ -100,7 +102,7 @@ def main(argv: list[str] | None = None) -> int:
 
             qsc_vars, qsc_meta = restore_checkpoint(workdir, "qsc_best")
             cfg = reconcile_quantum_cfg(cfg, qsc_meta)
-        results = run_snr_sweep(cfg, hdce_vars, sc_vars, qsc_vars)
+        results = run_snr_sweep(cfg, hdce_vars, sc_vars, qsc_vars, logger=logger)
         out_json = save_results_json(results, cfg.eval.results_dir)
         out_png = create_comparison_plots(results, cfg.eval.results_dir)
         from qdml_tpu.eval.report import results_markdown_table

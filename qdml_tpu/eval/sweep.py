@@ -126,8 +126,15 @@ def run_snr_sweep(
     hdce_vars: dict,
     sc_vars: dict,
     qsc_vars: dict | None = None,
+    logger=None,
 ) -> dict[str, Any]:
-    """Full sweep; returns ``{"snr": [...], "nmse_db": {curve: [...]}, "acc": {...}}``."""
+    """Full sweep; returns ``{"snr": [...], "nmse_db": {curve: [...]}, "acc": {...}}``.
+
+    When a :class:`qdml_tpu.utils.metrics.MetricsLogger` is passed, every
+    SNR row is appended to its JSONL stream as it completes (curve NMSEs in
+    dB, classifier accuracies, sample count) — line-level provenance for the
+    aggregate ``results/*.json`` the reporters write.
+    """
     geom = ChannelGeometry.from_config(cfg.data)
     profile = beam_delay_profile(geom)
     step = make_sweep_step(cfg, geom, hdce_vars, sc_vars, qsc_vars, profile)
@@ -147,9 +154,16 @@ def run_snr_sweep(
             for k, v in out.items():
                 sums[k] = sums.get(k, 0.0) + float(v)
         pow_ = max(sums["pow"], 1e-30)
+        row: dict[str, float] = {}
         for key in sums:
             if key.startswith("err_"):
-                curves.setdefault(key[4:], []).append(nmse_db(sums[key] / pow_))
+                db = nmse_db(sums[key] / pow_)
+                curves.setdefault(key[4:], []).append(db)
+                row[f"nmse_db_{key[4:]}"] = db
             elif key.startswith("correct_"):
-                accs.setdefault(key[8:], []).append(sums[key] / sums["count"])
+                acc = sums[key] / sums["count"]
+                accs.setdefault(key[8:], []).append(acc)
+                row[f"acc_{key[8:]}"] = acc
+        if logger is not None:
+            logger.log(snr_db=float(snr), n_samples=sums["count"], **row)
     return {"snr": list(cfg.eval.snr_grid), "nmse_db": curves, "acc": accs}

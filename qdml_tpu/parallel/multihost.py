@@ -56,6 +56,31 @@ def ensure_initialized(**kwargs) -> None:
         raise
 
 
+# Env markers that indicate this host is part of a multi-host accelerator
+# cluster, where jax's pod autodetection is worth attempting. On anything
+# else (laptops, single-host TPU VMs, CI) the bare initialize() attempt is
+# skipped entirely: its benign-fallback contract rests on autodetection
+# raising exactly ValueError, and a successful 1-process initialize (or a
+# slow metadata probe) would change plain single-host startup for nothing.
+# A GCE (non-GKE) TPU pod advertises itself only via the metadata server —
+# no env marker exists there, so such deployments must either set the
+# explicit JAX_COORDINATOR_ADDRESS triple or opt in with
+# QDML_POD_AUTODETECT=1 (docs/MULTIHOST.md).
+_POD_ENV_HINTS = (
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_WORKER_ID",
+    "TPU_PROCESS_ADDRESSES",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "CLOUD_TPU_TASK_ID",
+    "QDML_POD_AUTODETECT",
+)
+
+
+def pod_env_hint() -> bool:
+    """Whether the environment looks like a multi-host pod worker."""
+    return any(os.environ.get(k) for k in _POD_ENV_HINTS)
+
+
 def init_distributed_from_env() -> bool:
     """``jax.distributed.initialize`` from the standard env triple
     (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``);

@@ -16,6 +16,7 @@ import os
 from typing import Any
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 
@@ -27,17 +28,29 @@ def reconcile_quantum_cfg(cfg, meta: dict):
     """Rebuild the quantum-model config a checkpoint was trained for.
 
     QSC checkpoints store their architecture facts in ``meta['quantum']``
-    (n_qubits/n_layers/n_classes/backend/input_norm). Flags like
-    ``input_norm`` carry no params of their own, so evaluating with a
-    mismatched config would silently change behavior; shape-bearing fields
-    would crash later with an opaque error. Every qsc-checkpoint consumer
-    should pass its restored meta through here. No-op when the checkpoint
-    predates the meta (or came from a source that has none)."""
+    (n_qubits/n_layers/n_classes/input_norm). Flags like ``input_norm``
+    carry no params of their own, so evaluating with a mismatched config
+    would silently change behavior; shape-bearing fields would crash later
+    with an opaque error. ``backend`` is different: it is a numerically
+    equivalent execution strategy, not an architecture fact, so the eval
+    config (and any explicit CLI override) wins — a checkpoint trained with
+    ``backend='sharded'`` must remain evaluable on a single host. Every
+    qsc-checkpoint consumer should pass its restored meta through here.
+    No-op when the checkpoint predates the meta (or came from a source that
+    has none)."""
     import dataclasses
 
     stored = (meta or {}).get("quantum")
     if not stored:
         return cfg
+    stored = dict(stored)
+    trained_backend = stored.pop("backend", None)
+    if trained_backend is not None and trained_backend != cfg.quantum.backend:
+        print(
+            f"note: checkpoint was trained with backend={trained_backend!r}; "
+            f"evaluating with backend={cfg.quantum.backend!r} (numerically "
+            "equivalent execution strategies)"
+        )
     mismatch = {k: v for k, v in stored.items() if getattr(cfg.quantum, k) != v}
     if mismatch:
         print(f"using checkpoint quantum config {mismatch}")
@@ -73,8 +86,6 @@ def restore_checkpoint(workdir: str, tag: str, target: Any | None = None) -> tup
     if target is not None:
         restored = ckptr.restore(path, target)
     else:
-        import numpy as np
-
         meta_tree = ckptr.metadata(path).item_metadata.tree
         restored = ckptr.restore(
             path, jax.tree.map(lambda m: np.zeros(m.shape, m.dtype), meta_tree)
@@ -88,6 +99,31 @@ def restore_checkpoint(workdir: str, tag: str, target: Any | None = None) -> tup
 
 def has_checkpoint(workdir: str, tag: str) -> bool:
     return os.path.isdir(os.path.join(workdir, tag))
+
+
+def _broadcast_meta(meta: dict) -> dict:
+    """Under multi-process, make process 0's sidecar meta authoritative.
+
+    Orbax coordinates the array save across processes, but the plain-JSON
+    ``.meta.json`` sidecar is written by process 0 only — on a non-shared
+    workdir filesystem, hosts > 0 would read ``{}`` and resume at epoch 0
+    with a default best, diverging the control flow (unequal epoch counts /
+    save decisions) until a collective save hangs. Broadcasting the JSON
+    bytes from process 0 removes the shared-filesystem requirement for the
+    *control-flow* fields; the array data itself still needs the usual
+    orbax-visible storage (shared fs or object store) — see docs/MULTIHOST.md.
+    """
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    # Two-phase: length first (shapes must match across processes; hosts > 0
+    # may hold a different/empty meta), then the padded byte buffer.
+    n = int(multihost_utils.broadcast_one_to_all(jnp.asarray(len(payload))))
+    buf = np.zeros(n, np.uint8)
+    buf[: min(n, len(payload))] = payload[:n]
+    out = np.asarray(multihost_utils.broadcast_one_to_all(jnp.asarray(buf)))
+    return json.loads(out.tobytes().decode())
 
 
 # ---------------------------------------------------------------------------
@@ -121,9 +157,20 @@ def try_resume(workdir: str | None, tag: str, state: Any) -> tuple[Any, int, dic
     runs do not clobber a better ``*_best`` checkpoint). The reference cannot
     resume at all (write-only checkpoints, SURVEY.md §5.4).
     """
-    if workdir is None or not has_checkpoint(workdir, tag):
+    present = workdir is not None and has_checkpoint(workdir, tag)
+    if jax.process_count() > 1:
+        # Process 0's view is authoritative: a host whose filesystem view
+        # disagrees must fail loudly in the collective restore below, not
+        # silently resume from scratch while the others resume from the
+        # checkpoint (divergent epoch counts hang the next collective save).
+        from jax.experimental import multihost_utils
+
+        present = bool(multihost_utils.broadcast_one_to_all(jax.numpy.asarray(present)))
+    if not present:
         return state, 0, {}
     restored, meta = restore_checkpoint(workdir, tag, train_state_payload(state))
+    if jax.process_count() > 1:
+        meta = _broadcast_meta(meta)
     state = state.replace(
         params=restored["params"],
         opt_state=restored["opt_state"],

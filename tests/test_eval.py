@@ -44,8 +44,24 @@ def test_snr_sweep_structure(tmp_path):
     _, qsc_state = init_sc_state(qcfg, quantum=True, steps_per_epoch=4)
     qsc_vars = {"params": qsc_state.params}
 
-    results = run_snr_sweep(qcfg, hdce_vars, sc_vars, qsc_vars)
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    logger = MetricsLogger(str(tmp_path / "eval.metrics.jsonl"), echo=False)
+    results = run_snr_sweep(qcfg, hdce_vars, sc_vars, qsc_vars, logger=logger)
+    logger.close()
     assert results["snr"] == [5.0, 15.0]
+
+    # line-level provenance: one JSONL row per SNR with every curve and acc
+    import json
+
+    with open(tmp_path / "eval.metrics.jsonl") as fh:
+        rows = [json.loads(ln) for ln in fh]
+    assert [r["snr_db"] for r in rows] == [5.0, 15.0]
+    for r, i in zip(rows, range(2)):
+        assert r["n_samples"] == 60.0
+        for curve in ("ls", "mmse", "mmse_oracle", "hdce_classical", "hdce_quantum"):
+            assert r[f"nmse_db_{curve}"] == results["nmse_db"][curve][i]
+        assert r["acc_classical"] == results["acc"]["classical"][i]
     for curve in ("ls", "mmse", "mmse_oracle", "hdce_classical", "hdce_quantum"):
         assert len(results["nmse_db"][curve]) == 2
         assert np.isfinite(results["nmse_db"][curve]).all()
@@ -124,3 +140,12 @@ def test_reconcile_quantum_cfg():
     )
     assert out.quantum.n_qubits == 4 and out.quantum.input_norm is True
     assert out.quantum.n_layers == cfg.quantum.n_layers  # untouched field
+
+    # backend is an execution-strategy knob, not architecture: the eval
+    # config wins even when the checkpoint recorded a different one (a
+    # 'sharded'-trained checkpoint must be evaluable single-host; ADVICE r2)
+    out = reconcile_quantum_cfg(
+        cfg, {"quantum": {"n_qubits": 4, "backend": "sharded"}}
+    )
+    assert out.quantum.backend == cfg.quantum.backend
+    assert out.quantum.n_qubits == 4

@@ -1,0 +1,39 @@
+"""Pin the private jax APIs our runtime probes rely on.
+
+:mod:`qdml_tpu.utils.platform` and :mod:`qdml_tpu.parallel.multihost` probe
+``jax._src.xla_bridge._backends`` and ``jax._src.distributed.global_state``
+to decide whether a backend/coordination client is live. Both probes carry
+graceful fallbacks, but the fallbacks *change semantics* (``force_cpu``
+degrades to a late failure at the caller's device-count check;
+``ensure_initialized`` degrades to message-matching on RuntimeError text).
+A jax upgrade that moves these attributes should fail HERE, loudly, instead
+of silently shifting init behavior (ADVICE r2).
+"""
+
+import jax
+
+
+def test_xla_bridge_backends_attr_exists():
+    from jax._src import xla_bridge
+
+    assert hasattr(xla_bridge, "_backends")
+    assert isinstance(xla_bridge._backends, dict)
+
+
+def test_distributed_global_state_attr_exists():
+    from jax._src import distributed as _dist
+
+    state = _dist.global_state
+    # `client` is None until initialize(); the attribute itself must exist.
+    assert hasattr(state, "client")
+
+
+def test_probes_agree_with_reality():
+    from qdml_tpu.parallel.multihost import _runtime_initialized
+    from qdml_tpu.utils.platform import backend_initialized
+
+    # The conftest pinned the CPU backend but no test initializes
+    # jax.distributed; touching a device commits the backend.
+    jax.devices()
+    assert backend_initialized() is True
+    assert _runtime_initialized() is False
