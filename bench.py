@@ -23,8 +23,14 @@ number measured on CPU (``platform: "cpu_fallback"``) plus the TPU error —
 a structured record instead of a bare traceback.
 
 ``vs_baseline`` is the speedup over a faithful torch-CPU implementation of
-the reference training step measured in-process (the reference publishes no
-hardware throughput; BASELINE.md's target is >= 3x a single V100).
+the reference training step, measured against a FIXED committed constant
+(:data:`REFERENCE_TORCH_CPU_SPS`) so the number means the same thing in
+every round's artifact regardless of which host runs the harness (VERDICT
+r2 weak #6: the live measurement swings 5x between the driver host and the
+TPU VM). The live same-host measurement is still recorded as
+``torch_cpu_reference_sps_live`` for context. The reference publishes no
+hardware throughput; BASELINE.md's >= 3x-single-V100 target remains
+unmeasurable without a V100 — the committed CPU constant is the anchor.
 """
 
 from __future__ import annotations
@@ -38,6 +44,13 @@ import time
 
 # bf16 peak FLOP/s by TPU generation (PALLAS_AXON_TPU_GEN; default v5e).
 _PEAK_BF16 = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+
+# Fixed cross-round baseline: the reference-equivalent torch-CPU training
+# step (measure_torch_cpu_reference below) as measured on the round-2 driver
+# host and recorded in the committed BENCH_r02.json
+# ("torch_cpu_reference_sps": 1389.3). Every round's ``vs_baseline`` divides
+# by THIS constant, so the headline is comparable across rounds and hosts.
+REFERENCE_TORCH_CPU_SPS = 1389.3
 
 _GRID = (3, 3)
 _CELL_BS = 256
@@ -259,8 +272,11 @@ def measure_torch_cpu_reference(n_steps: int = 2) -> float | None:
 # ---------------------------------------------------------------------------
 
 # The probe prints backend:result so a silent JAX CPU fallback (e.g. axon
-# plugin not registered) cannot masquerade as a TPU run.
+# plugin not registered) cannot masquerade as a TPU run. It warms the
+# persistent compile cache so a healthy tunnel answers in seconds.
 _PROBE = (
+    "from qdml_tpu.utils.compile_cache import enable_compile_cache; "
+    "enable_compile_cache(); "
     "import jax, jax.numpy as jnp; "
     "print(jax.default_backend(), int(jnp.ones((8, 8)).sum()))"
 )
@@ -274,21 +290,33 @@ def _cpu_env() -> dict:
 
 
 def probe_tpu(attempts: int | None = None, timeout_s: int | None = None) -> str | None:
-    """Returns None if a TPU subprocess computes successfully, else the error."""
-    attempts = attempts or int(os.environ.get("QDML_BENCH_PROBE_ATTEMPTS", "2"))
-    timeout_s = timeout_s or int(os.environ.get("QDML_BENCH_PROBE_TIMEOUT", "180"))
+    """Returns None if a TPU subprocess computes successfully, else the error.
+
+    The tunnelled axon backend drops and restores on minutes timescales
+    (two rounds of driver artifacts show a 2-attempt probe losing the race),
+    so the default probe is patient: 5 attempts with exponential backoff
+    spreading ~6 minutes of sleep between them, and the parent re-probes
+    once more after the CPU fallback bench has burned several further
+    minutes (see main) before conceding a cpu_fallback record.
+    """
+    attempts = attempts or int(os.environ.get("QDML_BENCH_PROBE_ATTEMPTS", "5"))
+    timeout_s = timeout_s or int(os.environ.get("QDML_BENCH_PROBE_TIMEOUT", "150"))
     err = "unknown"
     for i in range(attempts):
         if i:
-            backoff = 10 * i
+            backoff = min(20 * 2 ** (i - 1), 300)
             print(f"[bench] TPU probe retry in {backoff}s", file=sys.stderr, flush=True)
             time.sleep(backoff)
         try:
+            # cwd = repo root so the '-c' child resolves qdml_tpu regardless
+            # of where the harness itself was invoked from (python -c puts
+            # the cwd, not the script dir, on sys.path).
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE],
                 capture_output=True,
                 text=True,
                 timeout=timeout_s,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
             )
         except subprocess.TimeoutExpired:
             err = f"probe timed out after {timeout_s}s (backend init hang)"
@@ -342,21 +370,34 @@ def main() -> int:
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK_BF16.get(gen, _PEAK_BF16["v5e"])
 
+    def try_tpu_bench() -> tuple[dict | None, str | None]:
+        """(details, error): TPU measurements, or why there are none."""
+        d = _run_bench_child(dict(os.environ), "tpu", timeout_s=1500)
+        if d is None:
+            return None, "tpu bench child failed or timed out after a good probe"
+        if d.get("backend") == "cpu":
+            # belt-and-braces: never label CPU numbers as TPU throughput/MFU
+            return None, "bench child ran on the cpu backend despite a tpu probe"
+        return d, None
+
     tpu_error = probe_tpu()
     details: dict | None = None
     platform = None
     if tpu_error is None:
-        details = _run_bench_child(dict(os.environ), "tpu", timeout_s=1500)
+        details, tpu_error = try_tpu_bench()
         platform = f"tpu-{gen}"
-        if details is None:
-            tpu_error = "tpu bench child failed or timed out after a good probe"
-        elif details.get("backend") == "cpu":
-            # belt-and-braces: never label CPU numbers as TPU throughput/MFU
-            tpu_error = "bench child ran on the cpu backend despite a tpu probe"
-            details = None
     if details is None:
         details = _run_bench_child(_cpu_env(), "cpu", timeout_s=1500)
         platform = "cpu_fallback"
+        # Last-chance TPU re-attempt: the CPU bench just spent several
+        # minutes — enough for a flapping tunnel to have come back. A late
+        # TPU record always supersedes the CPU fallback.
+        if probe_tpu(attempts=2) is None:
+            late, late_err = try_tpu_bench()
+            if late is not None:
+                details, tpu_error, platform = late, None, f"tpu-{gen}"
+            elif tpu_error is None:
+                tpu_error = late_err
     if details is None:
         print(
             json.dumps(
@@ -372,7 +413,7 @@ def main() -> int:
         )
         return 1
 
-    baseline = measure_torch_cpu_reference()
+    baseline_live = measure_torch_cpu_reference()
     # MFU vs the generation's bf16 peak (conservative for the f32 run). Only
     # meaningful on the TPU; CPU fallback reports null.
     on_tpu = platform != "cpu_fallback"
@@ -411,11 +452,14 @@ def main() -> int:
         "metric": "hdce_train_samples_per_sec_per_chip",
         "value": value,
         "unit": f"samples/sec (3x3 DML grid train step, cell batch 256, {dtype})",
-        "vs_baseline": round(value / baseline, 2) if baseline else None,
+        # Fixed committed constant (round-2 driver host) — comparable across
+        # rounds; the live same-host measurement is context only.
+        "vs_baseline": round(value / REFERENCE_TORCH_CPU_SPS, 2),
         "platform": platform,
         "dtype": dtype,
         "mfu": headline.get("mfu"),
-        "torch_cpu_reference_sps": round(baseline, 1) if baseline else None,
+        "torch_cpu_reference_sps": REFERENCE_TORCH_CPU_SPS,
+        "torch_cpu_reference_sps_live": round(baseline_live, 1) if baseline_live else None,
         "details": details,
     }
     if tpu_error is not None:

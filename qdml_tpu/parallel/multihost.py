@@ -72,12 +72,19 @@ _POD_ENV_HINTS = (
     "TPU_PROCESS_ADDRESSES",
     "MEGASCALE_COORDINATOR_ADDRESS",
     "CLOUD_TPU_TASK_ID",
-    "QDML_POD_AUTODETECT",
 )
 
 
 def pod_env_hint() -> bool:
-    """Whether the environment looks like a multi-host pod worker."""
+    """Whether the environment looks like a multi-host pod worker.
+
+    Platform markers count on any non-empty value (``TPU_WORKER_ID=0`` is a
+    real rank); the explicit ``QDML_POD_AUTODETECT`` opt-in is parsed as a
+    boolean so ``=0``/``=false`` means what it says.
+    """
+    optin = os.environ.get("QDML_POD_AUTODETECT", "").strip().lower()
+    if optin in ("1", "true", "yes"):
+        return True
     return any(os.environ.get(k) for k in _POD_ENV_HINTS)
 
 

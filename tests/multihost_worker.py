@@ -1,0 +1,63 @@
+"""Subprocess worker for ``test_multihost_2proc.py`` — NOT a test module.
+
+Runs one real HDCE training epoch through the production multi-host path
+(``training_mesh`` -> ``shard_hdce_state`` -> ``make_grid_placer``) either as
+one rank of a genuine 2-process ``jax.distributed`` cluster (rank 0/1, two
+local CPU devices each, Gloo collectives) or as the single-process reference
+(rank -1, four local CPU devices — the same 4-wide data axis in one process).
+Writes the loss history as JSON so the parent test can assert the two
+execution modes are numerically equivalent.
+
+Usage: python tests/multihost_worker.py RANK PORT OUT_JSON
+"""
+
+import json
+import os
+import sys
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out_path = sys.argv[3]
+
+n_local = 2 if rank >= 0 else 4
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_local}"
+
+from qdml_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+from qdml_tpu.utils.platform import honor_platform_env  # noqa: E402
+
+honor_platform_env()
+enable_compile_cache()
+
+import jax  # noqa: E402
+
+if rank >= 0:
+    jax.distributed.initialize(
+        f"localhost:{port}", num_processes=2, process_id=rank, local_device_ids=[0, 1]
+    )
+
+from qdml_tpu.config import (  # noqa: E402
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from qdml_tpu.train.hdce import train_hdce  # noqa: E402
+
+cfg = ExperimentConfig(
+    data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=40, train_split=0.8),
+    model=ModelConfig(features=8),
+    train=TrainConfig(batch_size=8, n_epochs=1, print_freq=1000),
+)
+_, history = train_hdce(cfg)
+with open(out_path, "w") as fh:
+    json.dump(
+        {
+            "rank": rank,
+            "nproc": jax.process_count(),
+            "n_global_devices": len(jax.devices()),
+            "train_loss": history["train_loss"],
+            "val_nmse": history["val_nmse"],
+        },
+        fh,
+    )

@@ -1,0 +1,87 @@
+"""Two REAL processes through the production multi-host training path.
+
+Round-2 verdict (missing #2): every multi-host contract was verified only by
+stubbing ``device.process_index`` in one process. This test launches two
+actual OS processes that form a ``jax.distributed`` cluster on localhost
+(CPU backend, 2 virtual devices each, Gloo collectives), trains one full
+HDCE epoch through ``training_mesh`` / ``shard_hdce_state`` /
+``make_grid_placer`` — per-process slice generation, global array assembly,
+cross-process gradient psum — and asserts the loss history matches the
+single-process run of the identical 4-wide data-parallel config.
+
+Slow-marked (two cold jax starts + an XLA CPU compile per process); run with
+``pytest -m slow tests/test_multihost_2proc.py``.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _launch(rank: int, port: int, out: str, log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # The worker pins its own platform/device-count; scrub ambient overrides.
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    # Log to a FILE, not a pipe: two live cluster ranks must never block on
+    # an unread pipe buffer mid-collective while the parent waits on the
+    # other rank (classic sequential-communicate deadlock).
+    log = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, _WORKER, str(rank), str(port), out],
+        env=env,
+        cwd=_REPO,
+        stdout=log,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+@pytest.mark.slow
+def test_two_process_hdce_matches_single_process(tmp_path):
+    port = _free_port()
+    outs = [str(tmp_path / f"rank{r}.json") for r in (0, 1)]
+    log_paths = [str(tmp_path / f"rank{r}.log") for r in (0, 1)]
+    procs = [_launch(r, port, outs[r], log_paths[r]) for r in (0, 1)]
+    for r, p in enumerate(procs):
+        p.wait(timeout=900)
+    for r, p in enumerate(procs):
+        log = open(log_paths[r]).read()
+        assert p.returncode == 0, f"rank {r} failed:\n{log[-3000:]}"
+
+    ref_out = str(tmp_path / "single.json")
+    ref_log = str(tmp_path / "single.log")
+    single = _launch(-1, port, ref_out, ref_log)
+    single.wait(timeout=900)
+    log = open(ref_log).read()
+    assert single.returncode == 0, f"single-process reference failed:\n{log[-3000:]}"
+
+    recs = [json.load(open(o)) for o in outs]
+    ref = json.load(open(ref_out))
+    assert [r["nproc"] for r in recs] == [2, 2]
+    assert [r["n_global_devices"] for r in recs] == [4, 4]
+    assert ref["nproc"] == 1 and ref["n_global_devices"] == 4
+
+    # Both ranks observe identical (replicated, psum-aggregated) metrics...
+    np.testing.assert_allclose(recs[0]["train_loss"], recs[1]["train_loss"], rtol=1e-6)
+    np.testing.assert_allclose(recs[0]["val_nmse"], recs[1]["val_nmse"], rtol=1e-6)
+    # ...and the 2-process cluster reproduces the single-process run: the
+    # per-process slice generation + global assembly is data-identical and
+    # the cross-process psum is the same reduction over the same 4-wide mesh.
+    np.testing.assert_allclose(recs[0]["train_loss"], ref["train_loss"], rtol=1e-5)
+    np.testing.assert_allclose(recs[0]["val_nmse"], ref["val_nmse"], rtol=1e-5)
