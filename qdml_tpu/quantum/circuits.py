@@ -16,10 +16,15 @@ Execution paths:
 - ``tensor``: gates applied on the ``(batch, 2**n)`` statevector via axis
   reshapes — O(n) cheap ops per layer, scales to n ~ 14 single-chip.
 - ``dense``: the whole weight-dependent ansatz is precompiled into ONE
-  ``(2**n, 2**n)`` unitary per step (Kronecker composition + ring permutation),
-  so each batch costs a single complex matmul — three real MXU matmuls via the
-  Gauss trick. Best for the reference's 4-8 qubit regime where ``2**n`` is tiny
-  compared to the batch.
+  ``(2**n, 2**n)`` unitary per step (Kronecker composition + ring
+  permutation); the RY embedding collapses to a closed-form REAL product
+  state (:func:`~qdml_tpu.quantum.statevector.ry_product_state`), so each
+  batch costs two real MXU matmuls against U^T plus the sign contraction.
+  Best for the reference's 4-8 qubit regime where ``2**n`` is tiny compared
+  to the batch.
+- ``pallas``: the dense math as ONE fused TPU kernel per batch tile —
+  in-kernel embedding, blockdiag unitary matmul, <Z> contraction
+  (:mod:`qdml_tpu.quantum.pallas_kernels`).
 
 Both paths are pure jittable functions of ``(angles, weights)`` and
 differentiable by JAX AD; they agree to float32 precision (tested against an
@@ -102,20 +107,30 @@ def run_circuit(
         # be mesh-sharded instead (select "sharded" explicitly — it needs a
         # multi-device mesh this helper cannot assume).
         backend = "dense" if n_qubits <= 10 else "tensor"
+    if backend == "dense":
+        # Closed-form embedding: the RY-embedded state is a REAL product
+        # state (sv.ry_product_state), so the whole circuit is two real
+        # matmuls against U^T plus the sign contraction — no gate chain on
+        # the 2^n tensor, half the matmul work of a complex-LHS product.
+        u = ansatz_unitary(weights, n_qubits, n_layers)
+        amp = sv.ry_product_state(angles, n_qubits)
+        psi = CArr(
+            jnp.einsum("...i,ji->...j", amp, u.re),
+            jnp.einsum("...i,ji->...j", amp, u.im),
+        )
+        return sv.expvals_z(psi, n_qubits)
+    if backend == "pallas":
+        # Whole-circuit fused kernel: in-kernel product-state embedding +
+        # blockdiag unitary matmul + |.|^2 <Z> contraction, one pallas_call
+        # (qdml_tpu.quantum.pallas_kernels.fused_qsc_expvals).
+        from qdml_tpu.quantum.pallas_kernels import fused_qsc_expvals
+
+        u = ansatz_unitary(weights, n_qubits, n_layers)
+        return fused_qsc_expvals(angles, u, n_qubits)
     psi = sv.zero_state(n_qubits, angles.shape[:-1])
     psi = angle_embed(psi, angles, n_qubits)
     if backend == "tensor":
         psi = apply_ansatz_tensor(psi, weights, n_qubits, n_layers)
-    elif backend == "dense":
-        u = ansatz_unitary(weights, n_qubits, n_layers)
-        psi = ceinsum("...i,ji->...j", psi, u)
-    elif backend == "pallas":
-        # Fused Pallas kernel: unitary application + |.|^2 + <Z> contraction
-        # never leave VMEM (qdml_tpu.quantum.pallas_kernels).
-        from qdml_tpu.quantum.pallas_kernels import fused_unitary_expvals
-
-        u = ansatz_unitary(weights, n_qubits, n_layers)
-        return fused_unitary_expvals(psi, u, n_qubits)
     elif backend == "pallas_tensor":
         # Per-layer fused rotation kernel + ring permutation; scales past the
         # dense path's 2^n x 2^n unitary (n ~ 10-14 single-chip).

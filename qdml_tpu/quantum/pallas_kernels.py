@@ -2,24 +2,29 @@
 
 The reference executes its variational circuit sample-by-sample on PennyLane's
 CPU ``default.qubit`` (``Estimators_QuantumNAT_onchipQNN.py:122-149``) — the
-hottest, slowest boundary in its training loop (SURVEY.md §3.1). The XLA
-"dense" path in :mod:`qdml_tpu.quantum.circuits` already turns the per-batch
-circuit cost into complex matmuls; this module fuses the remaining memory
-traffic away with a single Pallas kernel:
+hottest, slowest boundary in its training loop (SURVEY.md §3.1). This module
+holds the Pallas TPU kernels for that hot path. The production ``pallas``
+backend kernel (``fused_qsc_expvals``) computes the WHOLE circuit from raw
+angles in one ``pallas_call`` per batch tile:
 
-    expvals = |psi_embedded @ U^T|^2 @ Zsigns
+    expvals = square([amp(angles) | amp(angles)] @ blockdiag(Ur^T, Ui^T)) @ [z; z]
 
-computed per batch tile entirely in VMEM — the post-unitary statevector
-``psi'`` (batch x 2^n complex) and the probability vector never round-trip to
-HBM. The complex matmul uses the 3-multiplication Gauss trick, so the kernel
-issues three real MXU matmuls plus one more for the PauliZ contraction.
+where ``amp`` is the real RY product state built IN KERNEL from lane-iota
+bit masks — the embedded statevector never exists in HBM, the duplicated
+layout fills all 128 lanes at the shipped 6-qubit shape (no padding waste),
+and the real LHS needs two matmuls' work, not a complex product's four.
 
-Gradients are provided by a ``jax.custom_vjp`` whose backward pass is plain
-XLA matmul algebra (matmuls are what the MXU does best either way; the fusion
-win is in the forward's elided HBM round-trips).
+Two further kernels are retained: ``fused_unitary_expvals`` (the round-2
+psi-input formulation, kept as the benchmarking baseline the whole-circuit
+kernel is measured against) and ``apply_rotation_layer`` (per-layer fusion
+for the larger-n ``pallas_tensor`` path).
 
-On non-TPU backends the kernel runs in Pallas interpret mode, which is how the
-CPU test suite validates it against the XLA paths (``tests/test_pallas.py``).
+Gradients are provided by ``jax.custom_vjp``s whose backward passes are plain
+XLA matmul/gate algebra (matmuls are what the MXU does best either way; the
+fusion win is in the forward's elided HBM round-trips).
+
+On non-TPU backends the kernels run in Pallas interpret mode, which is how the
+CPU test suite validates them against the XLA paths (``tests/test_pallas.py``).
 """
 
 from __future__ import annotations
@@ -142,9 +147,12 @@ _fused_expvals.defvjp(_fused_fwd, _fused_bwd)
 def fused_unitary_expvals(psi: CArr, u: CArr, n_qubits: int) -> jnp.ndarray:
     """``psi (..., 2^n) -> per-wire <Z> (..., n)`` through unitary ``u``.
 
-    Equivalent to ``expvals_z(psi @ u^T)`` of the XLA dense path
-    (:func:`qdml_tpu.quantum.circuits.run_circuit` with ``backend='dense'``)
-    but fused into one Pallas kernel per batch tile.
+    Equivalent to ``expvals_z(psi @ u^T)``. Round-2 formulation, no longer
+    on the production ``pallas`` backend (it lost to XLA dense on-chip at
+    n=6: 128-lane padding waste + a separate embedding pass); retained as
+    the general psi-input fusion and as the benchmarking baseline for
+    :func:`fused_qsc_expvals`, which fuses the embedding in and fills the
+    lanes via the duplicated-amp layout.
     """
     lead = psi.shape[:-1]
     dim = psi.shape[-1]
@@ -152,6 +160,135 @@ def fused_unitary_expvals(psi: CArr, u: CArr, n_qubits: int) -> jnp.ndarray:
     ai = psi.im.reshape(-1, dim)
     z = jnp.asarray(sv.z_signs(n_qubits))
     ev = _fused_expvals(ar, ai, u.re.T, u.im.T, z)
+    return ev.reshape(lead + (n_qubits,))
+
+
+# ---------------------------------------------------------------------------
+# Whole-circuit QSC kernel: angles -> <Z> in one pallas_call
+# ---------------------------------------------------------------------------
+# Round-2 on-chip profiling showed the psi-input kernel above LOSING to plain
+# XLA dense at the shipped 6-qubit shape: it pads the 64-wide statevector to
+# 128 lanes (75% of every tile wasted), issues four matmuls, and still leaves
+# the angle embedding as a separate XLA pass over the (B, 64) statevector.
+# This kernel removes all three costs at once by exploiting that the
+# RY-embedded state is a REAL product state (statevector.ry_product_state):
+#
+#   - the embedding is built IN KERNEL from the (tile, n) angles via lane-
+#     iota bit masks — the statevector never exists in HBM at all (input
+#     traffic drops from 2 x B x 2^n floats to B x n);
+#   - the amplitude row is materialised directly in DUPLICATED layout
+#     (tile, 2*2^n) = [amp | amp], so at n=6 the tile is a fully-occupied
+#     128 lanes wide — zero padding waste;
+#   - one matmul against blockdiag(Ur^T, Ui^T) yields [c_r | c_i] in a
+#     single MXU pass (real LHS: two real matmuls' work, not four), and one
+#     more against the stacked sign matrix [z; z] contracts |c|^2 to <Z>.
+
+# Batch tile for the whole-circuit kernel: (tile, 2D) buffers at n=6 are
+# (512, 128) f32 = 256 KB; with angles + c + out the kernel sits ~1 MB of
+# VMEM — far under the ~16 MB/core budget, large enough to amortise the
+# (2D, 2D) unitary reload.
+_QSC_TILE_B = 512
+
+
+def _qsc_kernel(ang_ref, w_ref, z2_ref, out_ref, *, n: int):
+    """One batch tile: build [amp|amp], one blockdiag matmul, one contraction."""
+    dim = 1 << n
+    half = 0.5 * ang_ref[:]
+    c = jnp.cos(half)
+    s = jnp.sin(half)
+    tile_b, width = out_ref.shape[0], w_ref.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tile_b, width), 1)
+    x = lane & (dim - 1)  # duplicated basis index: both halves, any padding
+    amp = jnp.ones((tile_b, width), jnp.float32)
+    for q in range(n):
+        bit = (x >> (n - 1 - q)) & 1
+        amp = amp * jnp.where(bit == 1, s[:, q : q + 1], c[:, q : q + 1])
+    cmat = jnp.dot(amp, w_ref[:], preferred_element_type=jnp.float32)
+    out_ref[:] = jnp.dot(cmat * cmat, z2_ref[:], preferred_element_type=jnp.float32)
+
+
+def _qsc_forward(angles: jnp.ndarray, ur_t, ui_t, z, n: int) -> jnp.ndarray:
+    """angles (B, n) -> expvals (B, n) through one pallas_call.
+
+    ``ur_t``/``ui_t``: U^T (D, D); ``z``: (D, n) sign matrix.
+    """
+    batch = angles.shape[0]
+    dim = 1 << n
+    if dim > 256:
+        # Past n=8 the (2D, 2D) blockdiag operand grows quadratically toward
+        # the VMEM budget (n=10 would need a 16 MB W block alone). The
+        # kernel targets the reference's 4-8 qubit regime; larger circuits
+        # take the mathematically identical XLA formulation (and from ~10
+        # qubits the tensor/sharded paths win anyway — circuits.run_circuit).
+        return _xla_qsc_expvals(angles, ur_t, ui_t, z, n)
+    width = max(_LANES, 2 * dim)  # duplicated amp layout, >= one lane tile
+    n_p = ((n + _LANES - 1) // _LANES) * _LANES
+    tile_b = min(_QSC_TILE_B, max(8, ((batch + 7) // 8) * 8))
+    batch_p = ((batch + tile_b - 1) // tile_b) * tile_b
+
+    # blockdiag(Ur^T, Ui^T) padded to (width, width): [amp|amp] @ W = [cr|ci].
+    # Padded rows are zero, so garbage amp values in lanes >= 2D are inert.
+    w = jnp.zeros((width, width), jnp.float32)
+    w = jax.lax.dynamic_update_slice(w, ur_t, (0, 0))
+    w = jax.lax.dynamic_update_slice(w, ui_t, (dim, dim))
+    z2 = jnp.zeros((width, n_p), jnp.float32)
+    z2 = jax.lax.dynamic_update_slice(z2, z, (0, 0))
+    z2 = jax.lax.dynamic_update_slice(z2, z, (dim, 0))
+
+    ang = _pad_to(angles, 0, batch_p)
+
+    out = pl.pallas_call(
+        partial(_qsc_kernel, n=n),
+        grid=(batch_p // tile_b,),
+        in_specs=[
+            pl.BlockSpec((tile_b, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((width, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((width, n_p), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_b, n_p), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((batch_p, n_p), jnp.float32),
+        interpret=_interpret(),
+    )(ang, w, z2)
+    return out[:batch, :n]
+
+
+def _xla_qsc_expvals(angles, ur_t, ui_t, z, n: int) -> jnp.ndarray:
+    """XLA twin with identical math (real product state, two real matmuls,
+    sign contraction) — the backward differentiates through this."""
+    amp = sv.ry_product_state(angles, n)
+    cr = amp @ ur_t
+    ci = amp @ ui_t
+    return (cr * cr + ci * ci) @ z
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _qsc_expvals(angles, ur_t, ui_t, z, n):
+    return _qsc_forward(angles, ur_t, ui_t, z, n)
+
+
+def _qsc_fwd(angles, ur_t, ui_t, z, n):
+    return _qsc_forward(angles, ur_t, ui_t, z, n), (angles, ur_t, ui_t, z)
+
+
+def _qsc_bwd(n, res, g):
+    angles, ur_t, ui_t, z = res
+    _, vjp = jax.vjp(lambda a, br, bi, zz: _xla_qsc_expvals(a, br, bi, zz, n), *res)
+    return vjp(g)
+
+
+_qsc_expvals.defvjp(_qsc_fwd, _qsc_bwd)
+
+
+def fused_qsc_expvals(angles: jnp.ndarray, u: CArr, n_qubits: int) -> jnp.ndarray:
+    """Reference circuit measurement from raw angles: AngleEmbedding + the
+    precompiled ansatz unitary ``u`` + per-wire <Z>, one kernel per batch
+    tile. Equivalent to the dense path of
+    :func:`qdml_tpu.quantum.circuits.run_circuit`; the embedded statevector
+    never exists in HBM."""
+    lead = angles.shape[:-1]
+    a2 = angles.reshape(-1, n_qubits)
+    z = jnp.asarray(sv.z_signs(n_qubits))
+    ev = _qsc_expvals(a2, u.re.T, u.im.T, z, n_qubits)
     return ev.reshape(lead + (n_qubits,))
 
 

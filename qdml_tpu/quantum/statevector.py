@@ -140,6 +140,28 @@ def z_signs(n: int) -> np.ndarray:
     return (1.0 - 2.0 * bits).astype(np.float32)
 
 
+def ry_product_state(angles: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Closed-form AngleEmbedding state: ``RY(a_q)`` per qubit on |0...0>.
+
+    RY rotations on |0> produce a REAL product state —
+    ``amp[x] = prod_q (bit_q(x) ? sin(a_q/2) : cos(a_q/2))`` (MSB-first, the
+    module's qubit convention) — so the embedded statevector costs n
+    doubling multiplies instead of n gate applications on the full 2^n
+    tensor, and downstream complex arithmetic can exploit a real LHS (two
+    real matmuls, not four). Identical to
+    ``angle_embed(zero_state(n, lead), angles, n)``; returns the real
+    amplitude array of shape ``angles.shape[:-1] + (2**n,)``.
+    """
+    lead = angles.shape[:-1]
+    half = 0.5 * angles
+    c, s = jnp.cos(half), jnp.sin(half)
+    amp = jnp.ones(lead + (1,), jnp.float32)
+    for q in range(n):
+        pair = jnp.stack([c[..., q], s[..., q]], axis=-1)  # (..., 2)
+        amp = (amp[..., :, None] * pair[..., None, :]).reshape(lead + (-1,))
+    return amp
+
+
 def expvals_z(psi: CArr, n: int) -> jnp.ndarray:
     """Per-wire <PauliZ_i> (reference measurement, ``Estimators...py:142``):
     probabilities contracted with the sign matrix — one real MXU matmul."""

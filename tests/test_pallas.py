@@ -102,3 +102,29 @@ def test_pallas_under_jit_and_vmap():
         rtol=1e-4,
         atol=1e-5,
     )
+
+
+@pytest.mark.parametrize("n", [3, 6])
+def test_ry_product_state_matches_angle_embed(n):
+    """Closed-form embedding == gate-wise RY chain on |0...0> (and is real)."""
+    rng = np.random.default_rng(7)
+    angles = jnp.asarray(rng.uniform(-3, 3, (4, n)).astype(np.float32))
+    want = angle_embed(sv.zero_state(n, (4,)), angles, n)
+    amp = sv.ry_product_state(angles, n)
+    np.testing.assert_allclose(np.asarray(amp), np.asarray(want.re), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(want.im), 0.0, atol=1e-7)
+
+
+def test_fused_qsc_odd_batch_and_lead_shape():
+    """Non-tile-aligned batch + extra lead dims survive the padding/reshape."""
+    from qdml_tpu.quantum.pallas_kernels import fused_qsc_expvals
+
+    n, layers = 4, 1
+    rng = np.random.default_rng(11)
+    angles = jnp.asarray(rng.uniform(-2, 2, (3, 11, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(0, 6, (layers, n, 2)).astype(np.float32))
+    u = ansatz_unitary(w, n, layers)
+    got = fused_qsc_expvals(angles, u, n)
+    want = run_circuit(angles, w, n, layers, "dense")
+    assert got.shape == (3, 11, n)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
