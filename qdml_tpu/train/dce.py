@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Callable
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -28,7 +30,9 @@ from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
 
 
 def make_dce_train_step(model: DCEP128) -> Callable:
-    @jax.jit
+    from qdml_tpu.utils.platform import donation_argnums
+
+    @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
         label = batch["h_label"].reshape(x.shape[0], -1)

@@ -32,6 +32,8 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+from functools import partial
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -81,7 +83,9 @@ def cell_nmse(pred: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_hdce_train_step(model: HDCE, tx) -> Callable:
-    @jax.jit
+    from qdml_tpu.utils.platform import donation_argnums
+
+    @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         s, u, b = batch["yp_img"].shape[:3]
         x = batch["yp_img"].reshape(s, u * b, *batch["yp_img"].shape[3:])

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from typing import Callable
 
+from functools import partial
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -47,7 +49,9 @@ def build_classifier(cfg: ExperimentConfig, quantum: bool) -> nn.Module:
 
 
 def make_sc_train_step(model: nn.Module, needs_rng: bool) -> Callable:
-    @jax.jit
+    from qdml_tpu.utils.platform import donation_argnums
+
+    @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict, rng: jax.Array):
         x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
         labels = batch["indicator"].reshape(-1)
