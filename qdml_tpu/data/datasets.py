@@ -121,25 +121,44 @@ class DMLGridLoader:
         self.batch_size = batch_size = min(batch_size, self.n)
         self.steps_per_epoch = self.n // batch_size
         self._pslice: tuple[int, int] | None = None
+        self._sslice: tuple[int, int] = (0, cfg.n_scenarios)
         s, u = cfg.n_scenarios, cfg.n_users
         self._scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, batch_size))
         self._user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, batch_size))
 
-    def set_process_slice(self, start: int, length: int) -> None:
+    def set_process_slice(
+        self,
+        start: int,
+        length: int,
+        scen_start: int = 0,
+        scen_count: int | None = None,
+    ) -> None:
         """Multi-host data path: generate only ``[start, start+length)`` of
-        each global batch window — every host synthesizes its own slice and
-        the global array is assembled by
-        :func:`qdml_tpu.parallel.multihost.local_grid_batch_to_global`, so no
-        host ever materializes the full batch."""
+        each global batch window — and, under a federated cross-host layout,
+        only scenario rows ``[scen_start, scen_start+scen_count)`` — every
+        host synthesizes its own rectangle and the global array is assembled
+        by :func:`qdml_tpu.parallel.multihost.local_grid_batch_to_global`,
+        so no host ever materializes the full batch (or, federated, any
+        other base station's scenario data)."""
         if not (0 <= start and start + length <= self.batch_size):
             raise ValueError(
                 f"process slice [{start}, {start + length}) outside batch "
                 f"window of {self.batch_size}"
             )
         s, u = self.cfg.n_scenarios, self.cfg.n_users
+        scen_count = s if scen_count is None else scen_count
+        if not (0 <= scen_start and scen_start + scen_count <= s):
+            raise ValueError(
+                f"scenario slice [{scen_start}, {scen_start + scen_count}) "
+                f"outside the {s}-scenario grid"
+            )
         self._pslice = (start, length)
-        self._scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, length))
-        self._user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, length))
+        self._sslice = (scen_start, scen_count)
+        scen = jnp.arange(scen_start, scen_start + scen_count)
+        self._scen = jnp.broadcast_to(scen[:, None, None], (scen_count, u, length))
+        self._user = jnp.broadcast_to(
+            jnp.arange(u)[None, :, None], (scen_count, u, length)
+        )
 
     def _step_snr(self, epoch: int, step: int) -> float:
         """Per-step training SNR: fixed ``cfg.snr_db`` (reference protocol,
@@ -160,7 +179,8 @@ class DMLGridLoader:
             window = perms[:, :, step * bs : (step + 1) * bs]
             if self._pslice is not None:
                 p0, plen = self._pslice
-                window = window[:, :, p0 : p0 + plen]
+                s0, scount = self._sslice
+                window = window[s0 : s0 + scount, :, p0 : p0 + plen]
             idx = jnp.asarray(window)
             # jitter applies to shuffled (training) epochs only: validation
             # iterates with shuffle=False and stays at the fixed cfg.snr_db

@@ -152,6 +152,69 @@ def process_batch_slice(global_bs: int, mesh: Mesh, axis: str = "data") -> tuple
     return jax.process_index() * local, local
 
 
+def process_grid_slice(
+    global_bs: int, n_scenarios: int, mesh: Mesh, fed: bool
+) -> tuple[int, int, int, int]:
+    """The contiguous ``(scenario, batch)`` rectangle THIS process generates.
+
+    Returns ``(scen_start, scen_count, b_start, b_count)``. Generalizes
+    :func:`process_batch_slice` to federated multi-host layouts (BASELINE
+    config 4: federated scenario trunks ACROSS pod slices): with the grid
+    batch sharded S-over-``fed`` and B-over-``data``, a process's devices
+    must occupy a full contiguous rectangle of (fed, data) coordinates —
+    then it synthesizes exactly the scenario rows and batch columns its
+    addressable shards need, and the slice is derived from the OWNED
+    COORDINATES (not the process index), so any block assignment of
+    processes to the grid works. ``model``-axis devices of one (fed, data)
+    cell must stay within one process. Violations fail fast with the
+    offending layout instead of silently permuting the global batch.
+    """
+    nproc = jax.process_count()
+    if nproc == 1:
+        return 0, n_scenarios, 0, global_bs
+    if not fed or mesh.shape.get("fed", 1) == 1:
+        b0, blen = process_batch_slice(global_bs, mesh)
+        return 0, n_scenarios, b0, blen
+    names = list(mesh.axis_names)
+    devs = np.moveaxis(
+        mesh.devices, [names.index("fed"), names.index("data")], [0, 1]
+    )
+    n_fed, n_data = devs.shape[0], devs.shape[1]
+    if n_scenarios % n_fed:
+        raise ValueError(
+            f"{n_scenarios} scenarios do not shard evenly over the fed axis ({n_fed})"
+        )
+    if global_bs % n_data:
+        raise ValueError(
+            f"global batch {global_bs} not divisible by the mesh data axis ({n_data})"
+        )
+    cell_proc = np.empty((n_fed, n_data), dtype=np.int64)
+    for f in range(n_fed):
+        for d in range(n_data):
+            procs = {dev.process_index for dev in np.ravel(devs[f, d])}
+            if len(procs) != 1:
+                raise ValueError(
+                    f"mesh cell (fed={f}, data={d}) spans processes "
+                    f"{sorted(procs)} along the model axis — a cell's "
+                    "tensor-parallel group must live within one process"
+                )
+            cell_proc[f, d] = procs.pop()
+    mine = np.argwhere(cell_proc == jax.process_index())
+    if mine.size == 0:
+        raise ValueError(f"process {jax.process_index()} owns no devices of this mesh")
+    rows, cols = np.unique(mine[:, 0]), np.unique(mine[:, 1])
+    contiguous = lambda a: np.array_equal(a, np.arange(a[0], a[0] + len(a)))  # noqa: E731
+    if len(rows) * len(cols) != len(mine) or not (contiguous(rows) and contiguous(cols)):
+        raise ValueError(
+            f"process {jax.process_index()}'s (fed, data) cells {mine.tolist()} "
+            "do not form a contiguous rectangle — process-local generation "
+            "needs one contiguous (scenario, batch) block per process"
+        )
+    spf = n_scenarios // n_fed
+    bpd = global_bs // n_data
+    return int(rows[0]) * spf, len(rows) * spf, int(cols[0]) * bpd, len(cols) * bpd
+
+
 def make_grid_placer(loader, mesh: Mesh | None, fed: bool = False):
     """Batch-placement policy shared by the production trainers.
 
@@ -190,8 +253,8 @@ def make_grid_placer(loader, mesh: Mesh | None, fed: bool = False):
         from qdml_tpu.parallel.dp import shard_grid_batch
 
         return lambda b: shard_grid_batch(b, mesh, fed=fed)
-    start, local = process_batch_slice(bs, mesh)
-    loader.set_process_slice(start, local)
+    s0, sc, b0, blen = process_grid_slice(bs, loader.cfg.n_scenarios, mesh, fed)
+    loader.set_process_slice(b0, blen, s0, sc)
     return lambda b: local_grid_batch_to_global(b, mesh, fed=fed)
 
 

@@ -1,25 +1,33 @@
 """Subprocess worker for ``test_multihost_2proc.py`` — NOT a test module.
 
 Runs one real HDCE training epoch through the production multi-host path
-(``training_mesh`` -> ``shard_hdce_state`` -> ``make_grid_placer``) either as
-one rank of a genuine 2-process ``jax.distributed`` cluster (rank 0/1, two
-local CPU devices each, Gloo collectives) or as the single-process reference
-(rank -1, four local CPU devices — the same 4-wide data axis in one process).
-Writes the loss history as JSON so the parent test can assert the two
-execution modes are numerically equivalent.
+(``training_mesh`` -> ``shard_hdce_state`` -> ``make_grid_placer``) in one of
+two cluster shapes, or as the matching single-process reference:
 
-Usage: python tests/multihost_worker.py RANK PORT OUT_JSON
+- ``dp``:  2 processes x 2 CPU devices — pure data parallelism (data=4);
+  rank -1 = one process with 4 devices, same 4-wide data axis.
+- ``fed``: 3 processes x 1 CPU device — federated scenario sharding ACROSS
+  processes (fed=3, data=1): each rank generates and trains ONLY its own
+  base station's scenario row, the shared head aggregating over Gloo; rank
+  -1 = one process with 3 devices, same fed=3 mesh.
+
+Writes the loss history as JSON so the parent test can assert the cluster
+reproduces the single-process run.
+
+Usage: python tests/multihost_worker.py MODE RANK PORT OUT_JSON
 """
 
 import json
 import os
 import sys
 
-rank = int(sys.argv[1])
-port = sys.argv[2]
-out_path = sys.argv[3]
+mode = sys.argv[1]
+rank = int(sys.argv[2])
+port = sys.argv[3]
+out_path = sys.argv[4]
 
-n_local = 2 if rank >= 0 else 4
+NPROC = {"dp": 2, "fed": 3}[mode]
+n_local = {"dp": 2, "fed": 1}[mode] if rank >= 0 else {"dp": 4, "fed": 3}[mode]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_local}"
 
@@ -33,12 +41,16 @@ import jax  # noqa: E402
 
 if rank >= 0:
     jax.distributed.initialize(
-        f"localhost:{port}", num_processes=2, process_id=rank, local_device_ids=[0, 1]
+        f"localhost:{port}",
+        num_processes=NPROC,
+        process_id=rank,
+        local_device_ids=list(range(n_local)),
     )
 
 from qdml_tpu.config import (  # noqa: E402
     DataConfig,
     ExperimentConfig,
+    MeshConfig,
     ModelConfig,
     TrainConfig,
 )
@@ -48,11 +60,13 @@ cfg = ExperimentConfig(
     data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=40, train_split=0.8),
     model=ModelConfig(features=8),
     train=TrainConfig(batch_size=8, n_epochs=1, print_freq=1000),
+    mesh=MeshConfig(fed_axis=3) if mode == "fed" else MeshConfig(),
 )
 _, history = train_hdce(cfg)
 with open(out_path, "w") as fh:
     json.dump(
         {
+            "mode": mode,
             "rank": rank,
             "nproc": jax.process_count(),
             "n_global_devices": len(jax.devices()),

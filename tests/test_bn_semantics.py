@@ -99,7 +99,10 @@ def test_fused_vs_percell_bn_drift():
     loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
     batches = list(loader.epoch(0))
     model, state_f = init_hdce_state(cfg, loader.steps_per_epoch)
-    state_p = state_f  # identical init (frozen dataclass, pure updates)
+    # Identical init, but materially distinct buffers: the train step donates
+    # its state on accelerator backends, so an alias would be consumed by the
+    # first step and poison the second.
+    state_p = jax.tree.map(lambda x: jnp.array(x), state_f)
 
     fused = make_hdce_train_step(model, state_f.tx)
     # The per-cell reference applies n_users sequential BN updates per step at

@@ -1,16 +1,21 @@
-"""Two REAL processes through the production multi-host training path.
+"""Real multi-process clusters through the production multi-host training path.
 
 Round-2 verdict (missing #2): every multi-host contract was verified only by
-stubbing ``device.process_index`` in one process. This test launches two
-actual OS processes that form a ``jax.distributed`` cluster on localhost
-(CPU backend, 2 virtual devices each, Gloo collectives), trains one full
-HDCE epoch through ``training_mesh`` / ``shard_hdce_state`` /
-``make_grid_placer`` — per-process slice generation, global array assembly,
-cross-process gradient psum — and asserts the loss history matches the
-single-process run of the identical 4-wide data-parallel config.
+stubbing ``device.process_index`` in one process. These tests launch actual
+OS processes that form ``jax.distributed`` clusters on localhost (CPU
+backend, Gloo collectives) and train a full HDCE epoch through
+``training_mesh`` / ``shard_hdce_state`` / ``make_grid_placer``:
 
-Slow-marked (two cold jax starts + an XLA CPU compile per process); run with
-``pytest -m slow tests/test_multihost_2proc.py``.
+- ``dp``: 2 processes x 2 devices — per-process batch-slice generation,
+  global array assembly, cross-process gradient psum;
+- ``fed``: 3 processes x 1 device — federated scenario sharding ACROSS
+  processes (round-2 weak #7: config 4's "federated across pod slices"):
+  each rank generates and trains only its own scenario row, with the shared
+  head aggregated over the wire.
+
+Each cluster's loss history must match the single-process run of the
+identical mesh. Slow-marked (cold jax starts + XLA CPU compiles per
+process); run with ``pytest -m slow tests/test_multihost_2proc.py``.
 """
 
 import json
@@ -32,18 +37,18 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(rank: int, port: int, out: str, log_path: str) -> subprocess.Popen:
+def _launch(mode: str, rank: int, port: int, out: str, log_path: str) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     # The worker pins its own platform/device-count; scrub ambient overrides.
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
-    # Log to a FILE, not a pipe: two live cluster ranks must never block on
-    # an unread pipe buffer mid-collective while the parent waits on the
-    # other rank (classic sequential-communicate deadlock).
+    # Log to a FILE, not a pipe: live cluster ranks must never block on an
+    # unread pipe buffer mid-collective while the parent waits on another
+    # rank (classic sequential-communicate deadlock).
     log = open(log_path, "w")
     return subprocess.Popen(
-        [sys.executable, _WORKER, str(rank), str(port), out],
+        [sys.executable, _WORKER, mode, str(rank), str(port), out],
         env=env,
         cwd=_REPO,
         stdout=log,
@@ -52,27 +57,40 @@ def _launch(rank: int, port: int, out: str, log_path: str) -> subprocess.Popen:
     )
 
 
-@pytest.mark.slow
-def test_two_process_hdce_matches_single_process(tmp_path):
+def _run_cluster(mode: str, nproc: int, tmp_path):
     port = _free_port()
-    outs = [str(tmp_path / f"rank{r}.json") for r in (0, 1)]
-    log_paths = [str(tmp_path / f"rank{r}.log") for r in (0, 1)]
-    procs = [_launch(r, port, outs[r], log_paths[r]) for r in (0, 1)]
+    outs = [str(tmp_path / f"{mode}_rank{r}.json") for r in range(nproc)]
+    logs = [str(tmp_path / f"{mode}_rank{r}.log") for r in range(nproc)]
+    procs = [_launch(mode, r, port, outs[r], logs[r]) for r in range(nproc)]
+    try:
+        for p in procs:
+            p.wait(timeout=900)
+    except subprocess.TimeoutExpired:
+        # A hung collective is the exact failure mode under test: kill every
+        # rank and surface all logs instead of leaking live processes.
+        for p in procs:
+            p.kill()
+        tails = "\n".join(
+            f"--- {mode} rank {r} ---\n{open(lg).read()[-2000:]}"
+            for r, lg in enumerate(logs)
+        )
+        pytest.fail(f"{mode} cluster deadlocked (15 min):\n{tails}")
     for r, p in enumerate(procs):
-        p.wait(timeout=900)
-    for r, p in enumerate(procs):
-        log = open(log_paths[r]).read()
-        assert p.returncode == 0, f"rank {r} failed:\n{log[-3000:]}"
+        log = open(logs[r]).read()
+        assert p.returncode == 0, f"{mode} rank {r} failed:\n{log[-3000:]}"
 
-    ref_out = str(tmp_path / "single.json")
-    ref_log = str(tmp_path / "single.log")
-    single = _launch(-1, port, ref_out, ref_log)
+    ref_out = str(tmp_path / f"{mode}_single.json")
+    ref_log = str(tmp_path / f"{mode}_single.log")
+    single = _launch(mode, -1, port, ref_out, ref_log)
     single.wait(timeout=900)
     log = open(ref_log).read()
-    assert single.returncode == 0, f"single-process reference failed:\n{log[-3000:]}"
+    assert single.returncode == 0, f"{mode} single-process reference failed:\n{log[-3000:]}"
+    return [json.load(open(o)) for o in outs], json.load(open(ref_out))
 
-    recs = [json.load(open(o)) for o in outs]
-    ref = json.load(open(ref_out))
+
+@pytest.mark.slow
+def test_two_process_hdce_matches_single_process(tmp_path):
+    recs, ref = _run_cluster("dp", 2, tmp_path)
     assert [r["nproc"] for r in recs] == [2, 2]
     assert [r["n_global_devices"] for r in recs] == [4, 4]
     assert ref["nproc"] == 1 and ref["n_global_devices"] == 4
@@ -83,5 +101,18 @@ def test_two_process_hdce_matches_single_process(tmp_path):
     # ...and the 2-process cluster reproduces the single-process run: the
     # per-process slice generation + global assembly is data-identical and
     # the cross-process psum is the same reduction over the same 4-wide mesh.
+    np.testing.assert_allclose(recs[0]["train_loss"], ref["train_loss"], rtol=1e-5)
+    np.testing.assert_allclose(recs[0]["val_nmse"], ref["val_nmse"], rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_three_process_federated_matches_single_process(tmp_path):
+    """Fed-over-the-wire: one base station (scenario trunk) per process."""
+    recs, ref = _run_cluster("fed", 3, tmp_path)
+    assert [r["nproc"] for r in recs] == [3, 3, 3]
+    assert ref["nproc"] == 1 and ref["n_global_devices"] == 3
+
+    for r in (1, 2):
+        np.testing.assert_allclose(recs[0]["train_loss"], recs[r]["train_loss"], rtol=1e-6)
     np.testing.assert_allclose(recs[0]["train_loss"], ref["train_loss"], rtol=1e-5)
     np.testing.assert_allclose(recs[0]["val_nmse"], ref["val_nmse"], rtol=1e-5)

@@ -350,3 +350,65 @@ def test_make_grid_placer_multiprocess_decisions(monkeypatch):
     bad = DMLGridLoader(dcfg, 12)
     with pytest.raises(ValueError, match="multi-process"):
         multihost.make_grid_placer(bad, mesh)
+
+
+def _stub_grid_mesh(pidx_grid):
+    """Stub (fed, data, model) mesh from an array of process indices."""
+    from types import SimpleNamespace
+
+    arr = np.asarray(pidx_grid)
+    devs = np.empty(arr.shape, dtype=object)
+    for i, p in np.ndenumerate(arr):
+        devs[i] = SimpleNamespace(process_index=int(p))
+    return SimpleNamespace(
+        shape={"fed": arr.shape[0], "data": arr.shape[1], "model": arr.shape[2]},
+        devices=devs,
+        axis_names=("fed", "data", "model"),
+    )
+
+
+def test_process_grid_slice_fed_rectangles(monkeypatch):
+    """Federated cross-host ownership: each process generates exactly the
+    (scenario, batch) rectangle its mesh coordinates cover (r2 weak #7)."""
+    from qdml_tpu.parallel.multihost import process_grid_slice
+
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+
+    # one fed row per process, full data axis: scenario-partitioned only
+    rows = [[[p], [p]] for p in range(3)]  # (fed=3, data=2, model=1)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert process_grid_slice(8, 3, _stub_grid_mesh(rows), fed=True) == (1, 1, 0, 8)
+
+    # 6 single-cell processes: scenario AND batch partitioned
+    monkeypatch.setattr(jax, "process_count", lambda: 6)
+    cells = [[[2 * f + d] for d in range(2)] for f in range(3)]
+    monkeypatch.setattr(jax, "process_index", lambda: 5)  # (fed=2, data=1)
+    assert process_grid_slice(8, 3, _stub_grid_mesh(cells), fed=True) == (2, 1, 4, 4)
+
+    # fed=False delegates to the batch-only contract (full scenario range)
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    flat = [[[d // 2] for d in range(6)]]  # (fed=1, data=6, model=1): 2 cols/proc
+    assert process_grid_slice(12, 3, _stub_grid_mesh(flat), fed=False) == (0, 3, 8, 4)
+
+
+def test_process_grid_slice_rejects_bad_layouts(monkeypatch):
+    from qdml_tpu.parallel.multihost import process_grid_slice
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+    # a (fed, data) cell whose model group spans two processes
+    split_cell = [[[0, 1]], [[1, 1]]]  # (fed=2, data=1, model=2)
+    with pytest.raises(ValueError, match="model axis"):
+        process_grid_slice(8, 2, _stub_grid_mesh(split_cell), fed=True)
+
+    # diagonal ownership: cells (0,0) and (1,1) are not a rectangle
+    diag = [[[0], [1]], [[1], [0]]]
+    with pytest.raises(ValueError, match="rectangle"):
+        process_grid_slice(8, 2, _stub_grid_mesh(diag), fed=True)
+
+    # scenario count not divisible by the fed axis
+    rows2 = [[[0]], [[1]]]
+    with pytest.raises(ValueError, match="scenarios"):
+        process_grid_slice(8, 3, _stub_grid_mesh(rows2), fed=True)
