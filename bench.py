@@ -82,8 +82,9 @@ def qsc_fwd_flops_per_sample(cfg) -> float:
     pre = 2 * h * w * 9 * 2 * 16 + 2 * (h // 2) * (w // 2) * 9 * 16 * 32
     pre += 2 * flat * n_q
     dim = 1 << n_q
-    # statevector through one fused unitary: complex matvec ~= 8*dim^2 real
-    circ = 8.0 * dim * dim
+    # real product-state amp through U^T (two real matvecs) + |.|^2 sign
+    # contraction — the closed-form dense/pallas formulation
+    circ = 4.0 * dim * dim + 2.0 * dim * n_q
     head = 2 * n_q * cfg.quantum.n_classes
     return float(pre + circ + head)
 

@@ -96,7 +96,11 @@ def make_sweep_train_step(model: QSCP128, tx) -> Callable:
 
     vstep = jax.vmap(member_step, in_axes=(0, 0, 0, 0, None, None))
 
-    @jax.jit
+    from functools import partial
+
+    from qdml_tpu.utils.platform import donation_argnums
+
+    @partial(jax.jit, donate_argnums=donation_argnums(0, 1))
     def step(params, opt_state, rngs, sigmas, batch):
         x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
         labels = batch["indicator"].reshape(-1)

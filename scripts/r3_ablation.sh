@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-3 ablation (VERDICT r2 #5): settle the raw-pilot low-SNR question.
+# Trains the two missing cells of the {input_norm} x {snr_jitter} grid at the
+# full reference protocol (100 epochs), evals each, and leaves 4 comparable
+# curves: raw (committed r2), norm-only, jitter-only, both (committed r2).
+set -e
+cd /root/repo
+export JAX_PLATFORMS=cpu
+
+for v in norm jitter; do
+  if [ "$v" = norm ]; then OV="--quantum.input_norm=true"; else OV="--data.snr_jitter=5,15"; fi
+  python -m qdml_tpu.cli train-qsc $OV --train.workdir=runs/ab_$v --train.resume=true \
+      > runs/ab_$v.train.log 2>&1
+  mkdir -p runs/ab_$v/Pn_128/default
+  for t in hdce_best hdce_best.meta.json sc_best sc_best.meta.json; do
+    cp -r runs/science/Pn_128/default/$t runs/ab_$v/Pn_128/default/ 2>/dev/null || true
+  done
+  python -m qdml_tpu.cli eval $OV --train.workdir=runs/ab_$v \
+      --eval.results_dir=results/ablation/${v}_only > runs/ab_$v.eval.log 2>&1
+done
+echo "ABLATION DONE"

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-3 multi-seed variance estimate (VERDICT r2 #6): SC + robust-QSC at
+# 3 seeds, 30 epochs, accuracy @ 5 dB with spread.
+set -e
+cd /root/repo
+export JAX_PLATFORMS=cpu
+
+for s in 1 2 3; do
+  WD=runs/ms_s$s
+  SEEDS="--train.seed=$s --data.seed=$((2026 + s))"
+  python -m qdml_tpu.cli train-sc $SEEDS --train.n_epochs=30 \
+      --train.workdir=$WD --train.resume=true > runs/ms_s$s.sc.log 2>&1
+  python -m qdml_tpu.cli train-qsc --preset=robust_qsc $SEEDS --train.n_epochs=30 \
+      --train.workdir=$WD --train.resume=true > runs/ms_s$s.qsc.log 2>&1
+  mkdir -p $WD/Pn_128/robust_qsc
+  for t in hdce_best hdce_best.meta.json; do
+    cp -r runs/science/Pn_128/default/$t $WD/Pn_128/robust_qsc/ 2>/dev/null || true
+  done
+  # SC trained under "default" name; eval runs under the robust preset name —
+  # bring its checkpoint over so one eval sees both classifiers.
+  for t in sc_best sc_best.meta.json; do
+    cp -r $WD/Pn_128/default/$t $WD/Pn_128/robust_qsc/ 2>/dev/null || true
+  done
+  python -m qdml_tpu.cli eval --preset=robust_qsc --train.seed=$s --train.workdir=$WD \
+      --eval.results_dir=results/robust/seed$s > runs/ms_s$s.eval.log 2>&1
+done
+echo "MULTISEED DONE"

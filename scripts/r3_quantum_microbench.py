@@ -1,0 +1,90 @@
+"""On-chip micro-benchmark: quantum-circuit forward formulations + QSC steps.
+
+Run on the real TPU when the tunnel is up:
+    python runs/r3_quantum_microbench.py [out.json]
+
+Measures, at the shipped shape (n=6, L=3, batch 2304):
+  - forward-only: dense (closed-form product state), pallas (whole-circuit
+    kernel), pallas_old (round-2 psi-input kernel), tensor
+  - full QSC train step: dense vs pallas backends
+  - HDCE train step f32/bf16 (donation now on) for the MFU item
+"""
+
+import json
+import sys
+import time
+
+from qdml_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 2304
+N, L = 6, 3
+
+
+def timed(fn, *args, reps=50):
+    out = fn(*args)
+    jnp.asarray(out).block_until_ready()
+    float(jnp.sum(out))  # host transfer forces execution through the tunnel
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    float(jnp.sum(out))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    rng = np.random.default_rng(0)
+    angles = jnp.asarray(rng.uniform(-1, 1, (BATCH, N)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(-3, 3, (L, N, 2)).astype(np.float32))
+
+    from qdml_tpu.quantum.circuits import angle_embed, ansatz_unitary, run_circuit
+    from qdml_tpu.quantum import statevector as sv
+    from qdml_tpu.quantum.pallas_kernels import fused_unitary_expvals
+
+    res = {"backend": jax.default_backend(), "batch": BATCH, "n": N, "layers": L}
+
+    for backend in ("dense", "pallas", "tensor"):
+        f = jax.jit(lambda a, ww, b=backend: run_circuit(a, ww, N, L, b))
+        dt = timed(f, angles, w)
+        res[f"fwd_{backend}_us"] = round(dt * 1e6, 1)
+        res[f"fwd_{backend}_sps"] = round(BATCH / dt, 1)
+
+    # round-2 psi-input kernel as the baseline comparison
+    def old_pallas(a, ww):
+        psi = angle_embed(sv.zero_state(N, (a.shape[0],)), a, N)
+        return fused_unitary_expvals(psi, ansatz_unitary(ww, N, L), N)
+
+    dt = timed(jax.jit(old_pallas), angles, w)
+    res["fwd_pallas_old_us"] = round(dt * 1e6, 1)
+    res["fwd_pallas_old_sps"] = round(BATCH / dt, 1)
+
+    # full train steps via the bench harness's own builders
+    sys.path.insert(0, ".")
+    import bench
+
+    for key, fn in (
+        ("qsc_dense", lambda: bench._bench_qsc("dense", 50, 45.0)),
+        ("qsc_pallas", lambda: bench._bench_qsc("pallas", 50, 45.0)),
+        ("hdce_f32", lambda: bench._bench_hdce("float32", 50, 60.0)),
+        ("hdce_bf16", lambda: bench._bench_hdce("bfloat16", 50, 60.0)),
+    ):
+        try:
+            res[key] = fn()
+        except Exception as e:  # noqa: BLE001
+            res[key] = {"error": f"{type(e).__name__}: {e}"}
+        print(key, res[key], flush=True)
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "runs/r3_quantum_microbench.json"
+    with open(out_path, "w") as fh:
+        json.dump(res, fh, indent=1)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
