@@ -127,6 +127,10 @@ def run_circuit(
 
         u = ansatz_unitary(weights, n_qubits, n_layers)
         return fused_qsc_expvals(angles, u, n_qubits)
+    if backend == "sharded":
+        from qdml_tpu.quantum.sharded import run_circuit_sharded
+
+        return run_circuit_sharded(angles, weights, n_qubits, n_layers)
     psi = sv.zero_state(n_qubits, angles.shape[:-1])
     psi = angle_embed(psi, angles, n_qubits)
     if backend == "tensor":
@@ -140,10 +144,6 @@ def run_circuit(
         for l in range(n_layers):
             psi = apply_rotation_layer(psi, weights[l], n_qubits)
             psi = sv.apply_perm(psi, ring)
-    elif backend == "sharded":
-        from qdml_tpu.quantum.sharded import run_circuit_sharded
-
-        return run_circuit_sharded(angles, weights, n_qubits, n_layers)
     else:
         raise ValueError(f"unknown backend {backend!r}; want one of {VALID_BACKENDS}")
     return sv.expvals_z(psi, n_qubits)
