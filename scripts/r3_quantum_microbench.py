@@ -37,6 +37,43 @@ def timed(fn, *args, reps=50):
     return (time.perf_counter() - t0) / reps
 
 
+def _bench_hdce_bs(bench, cell_bs: int) -> dict:
+    """bench._bench_hdce at a non-reference cell batch (same FLOP model)."""
+    saved = bench._CELL_BS
+    bench._CELL_BS = cell_bs
+    try:
+        out = bench._bench_hdce("bfloat16", 50, 60.0)
+    finally:
+        bench._CELL_BS = saved
+    return out
+
+
+def capture_trace(out_dir: str = "runs/r3_tpu_trace"):
+    """jax.profiler trace of the bf16 HDCE step (roofline evidence)."""
+    from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
+    from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
+
+    sys.path.insert(0, ".")
+    import bench
+
+    cfg = ExperimentConfig(
+        data=DataConfig(),
+        model=ModelConfig(dtype="bfloat16"),
+        train=TrainConfig(batch_size=256, n_epochs=1),
+    )
+    batch = bench._make_grid_batch(cfg)
+    batch = {k: batch[k] for k in ("yp_img", "h_label", "h_perf")}
+    model, state = init_hdce_state(cfg, steps_per_epoch=100)
+    step = make_hdce_train_step(model, state.tx)
+    state, m = step(state, batch)
+    float(m["loss"])
+    with jax.profiler.trace(out_dir):
+        for _ in range(10):
+            state, m = step(state, batch)
+        float(m["loss"])
+    print("trace ->", out_dir, flush=True)
+
+
 def main():
     print("backend:", jax.default_backend(), flush=True)
     rng = np.random.default_rng(0)
@@ -73,6 +110,11 @@ def main():
         ("qsc_pallas", lambda: bench._bench_qsc("pallas", 50, 45.0)),
         ("hdce_f32", lambda: bench._bench_hdce("float32", 50, 60.0)),
         ("hdce_bf16", lambda: bench._bench_hdce("bfloat16", 50, 60.0)),
+        # batch-scaling probe for the MFU item: if MFU rises materially at
+        # 512/cell the 256-step carries fixed overhead; if flat, it is
+        # bandwidth-bound at this model size (roofline evidence either way)
+        ("hdce_bf16_b512", lambda: _bench_hdce_bs(bench, 512)),
+        ("hdce_bf16_b1024", lambda: _bench_hdce_bs(bench, 1024)),
     ):
         try:
             res[key] = fn()
@@ -84,6 +126,10 @@ def main():
     with open(out_path, "w") as fh:
         json.dump(res, fh, indent=1)
     print(json.dumps(res))
+    try:
+        capture_trace()
+    except Exception as e:  # noqa: BLE001
+        print("trace capture failed:", e, flush=True)
 
 
 if __name__ == "__main__":
