@@ -49,7 +49,9 @@ def _bench_hdce_bs(bench, cell_bs: int) -> dict:
 
 
 def capture_trace(out_dir: str = "runs/r3_tpu_trace"):
-    """jax.profiler trace of the bf16 HDCE step (roofline evidence)."""
+    """jax.profiler trace of EXACTLY the bench's bf16 HDCE step setup —
+    shared builders, same _CELL_BS — so the trace explains the same shape
+    the benchmark measured."""
     from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
     from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
 
@@ -59,7 +61,7 @@ def capture_trace(out_dir: str = "runs/r3_tpu_trace"):
     cfg = ExperimentConfig(
         data=DataConfig(),
         model=ModelConfig(dtype="bfloat16"),
-        train=TrainConfig(batch_size=256, n_epochs=1),
+        train=TrainConfig(batch_size=bench._CELL_BS, n_epochs=1),
     )
     batch = bench._make_grid_batch(cfg)
     batch = {k: batch[k] for k in ("yp_img", "h_label", "h_perf")}
