@@ -295,12 +295,13 @@ def probe_tpu(attempts: int | None = None, timeout_s: int | None = None) -> str 
 
     The tunnelled axon backend drops and restores on minutes timescales
     (two rounds of driver artifacts show a 2-attempt probe losing the race),
-    so the default probe is patient: 5 attempts with exponential backoff
-    spreading ~6 minutes of sleep between them, and the parent re-probes
-    once more after the CPU fallback bench has burned several further
-    minutes (see main) before conceding a cpu_fallback record.
+    so probing is patient AND spread: 3 backoff attempts up front, then the
+    CPU fallback bench burns ~10 further minutes, then 3 more attempts
+    (see main) — a ~25-minute window overall — before conceding a
+    cpu_fallback record, while keeping the worst-case harness runtime near
+    the envelope the driver has already tolerated.
     """
-    attempts = attempts or int(os.environ.get("QDML_BENCH_PROBE_ATTEMPTS", "5"))
+    attempts = attempts or int(os.environ.get("QDML_BENCH_PROBE_ATTEMPTS", "3"))
     timeout_s = timeout_s or int(os.environ.get("QDML_BENCH_PROBE_TIMEOUT", "150"))
     err = "unknown"
     for i in range(attempts):
@@ -393,7 +394,7 @@ def main() -> int:
         # Last-chance TPU re-attempt: the CPU bench just spent several
         # minutes — enough for a flapping tunnel to have come back. A late
         # TPU record always supersedes the CPU fallback.
-        if probe_tpu(attempts=2) is None:
+        if probe_tpu(attempts=3) is None:
             late, late_err = try_tpu_bench()
             if late is not None:
                 details, tpu_error, platform = late, None, f"tpu-{gen}"
