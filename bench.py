@@ -50,6 +50,11 @@ _PEAK_BF16 = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 # host and recorded in the committed BENCH_r02.json
 # ("torch_cpu_reference_sps": 1389.3). Every round's ``vs_baseline`` divides
 # by THIS constant, so the headline is comparable across rounds and hosts.
+# NOTE the older self-reported results/bench_tpu_v5e_r2.json predates the
+# constant and divided by its own host's much slower live baseline (278.5
+# sps on the TPU VM -> "2928x"); against this constant the same measurement
+# is 587x. Records since round 3 carry both the constant and the live
+# number so the two scales can never be conflated again.
 REFERENCE_TORCH_CPU_SPS = 1389.3
 
 _GRID = (3, 3)
@@ -394,7 +399,7 @@ def main() -> int:
         # Last-chance TPU re-attempt: the CPU bench just spent several
         # minutes — enough for a flapping tunnel to have come back. A late
         # TPU record always supersedes the CPU fallback.
-        if probe_tpu(attempts=3) is None:
+        if probe_tpu() is None:  # attempts honor QDML_BENCH_PROBE_ATTEMPTS
             late, late_err = try_tpu_bench()
             if late is not None:
                 details, tpu_error, platform = late, None, f"tpu-{gen}"

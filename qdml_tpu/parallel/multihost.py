@@ -56,36 +56,34 @@ def ensure_initialized(**kwargs) -> None:
         raise
 
 
-# Env markers that indicate this host is part of a multi-host accelerator
-# cluster, where jax's pod autodetection is worth attempting. On anything
-# else (laptops, single-host TPU VMs, CI) the bare initialize() attempt is
-# skipped entirely: its benign-fallback contract rests on autodetection
-# raising exactly ValueError, and a successful 1-process initialize (or a
-# slow metadata probe) would change plain single-host startup for nothing.
-# A GCE (non-GKE) TPU pod advertises itself only via the metadata server —
-# no env marker exists there, so such deployments must either set the
-# explicit JAX_COORDINATOR_ADDRESS triple or opt in with
-# QDML_POD_AUTODETECT=1 (docs/MULTIHOST.md).
-_POD_ENV_HINTS = (
-    "TPU_WORKER_HOSTNAMES",
-    "TPU_WORKER_ID",
-    "TPU_PROCESS_ADDRESSES",
-    "MEGASCALE_COORDINATOR_ADDRESS",
-    "CLOUD_TPU_TASK_ID",
-)
+# Env-marker PREFIXES that indicate this host is (or may be) part of an
+# accelerator cluster where jax's pod autodetection is worth attempting. On
+# hosts with none of them (laptops, CI, CPU boxes) the bare initialize()
+# attempt is skipped entirely: its benign-fallback contract rests on
+# autodetection raising exactly ValueError, and a slow metadata probe would
+# change plain startup for nothing. The net is deliberately WIDE over TPU
+# environments — a GCE (non-GKE) pod advertises its topology only via the
+# metadata server, but its runtime image still exports TPU_* variables, so
+# prefix matching keeps autodetection live there (a 1-process initialize on
+# a single-host TPU VM is benign); QDML_POD_AUTODETECT=1 covers anything
+# exotic (docs/MULTIHOST.md).
+_POD_ENV_HINT_PREFIXES = ("TPU_", "MEGASCALE_", "CLOUD_TPU_")
 
 
 def pod_env_hint() -> bool:
-    """Whether the environment looks like a multi-host pod worker.
+    """Whether the environment looks like an accelerator-cluster worker.
 
     Platform markers count on any non-empty value (``TPU_WORKER_ID=0`` is a
     real rank); the explicit ``QDML_POD_AUTODETECT`` opt-in is parsed as a
     boolean so ``=0``/``=false`` means what it says.
     """
     optin = os.environ.get("QDML_POD_AUTODETECT", "").strip().lower()
-    if optin in ("1", "true", "yes"):
-        return True
-    return any(os.environ.get(k) for k in _POD_ENV_HINTS)
+    if optin:
+        return optin in ("1", "true", "yes")
+    return any(
+        k.startswith(_POD_ENV_HINT_PREFIXES) and v
+        for k, v in os.environ.items()
+    )
 
 
 def init_distributed_from_env() -> bool:

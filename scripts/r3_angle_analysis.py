@@ -9,7 +9,7 @@ collapse is input-scale-driven tanh saturation, the raw path should show
 power growing as SNR drops with angles saturating, while the normalized
 path holds the trained activation range at every SNR.
 
-Usage: JAX_PLATFORMS=cpu python runs/r3_angle_analysis.py [workdir] [out.json]
+Usage: JAX_PLATFORMS=cpu python scripts/r3_angle_analysis.py [workdir] [out.json]
 """
 
 import json

@@ -1,6 +1,9 @@
 #!/bin/bash
 # Round-3 multi-seed variance estimate (VERDICT r2 #6): SC + robust-QSC at
-# 3 seeds, 30 epochs, accuracy @ 5 dB with spread.
+# 3 seeds, 30 epochs, accuracy @ 5 dB with spread. Eval deliberately omits
+# --data.seed so every seed scores on the COMMON seed-2026 fresh test
+# stream: across-seed differences then measure training variance, not
+# test-set resampling noise.
 set -e
 cd /root/repo
 export JAX_PLATFORMS=cpu

@@ -1,7 +1,7 @@
 """On-chip micro-benchmark: quantum-circuit forward formulations + QSC steps.
 
 Run on the real TPU when the tunnel is up:
-    python runs/r3_quantum_microbench.py [out.json]
+    python scripts/r3_quantum_microbench.py [out.json]
 
 Measures, at the shipped shape (n=6, L=3, batch 2304):
   - forward-only: dense (closed-form product state), pallas (whole-circuit

@@ -1,7 +1,7 @@
 """Assemble results/ablation and results/robust multi-seed summaries.
 
-Run after runs/r3_ablation.sh and runs/r3_multiseed.sh complete:
-    PYTHONPATH=/root/repo python runs/r3_summarize.py
+Run after scripts/r3_ablation.sh and scripts/r3_multiseed.sh complete:
+    PYTHONPATH=/root/repo python scripts/r3_summarize.py
 """
 
 import json
@@ -33,6 +33,8 @@ def ablation_table() -> str:
             continue
         snr = d["snr"]
         rows[label] = d["acc"].get("quantum")
+    if snr is None:
+        raise SystemExit("no ablation curve files found — run scripts/r3_ablation.sh first")
     out = ["| Quantum-SC accuracy | " + " | ".join(f"{int(s)} dB" for s in snr) + " |"]
     out.append("|" + "---|" * (len(snr) + 1))
     for label, acc in rows.items():
@@ -57,6 +59,8 @@ def multiseed_table() -> str:
         seeds.append(s)
         for k in per_seed:
             per_seed[k].append(d["acc"][k][i5])
+    if not seeds:
+        raise SystemExit("no per-seed eval files found — run scripts/r3_multiseed.sh first")
     lines = [
         "| Accuracy @ 5 dB | mean | spread (min..max) | per-seed |",
         "|---|---|---|---|",

@@ -82,7 +82,13 @@ def _run_cluster(mode: str, nproc: int, tmp_path):
     ref_out = str(tmp_path / f"{mode}_single.json")
     ref_log = str(tmp_path / f"{mode}_single.log")
     single = _launch(mode, -1, port, ref_out, ref_log)
-    single.wait(timeout=900)
+    try:
+        single.wait(timeout=900)
+    except subprocess.TimeoutExpired:
+        single.kill()
+        pytest.fail(
+            f"{mode} single-process reference hung:\n{open(ref_log).read()[-2000:]}"
+        )
     log = open(ref_log).read()
     assert single.returncode == 0, f"{mode} single-process reference failed:\n{log[-3000:]}"
     return [json.load(open(o)) for o in outs], json.load(open(ref_out))
