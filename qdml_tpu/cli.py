@@ -186,11 +186,14 @@ def main(argv: list[str] | None = None) -> int:
                 # eval rebuilds the right model (reference QSCs are raw-pilot:
                 # no input normalization).
                 qw = tree["params"]["qweights"]
+                from qdml_tpu.quantum.circuits import resolve_backend
+
                 meta["quantum"] = {
                     "n_qubits": int(qw.shape[1]),
                     "n_layers": int(qw.shape[0]),
                     "n_classes": int(tree["params"]["Dense_0"]["bias"].shape[0]),
-                    "backend": cfg.quantum.backend,
+                    # resolved path, not the "auto" alias (provenance)
+                    "backend": resolve_backend(cfg.quantum.backend, int(qw.shape[1])),
                     "input_norm": False,
                 }
             save_checkpoint(workdir, f"{name}_best", tree, meta)

@@ -85,12 +85,14 @@ class QuantumConfig:
     gradient_threshold: float = 0.1   # on-chip-QNN pruning threshold (Estimators...py:119)
     # QuantumNAT sigma grid for the vmapped noise-sweep ensemble (config 5)
     noise_sweep: tuple[float, ...] = (0.0, 0.01, 0.05, 0.1)
-    # simulator backend: "dense" builds per-layer unitaries (MXU matmuls, best
-    # for n<=10), "tensor" applies gates on the (2,)*n tensor (n<=14),
-    # "sharded" partitions the statevector over the mesh (n>=14), "auto"
-    # picks dense/tensor by qubit count; plus "pallas"/"pallas_tensor"
-    # kernel paths (see qdml_tpu.quantum.circuits.VALID_BACKENDS).
-    backend: str = "dense"
+    # simulator backend: "auto" (default) resolves by platform and qubit
+    # count — the whole-circuit Pallas kernel on TPU for n<=8 (measured
+    # fastest on-chip, results/bench_tpu_v5e_r3.json), XLA "dense" per-ansatz
+    # unitaries otherwise up to n<=10, gate-wise "tensor" above that;
+    # "sharded" (explicit) partitions the statevector over the mesh (n>=14);
+    # plus explicit "pallas"/"pallas_tensor" kernel paths
+    # (see qdml_tpu.quantum.circuits.resolve_backend / VALID_BACKENDS).
+    backend: str = "auto"
     # Per-sample RMS input normalization (scale-invariant angle encoding;
     # fixes low-SNR collapse of the raw-pilot QSC). OFF = reference parity.
     input_norm: bool = False

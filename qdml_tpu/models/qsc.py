@@ -40,7 +40,7 @@ class QSCP128(nn.Module):
     n_classes: int = 3
     use_quantumnat: bool = False   # reference ships with this OFF (Runner...py:313-316)
     noise_level: float = 0.01      # QuantumNAT sigma (Estimators...py:118)
-    backend: str = "dense"
+    backend: str = "auto"  # platform-aware resolution (circuits.resolve_backend)
     # Per-sample RMS normalization of the pilot image before the CNN. OFF by
     # default (reference parity: QSC_P128 consumes raw pilots). The raw-pilot
     # angle encoding is scale-sensitive — a classifier trained at SNR 10

@@ -92,6 +92,30 @@ def ansatz_unitary(weights: jnp.ndarray, n: int, n_layers: int) -> CArr:
     return total
 
 
+def resolve_backend(backend: str, n_qubits: int) -> str:
+    """Resolve ``auto`` to a concrete execution path.
+
+    Qubit count picks the formulation: the dense per-ansatz unitary (MXU
+    matmuls) wins up to ~10 qubits; past that its 2^n x 2^n build dominates
+    and the gate-wise tensor path wins; from ~14 qubits the statevector
+    should be mesh-sharded instead (select "sharded" explicitly — it needs a
+    multi-device mesh this helper cannot assume). Within the dense regime,
+    on a real TPU the whole-circuit Pallas kernel is the measured-fastest
+    path at the reference shapes (1.22x the XLA dense step on v5e,
+    ``results/bench_tpu_v5e_r3.json``) up to its n<=8 VMEM budget; on
+    non-TPU backends the kernel only has interpret mode, so XLA dense wins.
+    """
+    if backend != "auto":
+        return backend
+    if n_qubits > 10:
+        return "tensor"
+    import jax
+
+    if n_qubits <= 8 and jax.default_backend() == "tpu":
+        return "pallas"
+    return "dense"
+
+
 def run_circuit(
     angles: jnp.ndarray,
     weights: jnp.ndarray,
@@ -100,13 +124,7 @@ def run_circuit(
     backend: str = "dense",
 ) -> jnp.ndarray:
     """Full reference circuit: angles (..., n) -> per-wire <Z> (..., n)."""
-    if backend == "auto":
-        # Pick by qubit count: the dense per-ansatz unitary (MXU matmuls) wins
-        # up to ~10 qubits; past that its 2^n x 2^n build dominates and the
-        # gate-wise tensor path wins; from ~14 qubits the statevector should
-        # be mesh-sharded instead (select "sharded" explicitly — it needs a
-        # multi-device mesh this helper cannot assume).
-        backend = "dense" if n_qubits <= 10 else "tensor"
+    backend = resolve_backend(backend, n_qubits)
     if backend == "dense":
         # Closed-form embedding: the RY-embedded state is a REAL product
         # state (sv.ry_product_state), so the whole circuit is two real

@@ -45,12 +45,23 @@ def reconcile_quantum_cfg(cfg, meta: dict):
         return cfg
     stored = dict(stored)
     trained_backend = stored.pop("backend", None)
-    if trained_backend is not None and trained_backend != cfg.quantum.backend:
-        print(
-            f"note: checkpoint was trained with backend={trained_backend!r}; "
-            f"evaluating with backend={cfg.quantum.backend!r} (numerically "
-            "equivalent execution strategies)"
-        )
+    n_q = stored.get("n_qubits", cfg.quantum.n_qubits)
+    if trained_backend is not None:
+        # Compare RESOLVED execution paths: with "auto" in play, the stored
+        # and configured strings can differ while naming the identical path
+        # (auto->dense on CPU vs a 'dense' checkpoint) or match while the
+        # path actually changes across platforms — only the resolution is
+        # meaningful provenance.
+        from qdml_tpu.quantum.circuits import resolve_backend
+
+        trained_res = resolve_backend(trained_backend, n_q)
+        eval_res = resolve_backend(cfg.quantum.backend, n_q)
+        if trained_res != eval_res:
+            print(
+                f"note: checkpoint was trained on the {trained_res!r} circuit "
+                f"path (backend={trained_backend!r}); evaluating on "
+                f"{eval_res!r} (numerically equivalent execution strategies)"
+            )
     mismatch = {k: v for k, v in stored.items() if getattr(cfg.quantum, k) != v}
     if mismatch:
         print(f"using checkpoint quantum config {mismatch}")

@@ -28,6 +28,7 @@ from qdml_tpu.data.datasets import DMLGridLoader
 from qdml_tpu.models.cnn import SCP128
 from qdml_tpu.models.losses import nll_loss
 from qdml_tpu.models.qsc import QSCP128
+from qdml_tpu.quantum.circuits import resolve_backend
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
 from qdml_tpu.train.state import TrainState
@@ -174,7 +175,12 @@ def train_classifier(
                     "n_qubits": cfg.quantum.n_qubits,
                     "n_layers": cfg.quantum.n_layers,
                     "n_classes": cfg.quantum.n_classes,
-                    "backend": cfg.quantum.backend,
+                    # store the RESOLVED path: "auto" means different things
+                    # on different platforms, so the concrete resolution is
+                    # the only meaningful provenance for the reconcile note
+                    "backend": resolve_backend(
+                        cfg.quantum.backend, cfg.quantum.n_qubits
+                    ),
                     "input_norm": cfg.quantum.input_norm,
                 }
             if val_acc > best_acc:

@@ -200,3 +200,26 @@ def test_auto_backend_matches_explicit():
         a = run_circuit(angles, w, n, 1, "auto")
         b = run_circuit(angles, w, n, 1, other)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_resolve_backend_decisions(monkeypatch):
+    """auto resolves by qubit count AND platform: the Pallas whole-circuit
+    kernel only wins on a real TPU (results/bench_tpu_v5e_r3.json); everywhere
+    else it has only interpret mode, so XLA dense must be chosen."""
+    import jax
+
+    from qdml_tpu.quantum.circuits import resolve_backend
+
+    # explicit backends pass through untouched
+    assert resolve_backend("tensor", 6) == "tensor"
+    assert resolve_backend("sharded", 16) == "sharded"
+    # CPU (this suite's pinned platform): dense in the small-n regime
+    assert jax.default_backend() == "cpu"
+    assert resolve_backend("auto", 6) == "dense"
+    assert resolve_backend("auto", 11) == "tensor"
+    # TPU: the fused kernel up to its n<=8 VMEM budget, dense above it
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_backend("auto", 6) == "pallas"
+    assert resolve_backend("auto", 8) == "pallas"
+    assert resolve_backend("auto", 10) == "dense"
+    assert resolve_backend("auto", 12) == "tensor"
