@@ -8,11 +8,16 @@ Headline metric: full fused HDCE training-step throughput over the 3x3
 scenario/user DML grid at the reference batch size (256/cell => 2304
 samples/step; the reference's nine-sequential-backwards loop,
 ``Runner_P128_QuantumNAT_onchipQNN.py:181-204``). On TPU the headline is the
-bfloat16-activation step (the MXU fast path this framework targets); on the
-CPU fallback it is the reference-dtype float32 step — the ``dtype`` field
-records which. ``details`` always carries BOTH HDCE dtypes plus the
-quantum-classifier (QSC) step on the dense and Pallas circuit backends, each
-with achieved model FLOP/s and MFU against the chip's bf16 peak.
+scan-fused bfloat16 path (``train.scan_steps=16``: 16 train steps per device
+dispatch with each step's batch synthesized ON DEVICE inside the scan — the
+throughput a real training run achieves end to end, data generation
+included); on the CPU fallback it is the reference-dtype float32
+step-per-dispatch measurement — the ``dtype`` and ``unit`` fields record
+which. ``details`` always carries the per-dispatch HDCE step in both dtypes
+plus the quantum-classifier (QSC) step on the dense and Pallas circuit
+backends, each with achieved model FLOP/s and MFU against the chip's bf16
+peak (MFU counts model FLOPs only — the in-scan data synthesis is unpaid
+overhead, which makes the scan MFU an honest end-to-end figure).
 
 Robustness (VERDICT round 1, weak #1): the parent process never imports jax.
 It probes the TPU backend in a subprocess with a hard timeout and retries
@@ -121,6 +126,17 @@ def _timed_sps(step, state, batch, sync, max_steps: int, budget_s: float) -> flo
     return n / (time.perf_counter() - t0)
 
 
+def _grid_coords():
+    """(scen, user, idx) coordinate grids for one (S, U, B) bench batch."""
+    import jax.numpy as jnp
+
+    s, u = _GRID
+    scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, _CELL_BS))
+    user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, _CELL_BS))
+    idx = jnp.broadcast_to(jnp.arange(_CELL_BS)[None, None, :], (s, u, _CELL_BS))
+    return scen, user, idx
+
+
 def _make_grid_batch(cfg):
     import jax.numpy as jnp
 
@@ -128,10 +144,7 @@ def _make_grid_batch(cfg):
     from qdml_tpu.data.datasets import make_network_batch
 
     geom = ChannelGeometry.from_config(cfg.data)
-    s, u = _GRID
-    scen = jnp.broadcast_to(jnp.arange(s)[:, None, None], (s, u, _CELL_BS))
-    user = jnp.broadcast_to(jnp.arange(u)[None, :, None], (s, u, _CELL_BS))
-    idx = jnp.broadcast_to(jnp.arange(_CELL_BS)[None, None, :], (s, u, _CELL_BS))
+    scen, user, idx = _grid_coords()
     return make_network_batch(
         jnp.uint32(0), scen, user, idx, jnp.float32(cfg.data.snr_db), geom
     )
@@ -156,6 +169,48 @@ def _bench_hdce(dtype: str, max_steps: int, budget_s: float) -> dict:
     samples = sps * _GRID[0] * _GRID[1] * _CELL_BS
     tflops = samples * 3.0 * hdce_fwd_flops_per_sample(cfg) / 1e12
     return {"samples_per_sec": round(samples, 1), "model_tflops": round(tflops, 3)}
+
+
+def _bench_hdce_scan(dtype: str, k: int, max_steps: int, budget_s: float) -> dict:
+    """The scan-fused training path (qdml_tpu.train.hdce.make_hdce_scan_steps):
+    K steps per device dispatch, batches synthesized on-device inside the
+    scan. This is the throughput a real training run achieves with
+    ``train.scan_steps=K`` — it removes the per-step host dispatch gap that
+    caps the K=1 wall MFU at ~0.27 on the tunnelled backend
+    (docs/ROOFLINE.md: 1.42 ms device-busy vs 2.9 ms wall)."""
+    import jax.numpy as jnp
+
+    from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.train.hdce import init_hdce_state, make_hdce_scan_steps
+
+    cfg = ExperimentConfig(
+        data=DataConfig(),
+        model=ModelConfig(dtype=dtype),
+        train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
+    )
+    geom = ChannelGeometry.from_config(cfg.data)
+    s, u = _GRID
+    scen, user, idx1 = _grid_coords()
+    idx = jnp.broadcast_to(idx1[None], (k, s, u, _CELL_BS)).astype(jnp.int32)
+    snrs = jnp.full((k,), float(cfg.data.snr_db), jnp.float32)
+    model, state = init_hdce_state(cfg, steps_per_epoch=100)
+    run = make_hdce_scan_steps(model, geom)
+    seed = jnp.uint32(0)
+
+    def step(state, _):
+        return run(state, seed, scen, user, idx, snrs)
+
+    sps = _timed_sps(
+        step, state, None, lambda m: float(m["loss"][-1]), max_steps, budget_s
+    )
+    samples = sps * k * s * u * _CELL_BS
+    tflops = samples * 3.0 * hdce_fwd_flops_per_sample(cfg) / 1e12
+    return {
+        "samples_per_sec": round(samples, 1),
+        "model_tflops": round(tflops, 3),
+        "scan_steps": k,
+    }
 
 
 def _bench_qsc(backend: str, max_steps: int, budget_s: float) -> dict:
@@ -206,12 +261,24 @@ def run_child(platform: str) -> int:
     # Each sub-bench is independently guarded so one failing measurement
     # (flaky tunnelled backend, pallas unsupported off-TPU, ...) degrades to
     # an error entry instead of discarding the measurements that succeeded.
-    for key, fn in (
+    scan_k = 16
+    benches = [
         ("hdce_f32", lambda: _bench_hdce("float32", max_steps, budget)),
         ("hdce_bf16", lambda: _bench_hdce("bfloat16", max_steps, budget)),
+    ]
+    if on_tpu:
+        # The scan-fused path exists to remove the per-step host dispatch gap
+        # of the tunnelled accelerator; on the CPU fallback a single
+        # full-geometry step is ~13 s, so the K-step variant would only burn
+        # the child's budget re-measuring the same compute.
+        benches.append(
+            ("hdce_bf16_scan", lambda: _bench_hdce_scan("bfloat16", scan_k, max_steps, budget))
+        )
+    benches += [
         ("qsc_dense", lambda: _bench_qsc("dense", max_steps, budget / 2)),
         ("qsc_pallas", lambda: _bench_qsc("pallas", max_steps, budget / 2)),
-    ):
+    ]
+    for key, fn in benches:
         try:
             out[key] = fn()
         except Exception as e:
@@ -424,16 +491,21 @@ def main() -> int:
     # MFU vs the generation's bf16 peak (conservative for the f32 run). Only
     # meaningful on the TPU; CPU fallback reports null.
     on_tpu = platform != "cpu_fallback"
-    for k in ("hdce_f32", "hdce_bf16", "qsc_dense", "qsc_pallas"):
+    for k in ("hdce_f32", "hdce_bf16", "hdce_bf16_scan", "qsc_dense", "qsc_pallas"):
         d = details.get(k)
         if isinstance(d, dict) and "model_tflops" in d:
             d["mfu"] = round(d["model_tflops"] * 1e12 / peak, 4) if on_tpu else None
 
     # Headline: the framework's intended fast path — bf16 activations on the
-    # MXU — when on TPU; the reference-dtype f32 step on the CPU fallback.
-    # The dtype is part of the record so the two are never conflated. If the
-    # preferred measurement errored, fall back to the other dtype's.
-    order = ("hdce_bf16", "hdce_f32") if on_tpu else ("hdce_f32", "hdce_bf16")
+    # MXU with scan-fused dispatch (what train.scan_steps=K runs) — when on
+    # TPU; the reference-dtype f32 step on the CPU fallback. The dtype is
+    # part of the record so the two are never conflated. If the preferred
+    # measurement errored, fall back down the list.
+    order = (
+        ("hdce_bf16_scan", "hdce_bf16", "hdce_f32")
+        if on_tpu
+        else ("hdce_f32", "hdce_bf16")
+    )
     key = next(
         (k for k in order if "samples_per_sec" in details.get(k, {})), None
     )
@@ -452,13 +524,22 @@ def main() -> int:
             )
         )
         return 1
-    dtype = {"hdce_bf16": "bfloat16", "hdce_f32": "float32"}[key]
+    dtype = {
+        "hdce_bf16": "bfloat16",
+        "hdce_bf16_scan": "bfloat16",
+        "hdce_f32": "float32",
+    }[key]
     headline = details[key]
     value = headline["samples_per_sec"]
+    scan_note = (
+        f", {headline['scan_steps']}-step fused dispatch"
+        if "scan_steps" in headline
+        else ""
+    )
     record = {
         "metric": "hdce_train_samples_per_sec_per_chip",
         "value": value,
-        "unit": f"samples/sec (3x3 DML grid train step, cell batch 256, {dtype})",
+        "unit": f"samples/sec (3x3 DML grid train step, cell batch 256, {dtype}{scan_note})",
         # Fixed committed constant (round-2 driver host) — comparable across
         # rounds; the live same-host measurement is context only.
         "vs_baseline": round(value / REFERENCE_TORCH_CPU_SPS, 2),

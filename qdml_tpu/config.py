@@ -116,6 +116,14 @@ class TrainConfig:
     weight_decay: float = 0.01   # AdamW wd for the QSC (Runner...py:320)
     momentum: float = 0.9        # SGD momentum (Runner...py:45)
     print_freq: int = 50         # batch-loss print period (Runner...py:30)
+    # Train steps fused into ONE device dispatch (lax.scan over the jitted
+    # step with on-device batch synthesis inside the scan body). 1 = the
+    # reference's step-per-dispatch loop. On the tunnelled single-chip
+    # backend the host-side dispatch gap is ~half the step wall time
+    # (docs/ROOFLINE.md), so fusing K steps lifts wall MFU toward the
+    # device-busy figure. Used by the on-device-generation training path;
+    # ignored (with a warning) under multi-host sliced loaders.
+    scan_steps: int = 1
     seed: int = 0
     workdir: str = "workspace"   # checkpoint root (reference ./workspace/Pn_128/HDCE)
     resume: bool = False         # reference cannot resume; we can

@@ -203,7 +203,14 @@ def sample_channel(
     a = _steering(f, geom.n_ant)  # (L, n_ant)
     b = _delay_response(tau, geom.n_sub)  # (L, n_sub)
     w = CArr(alpha.re[:, None], alpha.im[:, None]) * a  # (L, n_ant)
-    return ceinsum("la,lk->ak", w, b)  # (n_ant, n_sub)
+    # Materialize the steering/delay factors before the path contraction.
+    # Without this barrier XLA (TPU) fuses the sin/cos chains INTO the
+    # reduction loop — a "convolution fusion" that recomputes the trig for
+    # every (antenna, subcarrier) output element, ~n_sub*n_ant-fold redundant
+    # work that made this contraction 5x the cost of the whole rest of the
+    # generator (measured on v5e: 3.0 -> 0.57 ms per 2304-sample batch).
+    wre, wim, bre, bim = jax.lax.optimization_barrier((w.re, w.im, b.re, b.im))
+    return ceinsum("la,lk->ak", CArr(wre, wim), CArr(bre, bim))  # (n_ant, n_sub)
 
 
 @partial(jax.jit, static_argnames=("geom",))

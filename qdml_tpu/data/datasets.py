@@ -160,6 +160,12 @@ class DMLGridLoader:
             jnp.arange(u)[None, :, None], (scen_count, u, length)
         )
 
+    @property
+    def grid_coords(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Broadcast ``(scenario, user)`` coordinate grids matching the index
+        windows this loader yields (accounts for any process slice)."""
+        return self._scen, self._user
+
     def _step_snr(self, epoch: int, step: int) -> float:
         """Per-step training SNR: fixed ``cfg.snr_db`` (reference protocol,
         SNRdb=10) or, with ``cfg.snr_jitter=(lo, hi)``, drawn uniformly per
@@ -172,27 +178,53 @@ class DMLGridLoader:
         rng = np.random.default_rng((self.cfg.seed, 7, epoch, step))
         return float(rng.uniform(lo_hi[0], lo_hi[1]))
 
-    def epoch(self, epoch: int, shuffle: bool = True) -> Iterator[dict[str, jnp.ndarray]]:
+    def _step_window(self, perms: np.ndarray, step: int) -> np.ndarray:
+        """This step's (S, U, bs) index window, process-sliced if configured.
+        Single source for both iterators below: the scan path's bitwise
+        equivalence to the per-step path rests on them slicing identically."""
         bs = self.batch_size
+        window = perms[:, :, step * bs : (step + 1) * bs]
+        if self._pslice is not None:
+            p0, plen = self._pslice
+            s0, scount = self._sslice
+            window = window[s0 : s0 + scount, :, p0 : p0 + plen]
+        return window
+
+    def _snr_for(self, epoch: int, step: int, shuffle: bool) -> float:
+        # jitter applies to shuffled (training) epochs only: validation
+        # iterates with shuffle=False and stays at the fixed cfg.snr_db
+        return self._step_snr(epoch, step) if shuffle else float(self.cfg.snr_db)
+
+    def epoch(self, epoch: int, shuffle: bool = True) -> Iterator[dict[str, jnp.ndarray]]:
         perms = _epoch_perms(self.cfg, self.n, self.index_base, epoch, shuffle)
         for step in range(self.steps_per_epoch):
-            window = perms[:, :, step * bs : (step + 1) * bs]
-            if self._pslice is not None:
-                p0, plen = self._pslice
-                s0, scount = self._sslice
-                window = window[s0 : s0 + scount, :, p0 : p0 + plen]
-            idx = jnp.asarray(window)
-            # jitter applies to shuffled (training) epochs only: validation
-            # iterates with shuffle=False and stays at the fixed cfg.snr_db
-            snr = self._step_snr(epoch, step) if shuffle else float(self.cfg.snr_db)
+            idx = jnp.asarray(self._step_window(perms, step))
             yield make_network_batch(
                 jnp.uint32(self.cfg.seed),
                 self._scen,
                 self._user,
                 idx,
-                jnp.float32(snr),
+                jnp.float32(self._snr_for(epoch, step, shuffle)),
                 self.geom,
             )
+
+    def epoch_chunks(
+        self, epoch: int, k: int, shuffle: bool = True
+    ) -> Iterator[tuple[jnp.ndarray, jnp.ndarray]]:
+        """Scan-fused view of :meth:`epoch`: ``(idx (k', S, U, B), snr (k',))``
+        chunks covering the SAME per-step index windows and per-step SNRs the
+        step-at-a-time iterator would produce, grouped ``k`` steps at a time
+        (the final chunk may be shorter). Feed to
+        :func:`qdml_tpu.train.hdce.make_hdce_scan_steps` — the device
+        synthesizes each step's batch inside the scan, so the host dispatches
+        once per chunk. At most two chunk lengths occur per epoch (``k`` and
+        the tail), bounding jit recompilation."""
+        perms = _epoch_perms(self.cfg, self.n, self.index_base, epoch, shuffle)
+        for c0 in range(0, self.steps_per_epoch, k):
+            steps = range(c0, min(c0 + k, self.steps_per_epoch))
+            windows = np.stack([self._step_window(perms, step) for step in steps])
+            snrs = [self._snr_for(epoch, step, shuffle) for step in steps]
+            yield jnp.asarray(windows), jnp.asarray(snrs, jnp.float32)
 
 
 def generate_datapair(

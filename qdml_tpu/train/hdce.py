@@ -37,10 +37,11 @@ from functools import partial
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
-from qdml_tpu.data.datasets import DMLGridLoader
+from qdml_tpu.data.datasets import DMLGridLoader, make_network_batch
 from qdml_tpu.models.cnn import FCP128, StackedConvP128, activation_dtype
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
@@ -82,36 +83,82 @@ def cell_nmse(pred: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
     return err / pow_
 
 
+def _fused_step(model: HDCE, state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+    """One fused grid step (traceable; jitted by the makers below)."""
+    s, u, b = batch["yp_img"].shape[:3]
+    x = batch["yp_img"].reshape(s, u * b, *batch["yp_img"].shape[3:])
+    label = batch["h_label"]
+    perf = batch["h_perf"]
+
+    def loss_fn(params):
+        out, upd = model.apply(
+            {"params": params, "batch_stats": state.batch_stats},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        pred = out.reshape(s, u, b, -1)
+        loss = jnp.mean(cell_nmse(pred, label))  # == reference sum(cell/9)
+        loss_perf = jnp.mean(cell_nmse(pred, perf))
+        return loss, (upd["batch_stats"], loss_perf)
+
+    (loss, (new_stats, loss_perf)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params
+    )
+    state = state.apply_gradients(grads=grads)
+    state = state.replace(batch_stats=new_stats)
+    return state, {"loss": loss, "loss_perf": loss_perf}
+
+
 def make_hdce_train_step(model: HDCE, tx) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
-        s, u, b = batch["yp_img"].shape[:3]
-        x = batch["yp_img"].reshape(s, u * b, *batch["yp_img"].shape[3:])
-        label = batch["h_label"]
-        perf = batch["h_perf"]
-
-        def loss_fn(params):
-            out, upd = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                x,
-                train=True,
-                mutable=["batch_stats"],
-            )
-            pred = out.reshape(s, u, b, -1)
-            loss = jnp.mean(cell_nmse(pred, label))  # == reference sum(cell/9)
-            loss_perf = jnp.mean(cell_nmse(pred, perf))
-            return loss, (upd["batch_stats"], loss_perf)
-
-        (loss, (new_stats, loss_perf)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
-        state = state.apply_gradients(grads=grads)
-        state = state.replace(batch_stats=new_stats)
-        return state, {"loss": loss, "loss_perf": loss_perf}
+        return _fused_step(model, state, batch)
 
     return step
+
+
+def make_hdce_scan_steps(model: HDCE, geom: ChannelGeometry) -> Callable:
+    """K train steps in ONE device dispatch.
+
+    ``lax.scan`` over the fused step with batch synthesis *inside* the scan
+    body (the jitted channel generator makes the whole K-step block a single
+    XLA program, so the host enters the loop once per K steps instead of once
+    per step). On the tunnelled single-chip backend the per-step dispatch gap
+    is comparable to the step itself (docs/ROOFLINE.md: 1.42 ms device-busy
+    vs 2.9 ms wall at K=1) — this is the "keep the host out of the loop"
+    lever that trace identified.
+
+    Returned callable: ``run(state, seed, scen, user, idx, snrs)`` with
+    ``idx (K, S, U, B) i32`` per-step sample indices and ``snrs (K,) f32``
+    per-step training SNRs; returns ``(state, {"loss": (K,), "loss_perf":
+    (K,)})`` — the same per-step metrics the K individual dispatches would
+    have produced (bitwise-identical update sequence, ``tests/test_train.py``).
+    """
+    from qdml_tpu.utils.platform import donation_argnums
+
+    @partial(jax.jit, donate_argnums=donation_argnums(0))
+    def run(
+        state: TrainState,
+        seed: jnp.ndarray,
+        scen: jnp.ndarray,
+        user: jnp.ndarray,
+        idx: jnp.ndarray,
+        snrs: jnp.ndarray,
+    ) -> tuple[TrainState, dict]:
+        def body(state, inp):
+            idx_k, snr = inp
+            batch = make_network_batch(seed, scen, user, idx_k, snr, geom)
+            batch = {k: batch[k] for k in ("yp_img", "h_label", "h_perf")}
+            state, m = _fused_step(model, state, batch)
+            return state, m
+
+        state, ms = jax.lax.scan(body, state, (idx, snrs))
+        return state, ms
+
+    return run
 
 
 def make_hdce_eval_step(model: HDCE) -> Callable:
@@ -200,14 +247,43 @@ def train_hdce(
     place_train = make_grid_placer(train_loader, mesh, fed=fed)
     place_val = make_grid_placer(val_loader, mesh, fed=fed)
 
+    # Scan-fused dispatch (cfg.train.scan_steps > 1): K steps per device
+    # dispatch with on-device batch synthesis inside the scan. Only on the
+    # single-device path — under a mesh the placer owns batch placement (and
+    # under multiple processes, per-host slice generation), which the
+    # in-scan generator would bypass.
+    scan_k = cfg.train.scan_steps
+    scan_run = None
+    if scan_k > 1:
+        if mesh is None:
+            scan_run = make_hdce_scan_steps(model, geom)
+        else:
+            logger.log(
+                warning=f"scan_steps={scan_k} ignored: mesh execution uses the "
+                "per-step placer data path"
+            )
+
     history: dict[str, list] = {"train_loss": [], "val_nmse": [], "val_nmse_perf": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
-        for batch in train_loader.epoch(epoch):
-            state, m = train_step(state, place_train(batch))
-            tot, n = tot + float(m["loss"]), n + 1
-            if n % cfg.train.print_freq == 0:
-                logger.log(step=int(state.step), epoch=epoch, loss=float(m["loss"]))
+        if scan_run is not None:
+            seed = jnp.uint32(cfg.data.seed)
+            scen, user = train_loader.grid_coords
+            for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
+                state, ms = scan_run(state, seed, scen, user, idx, snrs)
+                # one bulk transfer for the (K,) loss vector — K separate
+                # float() calls would reintroduce the per-step host round
+                # trips the scan dispatch just removed
+                losses = np.asarray(jax.device_get(ms["loss"]))
+                tot, n = tot + float(losses.sum()), n + losses.size
+                if (n // scan_k) % max(cfg.train.print_freq // scan_k, 1) == 0:
+                    logger.log(step=int(state.step), epoch=epoch, loss=float(losses[-1]))
+        else:
+            for batch in train_loader.epoch(epoch):
+                state, m = train_step(state, place_train(batch))
+                tot, n = tot + float(m["loss"]), n + 1
+                if n % cfg.train.print_freq == 0:
+                    logger.log(step=int(state.step), epoch=epoch, loss=float(m["loss"]))
         train_loss = tot / max(n, 1)
 
         sums = {"err": 0.0, "pow": 0.0, "err_perf": 0.0, "pow_perf": 0.0}

@@ -49,24 +49,56 @@ def build_classifier(cfg: ExperimentConfig, quantum: bool) -> nn.Module:
     return SCP128(n_classes=cfg.quantum.n_classes)
 
 
+def _sc_step(
+    model: nn.Module, needs_rng: bool, state: TrainState, batch: dict, rng: jax.Array
+) -> tuple[TrainState, dict]:
+    """One classifier grid step (traceable; jitted by the makers below)."""
+    x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+    labels = batch["indicator"].reshape(-1)
+
+    def loss_fn(params):
+        kwargs = {"rngs": {"quantumnat": rng}} if needs_rng else {}
+        log_probs = model.apply({"params": params}, x, train=True, **kwargs)
+        return nll_loss(log_probs, labels)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    state = state.apply_gradients(grads=grads)
+    return state, {"loss": loss}
+
+
 def make_sc_train_step(model: nn.Module, needs_rng: bool) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict, rng: jax.Array):
-        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
-        labels = batch["indicator"].reshape(-1)
-
-        def loss_fn(params):
-            kwargs = {"rngs": {"quantumnat": rng}} if needs_rng else {}
-            log_probs = model.apply({"params": params}, x, train=True, **kwargs)
-            return nll_loss(log_probs, labels)
-
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        state = state.apply_gradients(grads=grads)
-        return state, {"loss": loss}
+        return _sc_step(model, needs_rng, state, batch, rng)
 
     return step
+
+
+def make_sc_scan_steps(
+    model: nn.Module, geom: ChannelGeometry, needs_rng: bool
+) -> Callable:
+    """K classifier train steps in ONE device dispatch (lax.scan with on-device
+    batch synthesis — the HDCE counterpart is
+    :func:`qdml_tpu.train.hdce.make_hdce_scan_steps`; rationale in
+    docs/ROOFLINE.md). ``rngs (K, 2)`` carries one pre-split QuantumNAT key
+    per step so the noise stream matches the per-step dispatch loop exactly."""
+    from qdml_tpu.data.datasets import make_network_batch
+    from qdml_tpu.utils.platform import donation_argnums
+
+    @partial(jax.jit, donate_argnums=donation_argnums(0))
+    def run(state, seed, scen, user, idx, snrs, rngs):
+        def body(state, inp):
+            idx_k, snr, rng = inp
+            batch = make_network_batch(seed, scen, user, idx_k, snr, geom)
+            batch = {k: batch[k] for k in ("yp_img", "indicator")}
+            return _sc_step(model, needs_rng, state, batch, rng)
+
+        state, ms = jax.lax.scan(body, state, (idx, snrs, rngs))
+        return state, ms
+
+    return run
 
 
 def make_sc_eval_step(model: nn.Module) -> Callable:
@@ -141,16 +173,44 @@ def train_classifier(
     place_train = make_grid_placer(train_loader, mesh)
     place_val = make_grid_placer(val_loader, mesh)
 
+    # Scan-fused dispatch (cfg.train.scan_steps > 1): see train_hdce — only
+    # on the single-device path, where the in-scan generator can own the
+    # batch without bypassing the mesh placer.
+    scan_k = cfg.train.scan_steps
+    scan_run = None
+    if scan_k > 1:
+        if mesh is None:
+            scan_run = make_sc_scan_steps(model, geom, needs_rng)
+        else:
+            logger.log(
+                warning=f"scan_steps={scan_k} ignored: mesh execution uses the "
+                "per-step placer data path"
+            )
+
     # Fold the start epoch into the QuantumNAT noise stream so resumed epochs
     # draw FRESH noise instead of replaying epochs 0..start_epoch-1's draws.
     rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), start_epoch)
     history: dict[str, list] = {"train_loss": [], "val_loss": [], "val_acc": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
-        for batch in train_loader.epoch(epoch):
-            rng, sub = jax.random.split(rng)
-            state, m = train_step(state, place_train(batch), sub)
-            tot, n = tot + float(m["loss"]), n + 1
+        if scan_run is not None:
+            seed = jnp.uint32(cfg.data.seed)
+            scen, user = train_loader.grid_coords
+            for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
+                subs = []
+                for _ in range(idx.shape[0]):
+                    rng, sub = jax.random.split(rng)
+                    subs.append(sub)
+                state, ms = scan_run(
+                    state, seed, scen, user, idx, snrs, jnp.stack(subs)
+                )
+                tot = tot + float(jnp.sum(ms["loss"]))
+                n += idx.shape[0]
+        else:
+            for batch in train_loader.epoch(epoch):
+                rng, sub = jax.random.split(rng)
+                state, m = train_step(state, place_train(batch), sub)
+                tot, n = tot + float(m["loss"]), n + 1
         train_loss = tot / max(n, 1)
 
         sums = {"nll_sum": 0.0, "correct": 0.0, "count": 0.0}
