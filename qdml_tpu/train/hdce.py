@@ -120,7 +120,52 @@ def make_hdce_train_step(model: HDCE, tx) -> Callable:
     return step
 
 
-def make_hdce_scan_steps(model: HDCE, geom: ChannelGeometry) -> Callable:
+def _grid_batch_constrainer(mesh, fed: bool) -> Callable:
+    """Sharding constraint for an in-scan generated grid batch: B over
+    ``data`` (and optionally S over ``fed``), the same layout the per-step
+    placer produces (:func:`qdml_tpu.parallel.dp.grid_batch_spec`). Inside
+    jit this makes XLA partition the batch SYNTHESIS itself across the mesh —
+    each device generates only its own shard, the intra-process twin of the
+    multi-host per-slice generation path."""
+    from jax.sharding import NamedSharding
+
+    from qdml_tpu.parallel.dp import grid_batch_spec
+
+    def constrain(batch: dict) -> dict:
+        return {
+            k: jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, grid_batch_spec(mesh, fed, v.ndim))
+            )
+            for k, v in batch.items()
+        }
+
+    return constrain
+
+
+def scan_eligible(cfg: ExperimentConfig, mesh, loader, logger) -> bool:
+    """Whether the scan-fused dispatch path may own the data for this run.
+
+    Shared gate for both trainers: eligible single-device, or on a
+    single-process mesh whose ``data`` axis divides the batch. Multi-process
+    runs (per-host slice generation + global assembly) and non-dividing
+    batches (the placer runs those replicated) keep the per-step placer
+    path; logs the fallback when scan_steps was requested but ineligible."""
+    if cfg.train.scan_steps <= 1:
+        return False
+    if mesh is None:
+        return True
+    if jax.process_count() == 1 and loader.batch_size % mesh.shape["data"] == 0:
+        return True
+    logger.log(
+        warning=f"scan_steps={cfg.train.scan_steps} ignored: multi-process "
+        "or non-dividing batch uses the per-step placer data path"
+    )
+    return False
+
+
+def make_hdce_scan_steps(
+    model: HDCE, geom: ChannelGeometry, mesh=None, fed: bool = False
+) -> Callable:
     """K train steps in ONE device dispatch.
 
     ``lax.scan`` over the fused step with batch synthesis *inside* the scan
@@ -131,6 +176,12 @@ def make_hdce_scan_steps(model: HDCE, geom: ChannelGeometry) -> Callable:
     vs 2.9 ms wall at K=1) — this is the "keep the host out of the loop"
     lever that trace identified.
 
+    With a (single-process) ``mesh``, the synthesized batch is sharding-
+    constrained to the same (fed, data) layout the per-step placer uses, so
+    the scan program runs SPMD: generation and training both partition over
+    the mesh and XLA inserts the gradient psum, exactly as in the per-step
+    path.
+
     Returned callable: ``run(state, seed, scen, user, idx, snrs)`` with
     ``idx (K, S, U, B) i32`` per-step sample indices and ``snrs (K,) f32``
     per-step training SNRs; returns ``(state, {"loss": (K,), "loss_perf":
@@ -138,6 +189,8 @@ def make_hdce_scan_steps(model: HDCE, geom: ChannelGeometry) -> Callable:
     have produced (bitwise-identical update sequence, ``tests/test_train.py``).
     """
     from qdml_tpu.utils.platform import donation_argnums
+
+    constrain = _grid_batch_constrainer(mesh, fed) if mesh is not None else (lambda b: b)
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def run(
@@ -151,7 +204,7 @@ def make_hdce_scan_steps(model: HDCE, geom: ChannelGeometry) -> Callable:
         def body(state, inp):
             idx_k, snr = inp
             batch = make_network_batch(seed, scen, user, idx_k, snr, geom)
-            batch = {k: batch[k] for k in ("yp_img", "h_label", "h_perf")}
+            batch = constrain({k: batch[k] for k in ("yp_img", "h_label", "h_perf")})
             state, m = _fused_step(model, state, batch)
             return state, m
 
@@ -248,20 +301,13 @@ def train_hdce(
     place_val = make_grid_placer(val_loader, mesh, fed=fed)
 
     # Scan-fused dispatch (cfg.train.scan_steps > 1): K steps per device
-    # dispatch with on-device batch synthesis inside the scan. Only on the
-    # single-device path — under a mesh the placer owns batch placement (and
-    # under multiple processes, per-host slice generation), which the
-    # in-scan generator would bypass.
+    # dispatch with on-device batch synthesis inside the scan, composing
+    # with a single-process mesh via a sharding constraint on the generated
+    # batch (eligibility rules in scan_eligible).
     scan_k = cfg.train.scan_steps
     scan_run = None
-    if scan_k > 1:
-        if mesh is None:
-            scan_run = make_hdce_scan_steps(model, geom)
-        else:
-            logger.log(
-                warning=f"scan_steps={scan_k} ignored: mesh execution uses the "
-                "per-step placer data path"
-            )
+    if scan_eligible(cfg, mesh, train_loader, logger):
+        scan_run = make_hdce_scan_steps(model, geom, mesh=mesh, fed=fed)
 
     history: dict[str, list] = {"train_loss": [], "val_nmse": [], "val_nmse_perf": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):

@@ -77,22 +77,29 @@ def make_sc_train_step(model: nn.Module, needs_rng: bool) -> Callable:
 
 
 def make_sc_scan_steps(
-    model: nn.Module, geom: ChannelGeometry, needs_rng: bool
+    model: nn.Module, geom: ChannelGeometry, needs_rng: bool, mesh=None
 ) -> Callable:
     """K classifier train steps in ONE device dispatch (lax.scan with on-device
     batch synthesis — the HDCE counterpart is
     :func:`qdml_tpu.train.hdce.make_hdce_scan_steps`; rationale in
     docs/ROOFLINE.md). ``rngs (K, 2)`` carries one pre-split QuantumNAT key
-    per step so the noise stream matches the per-step dispatch loop exactly."""
+    per step so the noise stream matches the per-step dispatch loop exactly.
+    With a (single-process) ``mesh``, the generated batch is constrained to
+    the data-parallel layout so the whole scan runs SPMD."""
     from qdml_tpu.data.datasets import make_network_batch
+    from qdml_tpu.train.hdce import _grid_batch_constrainer
     from qdml_tpu.utils.platform import donation_argnums
+
+    constrain = (
+        _grid_batch_constrainer(mesh, fed=False) if mesh is not None else (lambda b: b)
+    )
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def run(state, seed, scen, user, idx, snrs, rngs):
         def body(state, inp):
             idx_k, snr, rng = inp
             batch = make_network_batch(seed, scen, user, idx_k, snr, geom)
-            batch = {k: batch[k] for k in ("yp_img", "indicator")}
+            batch = constrain({k: batch[k] for k in ("yp_img", "indicator")})
             return _sc_step(model, needs_rng, state, batch, rng)
 
         state, ms = jax.lax.scan(body, state, (idx, snrs, rngs))
@@ -173,19 +180,14 @@ def train_classifier(
     place_train = make_grid_placer(train_loader, mesh)
     place_val = make_grid_placer(val_loader, mesh)
 
-    # Scan-fused dispatch (cfg.train.scan_steps > 1): see train_hdce — only
-    # on the single-device path, where the in-scan generator can own the
-    # batch without bypassing the mesh placer.
+    # Scan-fused dispatch (cfg.train.scan_steps > 1): same machinery and
+    # eligibility rules as train_hdce (qdml_tpu.train.hdce.scan_eligible).
+    from qdml_tpu.train.hdce import scan_eligible
+
     scan_k = cfg.train.scan_steps
     scan_run = None
-    if scan_k > 1:
-        if mesh is None:
-            scan_run = make_sc_scan_steps(model, geom, needs_rng)
-        else:
-            logger.log(
-                warning=f"scan_steps={scan_k} ignored: mesh execution uses the "
-                "per-step placer data path"
-            )
+    if scan_eligible(cfg, mesh, train_loader, logger):
+        scan_run = make_sc_scan_steps(model, geom, needs_rng, mesh=mesh)
 
     # Fold the start epoch into the QuantumNAT noise stream so resumed epochs
     # draw FRESH noise instead of replaying epochs 0..start_epoch-1's draws.

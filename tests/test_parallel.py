@@ -412,3 +412,39 @@ def test_process_grid_slice_rejects_bad_layouts(monkeypatch):
     rows2 = [[[0]], [[1]]]
     with pytest.raises(ValueError, match="scenarios"):
         process_grid_slice(8, 3, _stub_grid_mesh(rows2), fed=True)
+
+
+def test_scan_fused_steps_on_mesh_match_single_device():
+    """Scan-fused dispatch composes with a single-process DP mesh: the
+    sharding constraint on the in-scan generated batch makes the whole
+    K-step program run SPMD with the same losses/params as the unsharded
+    scan (generation partitions over the mesh — the intra-process twin of
+    the multi-host per-slice data path)."""
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.train.hdce import make_hdce_scan_steps
+
+    cfg, state, _, _ = _tiny_setup()
+    geom = ChannelGeometry.from_config(cfg.data)
+    loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
+    scen, user = loader.grid_coords
+    idx, snrs = next(loader.epoch_chunks(0, k=3))
+    seed = jnp.uint32(cfg.data.seed)
+
+    from qdml_tpu.train.hdce import init_hdce_state as _init
+
+    model, state_a = _init(cfg, loader.steps_per_epoch)
+    _, state_b = _init(cfg, loader.steps_per_epoch)
+    run_single = make_hdce_scan_steps(model, geom)
+    state_a, ms_a = run_single(state_a, seed, scen, user, idx, snrs)
+
+    mesh = make_mesh(MeshConfig(data_axis=-1, model_axis=1, fed_axis=1))
+    state_b = replicate(state_b, mesh)
+    run_mesh = make_hdce_scan_steps(model, geom, mesh=mesh)
+    state_b, ms_b = run_mesh(state_b, seed, scen, user, idx, snrs)
+
+    np.testing.assert_allclose(
+        np.asarray(ms_b["loss"]), np.asarray(ms_a["loss"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        _first_leaf(state_b.params), _first_leaf(state_a.params), rtol=1e-4, atol=1e-6
+    )
