@@ -19,7 +19,6 @@ included.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax
@@ -52,8 +51,9 @@ def make_sweep_step(
     qsc_vars: dict | None,
     profile: jnp.ndarray,
 ):
-    """Build the jitted per-batch sweep step. Returns accumulator dicts of
-    error/power sums and correct-counts."""
+    """Build the jitted per-batch sweep step: ``step(start, count_base,
+    snr_db)`` returns a dict of error/power sums and correct-counts for one
+    ``eval.batch_size`` batch."""
     hdce = HDCE(
         n_scenarios=cfg.data.n_scenarios,
         features=cfg.model.features,
@@ -73,8 +73,9 @@ def make_sweep_step(
     )
     n_scen = cfg.data.n_scenarios
 
-    @partial(jax.jit, static_argnames=())
-    def step(start: jnp.ndarray, count_base: jnp.ndarray, snr_db: jnp.ndarray) -> dict:
+    def _batch_metrics(
+        start: jnp.ndarray, count_base: jnp.ndarray, snr_db: jnp.ndarray
+    ) -> dict:
         bs = cfg.eval.batch_size
         i = count_base + jnp.arange(bs)
         scen = i % n_scen
@@ -118,7 +119,34 @@ def make_sweep_step(
             out[f"correct_{name}"] = jnp.sum(pred == batch["indicator"]).astype(jnp.float32)
         return out
 
-    return step
+    return jax.jit(_batch_metrics)
+
+
+def make_snr_scan(cfg: ExperimentConfig, batch_metrics, n_batches: int):
+    """One device dispatch per SNR point: ``lax.scan`` over the batch index,
+    stacking each batch's metric dict; the (n_batches,)-shaped outputs are
+    summed host-side in float64, matching the per-batch dispatch loop's
+    accumulation (sequential float64 adds of float32 batch values). Replaces
+    ``n_batches`` separate dispatches plus ~10 blocking scalar transfers per
+    batch with ONE dispatch and one transfer set — the eval twin of the
+    training scan path (docs/ROOFLINE.md)."""
+    import numpy as np
+
+    bs = cfg.eval.batch_size
+
+    @jax.jit
+    def _stacked(start: jnp.ndarray, snr_db: jnp.ndarray) -> dict:
+        def body(_, b):
+            return None, batch_metrics(start, b * bs, snr_db)
+
+        _, outs = jax.lax.scan(body, None, jnp.arange(n_batches))
+        return outs
+
+    def sweep_one_snr(start: jnp.ndarray, snr_db: jnp.ndarray) -> dict:
+        outs = jax.device_get(_stacked(start, snr_db))
+        return {k: float(np.asarray(v, np.float64).sum()) for k, v in outs.items()}
+
+    return sweep_one_snr
 
 
 def run_snr_sweep(
@@ -138,21 +166,14 @@ def run_snr_sweep(
     geom = ChannelGeometry.from_config(cfg.data)
     profile = beam_delay_profile(geom)
     step = make_sweep_step(cfg, geom, hdce_vars, sc_vars, qsc_vars, profile)
+    n_batches = max(cfg.eval.test_len // cfg.eval.batch_size, 1)
+    sweep_one_snr = make_snr_scan(cfg, step, n_batches)
 
     start = cfg.data.data_len * 3  # offset past training data (Test.py:127)
     curves: dict[str, list] = {}
     accs: dict[str, list] = {}
     for snr in cfg.eval.snr_grid:
-        sums: dict[str, float] = {}
-        n_batches = max(cfg.eval.test_len // cfg.eval.batch_size, 1)
-        for b in range(n_batches):
-            out = step(
-                jnp.asarray(start),
-                jnp.asarray(b * cfg.eval.batch_size),
-                jnp.float32(snr),
-            )
-            for k, v in out.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
+        sums = sweep_one_snr(jnp.asarray(start), jnp.float32(snr))
         pow_ = max(sums["pow"], 1e-30)
         row: dict[str, float] = {}
         for key in sums:

@@ -149,3 +149,32 @@ def test_reconcile_quantum_cfg():
     )
     assert out.quantum.backend == cfg.quantum.backend
     assert out.quantum.n_qubits == 4
+
+
+def test_snr_scan_matches_per_batch_loop():
+    """The scanned per-SNR sweep accumulates exactly what the per-batch
+    dispatch loop would (same generation indices, same accumulation order)."""
+    from qdml_tpu.data.baselines import beam_delay_profile
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.eval.sweep import make_snr_scan, make_sweep_step
+
+    cfg = _sweep_cfg()
+    geom = ChannelGeometry.from_config(cfg.data)
+    model, state = init_hdce_state(cfg, steps_per_epoch=1)
+    hdce_vars = {"params": state.params, "batch_stats": state.batch_stats}
+    sc_model, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=1)
+    sc_vars = {"params": sc_state.params}
+    step = make_sweep_step(cfg, geom, hdce_vars, sc_vars, None, beam_delay_profile(geom))
+
+    n_batches = cfg.eval.test_len // cfg.eval.batch_size
+    start = jnp.asarray(cfg.data.data_len * 3)
+    snr = jnp.float32(5.0)
+    sums: dict = {}
+    for b in range(n_batches):
+        out = step(start, jnp.asarray(b * cfg.eval.batch_size), snr)
+        for k, v in out.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+
+    scanned = make_snr_scan(cfg, step, n_batches)(start, snr)
+    for k, v in sums.items():
+        np.testing.assert_allclose(scanned[k], v, rtol=1e-6)
