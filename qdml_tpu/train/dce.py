@@ -29,33 +29,48 @@ from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
 
 
+def _dce_step(model: DCEP128, state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+    """One DCE grid step (traceable; jitted by the makers below)."""
+    x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+    label = batch["h_label"].reshape(x.shape[0], -1)
+    perf = batch["h_perf"].reshape(x.shape[0], -1)
+
+    def loss_fn(params):
+        pred, upd = model.apply(
+            {"params": params, "batch_stats": state.batch_stats},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        loss = nmse_loss(pred, label)
+        return loss, (upd["batch_stats"], nmse_loss(pred, perf))
+
+    (loss, (new_stats, loss_perf)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(state.params)
+    state = state.apply_gradients(grads=grads)
+    state = state.replace(batch_stats=new_stats)
+    return state, {"loss": loss, "loss_perf": loss_perf}
+
+
 def make_dce_train_step(model: DCEP128) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
-        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
-        label = batch["h_label"].reshape(x.shape[0], -1)
-        perf = batch["h_perf"].reshape(x.shape[0], -1)
-
-        def loss_fn(params):
-            pred, upd = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                x,
-                train=True,
-                mutable=["batch_stats"],
-            )
-            loss = nmse_loss(pred, label)
-            return loss, (upd["batch_stats"], nmse_loss(pred, perf))
-
-        (loss, (new_stats, loss_perf)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        state = state.apply_gradients(grads=grads)
-        state = state.replace(batch_stats=new_stats)
-        return state, {"loss": loss, "loss_perf": loss_perf}
+        return _dce_step(model, state, batch)
 
     return step
+
+
+def make_dce_scan_steps(model: DCEP128, geom: ChannelGeometry) -> Callable:
+    """K DCE train steps in ONE device dispatch via the shared scan machinery
+    (:func:`qdml_tpu.train.scan.make_scan_steps`)."""
+    from qdml_tpu.train.scan import make_scan_steps
+
+    return make_scan_steps(
+        partial(_dce_step, model), geom, ("yp_img", "h_label", "h_perf")
+    )
 
 
 def make_dce_eval_step(model: DCEP128) -> Callable:
@@ -109,12 +124,28 @@ def train_dce(
         state, start_epoch, rmeta = try_resume(workdir, "dce_resume", state)
         best = float(rmeta.get("best", best))
 
+    # Scan-fused dispatch, same machinery as train_hdce (this trainer is
+    # single-device, so eligibility reduces to scan_steps > 1).
+    from qdml_tpu.train.scan import scan_eligible
+
+    scan_run = None
+    if scan_eligible(cfg, None, train_loader, logger):
+        scan_run = make_dce_scan_steps(model, geom)
+
     history: dict[str, list] = {"train_loss": [], "val_nmse": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
-        for batch in train_loader.epoch(epoch):
-            state, m = train_step(state, batch)
-            tot, n = tot + float(m["loss"]), n + 1
+        if scan_run is not None:
+            seed = jnp.uint32(cfg.data.seed)
+            scen, user = train_loader.grid_coords
+            for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
+                state, ms = scan_run(state, seed, scen, user, idx, snrs)
+                tot = tot + float(jnp.sum(ms["loss"]))
+                n += idx.shape[0]
+        else:
+            for batch in train_loader.epoch(epoch):
+                state, m = train_step(state, batch)
+                tot, n = tot + float(m["loss"]), n + 1
         train_loss = tot / max(n, 1)
 
         sums = {"err": 0.0, "pow": 0.0}

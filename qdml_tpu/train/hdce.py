@@ -41,10 +41,11 @@ import numpy as np
 
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
-from qdml_tpu.data.datasets import DMLGridLoader, make_network_batch
+from qdml_tpu.data.datasets import DMLGridLoader
 from qdml_tpu.models.cnn import FCP128, StackedConvP128, activation_dtype
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
+from qdml_tpu.train.scan import make_scan_steps, scan_eligible
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
 
@@ -120,98 +121,21 @@ def make_hdce_train_step(model: HDCE, tx) -> Callable:
     return step
 
 
-def _grid_batch_constrainer(mesh, fed: bool) -> Callable:
-    """Sharding constraint for an in-scan generated grid batch: B over
-    ``data`` (and optionally S over ``fed``), the same layout the per-step
-    placer produces (:func:`qdml_tpu.parallel.dp.grid_batch_spec`). Inside
-    jit this makes XLA partition the batch SYNTHESIS itself across the mesh —
-    each device generates only its own shard, the intra-process twin of the
-    multi-host per-slice generation path."""
-    from jax.sharding import NamedSharding
-
-    from qdml_tpu.parallel.dp import grid_batch_spec
-
-    def constrain(batch: dict) -> dict:
-        return {
-            k: jax.lax.with_sharding_constraint(
-                v, NamedSharding(mesh, grid_batch_spec(mesh, fed, v.ndim))
-            )
-            for k, v in batch.items()
-        }
-
-    return constrain
-
-
-def scan_eligible(cfg: ExperimentConfig, mesh, loader, logger) -> bool:
-    """Whether the scan-fused dispatch path may own the data for this run.
-
-    Shared gate for both trainers: eligible single-device, or on a
-    single-process mesh whose ``data`` axis divides the batch. Multi-process
-    runs (per-host slice generation + global assembly) and non-dividing
-    batches (the placer runs those replicated) keep the per-step placer
-    path; logs the fallback when scan_steps was requested but ineligible."""
-    if cfg.train.scan_steps <= 1:
-        return False
-    if mesh is None:
-        return True
-    if jax.process_count() == 1 and loader.batch_size % mesh.shape["data"] == 0:
-        return True
-    logger.log(
-        warning=f"scan_steps={cfg.train.scan_steps} ignored: multi-process "
-        "or non-dividing batch uses the per-step placer data path"
-    )
-    return False
-
-
 def make_hdce_scan_steps(
     model: HDCE, geom: ChannelGeometry, mesh=None, fed: bool = False
 ) -> Callable:
-    """K train steps in ONE device dispatch.
-
-    ``lax.scan`` over the fused step with batch synthesis *inside* the scan
-    body (the jitted channel generator makes the whole K-step block a single
-    XLA program, so the host enters the loop once per K steps instead of once
-    per step). On the tunnelled single-chip backend the per-step dispatch gap
-    is comparable to the step itself (docs/ROOFLINE.md: 1.42 ms device-busy
-    vs 2.9 ms wall at K=1) — this is the "keep the host out of the loop"
-    lever that trace identified.
-
-    With a (single-process) ``mesh``, the synthesized batch is sharding-
-    constrained to the same (fed, data) layout the per-step placer uses, so
-    the scan program runs SPMD: generation and training both partition over
-    the mesh and XLA inserts the gradient psum, exactly as in the per-step
-    path.
-
-    Returned callable: ``run(state, seed, scen, user, idx, snrs)`` with
-    ``idx (K, S, U, B) i32`` per-step sample indices and ``snrs (K,) f32``
-    per-step training SNRs; returns ``(state, {"loss": (K,), "loss_perf":
-    (K,)})`` — the same per-step metrics the K individual dispatches would
-    have produced (bitwise-identical update sequence, ``tests/test_train.py``).
-    """
-    from qdml_tpu.utils.platform import donation_argnums
-
-    constrain = _grid_batch_constrainer(mesh, fed) if mesh is not None else (lambda b: b)
-
-    @partial(jax.jit, donate_argnums=donation_argnums(0))
-    def run(
-        state: TrainState,
-        seed: jnp.ndarray,
-        scen: jnp.ndarray,
-        user: jnp.ndarray,
-        idx: jnp.ndarray,
-        snrs: jnp.ndarray,
-    ) -> tuple[TrainState, dict]:
-        def body(state, inp):
-            idx_k, snr = inp
-            batch = make_network_batch(seed, scen, user, idx_k, snr, geom)
-            batch = constrain({k: batch[k] for k in ("yp_img", "h_label", "h_perf")})
-            state, m = _fused_step(model, state, batch)
-            return state, m
-
-        state, ms = jax.lax.scan(body, state, (idx, snrs))
-        return state, ms
-
-    return run
+    """K HDCE train steps in ONE device dispatch: the shared scan machinery
+    (:func:`qdml_tpu.train.scan.make_scan_steps` — rationale, SPMD
+    composition and calling convention documented there) bound to the fused
+    HDCE step. Bitwise-identical update sequence to per-step dispatch
+    (``tests/test_train.py``)."""
+    return make_scan_steps(
+        partial(_fused_step, model),
+        geom,
+        ("yp_img", "h_label", "h_perf"),
+        mesh=mesh,
+        fed=fed,
+    )
 
 
 def make_hdce_eval_step(model: HDCE) -> Callable:

@@ -79,33 +79,20 @@ def make_sc_train_step(model: nn.Module, needs_rng: bool) -> Callable:
 def make_sc_scan_steps(
     model: nn.Module, geom: ChannelGeometry, needs_rng: bool, mesh=None
 ) -> Callable:
-    """K classifier train steps in ONE device dispatch (lax.scan with on-device
-    batch synthesis — the HDCE counterpart is
-    :func:`qdml_tpu.train.hdce.make_hdce_scan_steps`; rationale in
-    docs/ROOFLINE.md). ``rngs (K, 2)`` carries one pre-split QuantumNAT key
-    per step so the noise stream matches the per-step dispatch loop exactly.
-    With a (single-process) ``mesh``, the generated batch is constrained to
-    the data-parallel layout so the whole scan runs SPMD."""
-    from qdml_tpu.data.datasets import make_network_batch
-    from qdml_tpu.train.hdce import _grid_batch_constrainer
-    from qdml_tpu.utils.platform import donation_argnums
+    """K classifier train steps in ONE device dispatch: the shared scan
+    machinery (:func:`qdml_tpu.train.scan.make_scan_steps`) bound to the
+    classifier step. ``rngs (K, 2)`` carries one pre-split QuantumNAT key per
+    step (:func:`qdml_tpu.train.scan.presplit_keys`) so the noise stream
+    matches the per-step dispatch loop exactly."""
+    from qdml_tpu.train.scan import make_scan_steps
 
-    constrain = (
-        _grid_batch_constrainer(mesh, fed=False) if mesh is not None else (lambda b: b)
+    return make_scan_steps(
+        partial(_sc_step, model, needs_rng),
+        geom,
+        ("yp_img", "indicator"),
+        mesh=mesh,
+        with_rng=True,
     )
-
-    @partial(jax.jit, donate_argnums=donation_argnums(0))
-    def run(state, seed, scen, user, idx, snrs, rngs):
-        def body(state, inp):
-            idx_k, snr, rng = inp
-            batch = make_network_batch(seed, scen, user, idx_k, snr, geom)
-            batch = constrain({k: batch[k] for k in ("yp_img", "indicator")})
-            return _sc_step(model, needs_rng, state, batch, rng)
-
-        state, ms = jax.lax.scan(body, state, (idx, snrs, rngs))
-        return state, ms
-
-    return run
 
 
 def make_sc_eval_step(model: nn.Module) -> Callable:
@@ -181,8 +168,8 @@ def train_classifier(
     place_val = make_grid_placer(val_loader, mesh)
 
     # Scan-fused dispatch (cfg.train.scan_steps > 1): same machinery and
-    # eligibility rules as train_hdce (qdml_tpu.train.hdce.scan_eligible).
-    from qdml_tpu.train.hdce import scan_eligible
+    # eligibility rules as train_hdce (qdml_tpu.train.scan.scan_eligible).
+    from qdml_tpu.train.scan import presplit_keys, scan_eligible
 
     scan_k = cfg.train.scan_steps
     scan_run = None
@@ -199,13 +186,8 @@ def train_classifier(
             seed = jnp.uint32(cfg.data.seed)
             scen, user = train_loader.grid_coords
             for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
-                subs = []
-                for _ in range(idx.shape[0]):
-                    rng, sub = jax.random.split(rng)
-                    subs.append(sub)
-                state, ms = scan_run(
-                    state, seed, scen, user, idx, snrs, jnp.stack(subs)
-                )
+                rng, subs = presplit_keys(rng, idx.shape[0])
+                state, ms = scan_run(state, seed, scen, user, idx, snrs, subs)
                 tot = tot + float(jnp.sum(ms["loss"]))
                 n += idx.shape[0]
         else:

@@ -31,3 +31,19 @@ def test_step_timer():
         timer.tick(jnp.ones((2,)) * i)
     assert timer.steps_per_sec() > 0
     assert timer.samples_per_sec(32) == timer.steps_per_sec() * 32
+
+
+def test_dce_scan_steps_match_history():
+    """train_dce with scan_steps>1 reproduces the per-step history."""
+    import dataclasses
+
+    cfg = ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=128),
+        model=ModelConfig(features=16),
+        train=TrainConfig(batch_size=16, n_epochs=2),
+    )
+    h1 = train_dce(cfg)[1]
+    cfg_scan = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, scan_steps=3))
+    h2 = train_dce(cfg_scan)[1]
+    np.testing.assert_allclose(h1["train_loss"], h2["train_loss"], rtol=1e-5)
+    np.testing.assert_allclose(h1["val_nmse"], h2["val_nmse"], rtol=1e-5)
