@@ -80,8 +80,10 @@ def init_sweep(cfg: ExperimentConfig, noise_levels: Sequence[float], steps_per_e
     return model, tx, params, opt_state, sigmas
 
 
-def make_sweep_train_step(model: QSCP128, tx) -> Callable:
-    """jit(vmap(member step)): (E-stacked params/opt/rng/sigma, shared batch)."""
+def _make_vstep(model: QSCP128, tx) -> Callable:
+    """vmap over the ensemble of one member's QuantumNAT train step — the
+    single definition both dispatch paths bind, so the noise-injection /
+    optimizer logic cannot drift between them."""
 
     def member_step(params, opt_state, rng, sigma, x, labels):
         def loss_fn(p):
@@ -94,7 +96,12 @@ def make_sweep_train_step(model: QSCP128, tx) -> Callable:
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    vstep = jax.vmap(member_step, in_axes=(0, 0, 0, 0, None, None))
+    return jax.vmap(member_step, in_axes=(0, 0, 0, 0, None, None))
+
+
+def make_sweep_train_step(model: QSCP128, tx) -> Callable:
+    """jit(vmap(member step)): (E-stacked params/opt/rng/sigma, shared batch)."""
+    vstep = _make_vstep(model, tx)
 
     from functools import partial
 
@@ -107,6 +114,28 @@ def make_sweep_train_step(model: QSCP128, tx) -> Callable:
         return vstep(params, opt_state, rngs, sigmas, x, labels)
 
     return step
+
+
+def make_sweep_scan_steps(model: QSCP128, tx, sigmas, geom, mesh=None) -> Callable:
+    """K ensemble train steps in ONE device dispatch via the shared scan
+    machinery (:func:`qdml_tpu.train.scan.make_scan_steps`). The scan carry
+    is the ``(params, opt_state)`` stacked-ensemble pair; ``rngs`` has shape
+    ``(K, n_members, 2)`` — one pre-split key per (step, member), matching
+    the per-step dispatch loop's noise stream."""
+    from qdml_tpu.train.scan import make_scan_steps
+
+    vstep = _make_vstep(model, tx)
+
+    def step_body(state, batch, rngs):
+        params, opt_state = state
+        x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
+        labels = batch["indicator"].reshape(-1)
+        params, opt_state, losses = vstep(params, opt_state, rngs, sigmas, x, labels)
+        return (params, opt_state), {"loss": losses}
+
+    return make_scan_steps(
+        step_body, geom, ("yp_img", "indicator"), mesh=mesh, with_rng=True
+    )
 
 
 def make_sweep_eval_step(model: QSCP128) -> Callable:
@@ -185,17 +214,37 @@ def train_nat_sweep(
     # exactly the noise an uninterrupted run would have drawn, so resume is
     # bit-reproducible (tests/test_nat_sweep.py::test_train_nat_sweep_resume).
     base_rng = jax.random.PRNGKey(cfg.train.seed + 101)
+
+    # Scan-fused dispatch: same machinery/eligibility as the other trainers.
+    from qdml_tpu.train.scan import presplit_keys, scan_eligible
+
+    scan_run = None
+    if scan_eligible(cfg, mesh, train_loader, logger):
+        scan_run = make_sweep_scan_steps(model, tx, sigmas, geom, mesh=mesh)
+
     history = {"train_loss": [], "val_loss": [], "val_acc": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         rng = jax.random.fold_in(base_rng, epoch)
         tot = np.zeros(n_members)
         n = 0
-        for batch in train_loader.epoch(epoch):
-            rng, sub = jax.random.split(rng)
-            rngs = jax.random.split(sub, n_members)
-            params, opt_state, losses = train_step(params, opt_state, rngs, sigmas, place_train(batch))
-            tot += np.asarray(losses)
-            n += 1
+        if scan_run is not None:
+            seed = jnp.uint32(cfg.data.seed)
+            scen, user = train_loader.grid_coords
+            for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
+                rng, subs = presplit_keys(rng, idx.shape[0])
+                member_keys = jax.vmap(lambda s: jax.random.split(s, n_members))(subs)
+                (params, opt_state), ms = scan_run(
+                    (params, opt_state), seed, scen, user, idx, snrs, member_keys
+                )
+                tot += np.asarray(ms["loss"]).sum(0)
+                n += idx.shape[0]
+        else:
+            for batch in train_loader.epoch(epoch):
+                rng, sub = jax.random.split(rng)
+                rngs = jax.random.split(sub, n_members)
+                params, opt_state, losses = train_step(params, opt_state, rngs, sigmas, place_train(batch))
+                tot += np.asarray(losses)
+                n += 1
         train_loss = tot / max(n, 1)
 
         vloss = np.zeros(n_members)

@@ -1,0 +1,138 @@
+"""Scan-fused dispatch: K train steps in ONE device program.
+
+``lax.scan`` over a trainer's fused step with batch synthesis *inside* the
+scan body — the jitted channel generator makes the whole K-step block a
+single XLA program, so the host enters the loop once per K steps instead of
+once per step. On the tunnelled single-chip backend the per-step dispatch
+gap is comparable to the step itself (docs/ROOFLINE.md: 1.42 ms device-busy
+vs 2.9 ms wall at K=1); fusing the dispatch lifted the measured end-to-end
+training throughput from 800k to 966k samples/sec even though the scan pays
+for data synthesis every step and the fixed-batch measurement never did.
+
+One factory serves every trainer (HDCE, classifier, DCE); the per-trainer
+makers in :mod:`qdml_tpu.train.hdce` / :mod:`qdml_tpu.train.qsc` /
+:mod:`qdml_tpu.train.dce` bind their step body and batch fields here so the
+dispatch machinery cannot drift between them. Equivalence to per-step
+dispatch (same losses, same params, same QuantumNAT noise stream) is pinned
+by ``tests/test_train.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import make_network_batch
+
+
+def grid_batch_constrainer(mesh, fed: bool) -> Callable:
+    """Sharding constraint for an in-scan generated grid batch: B over
+    ``data`` (and optionally S over ``fed``), the same layout the per-step
+    placer produces (:func:`qdml_tpu.parallel.dp.grid_batch_spec`). Inside
+    jit this makes XLA partition the batch SYNTHESIS itself across the mesh —
+    each device generates only its own shard, the intra-process twin of the
+    multi-host per-slice generation path."""
+    from jax.sharding import NamedSharding
+
+    from qdml_tpu.parallel.dp import grid_batch_spec
+
+    def constrain(batch: dict) -> dict:
+        return {
+            k: jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, grid_batch_spec(mesh, fed, v.ndim))
+            )
+            for k, v in batch.items()
+        }
+
+    return constrain
+
+
+def make_scan_steps(
+    step_fn: Callable,
+    geom: ChannelGeometry,
+    fields: Sequence[str],
+    mesh=None,
+    fed: bool = False,
+    with_rng: bool = False,
+) -> Callable:
+    """Build the scan-fused runner for one trainer.
+
+    ``step_fn(state, batch)`` (or ``(state, batch, rng)`` with ``with_rng``)
+    is the trainer's traceable fused step; ``fields`` names the
+    :func:`make_network_batch` outputs it consumes. With a (single-process)
+    ``mesh`` the synthesized batch is sharding-constrained to the per-step
+    placer's (fed, data) layout, so the whole scan runs SPMD.
+
+    Returned callable: ``run(state, seed, scen, user, idx, snrs[, rngs])``
+    with ``idx (K, S, U, B) i32`` per-step sample indices, ``snrs (K,) f32``
+    per-step training SNRs and (``with_rng``) ``rngs (K, 2)`` pre-split
+    per-step PRNG keys; returns ``(state, metrics)`` where every metric leaf
+    has a leading ``(K,)`` axis — the same per-step values the K individual
+    dispatches would have produced.
+    """
+    from qdml_tpu.utils.platform import donation_argnums
+
+    constrain = grid_batch_constrainer(mesh, fed) if mesh is not None else (lambda b: b)
+
+    def _make_batch(seed, scen, user, idx_k, snr):
+        batch = make_network_batch(seed, scen, user, idx_k, snr, geom)
+        return constrain({k: batch[k] for k in fields})
+
+    if with_rng:
+
+        @partial(jax.jit, donate_argnums=donation_argnums(0))
+        def run(state, seed, scen, user, idx, snrs, rngs):
+            def body(state, inp):
+                idx_k, snr, rng = inp
+                return step_fn(state, _make_batch(seed, scen, user, idx_k, snr), rng)
+
+            return jax.lax.scan(body, state, (idx, snrs, rngs))
+
+    else:
+
+        @partial(jax.jit, donate_argnums=donation_argnums(0))
+        def run(state, seed, scen, user, idx, snrs):
+            def body(state, inp):
+                idx_k, snr = inp
+                return step_fn(state, _make_batch(seed, scen, user, idx_k, snr))
+
+            return jax.lax.scan(body, state, (idx, snrs))
+
+    return run
+
+
+def scan_eligible(cfg, mesh, loader, logger) -> bool:
+    """Whether the scan-fused dispatch path may own the data for this run.
+
+    Shared gate for every trainer: eligible single-device, or on a
+    single-process mesh whose ``data`` axis divides the batch. Multi-process
+    runs (per-host slice generation + global assembly) and non-dividing
+    batches (the placer runs those replicated) keep the per-step placer
+    path; logs the fallback when scan_steps was requested but ineligible."""
+    if cfg.train.scan_steps <= 1:
+        return False
+    if mesh is None:
+        return True
+    if jax.process_count() == 1 and loader.batch_size % mesh.shape["data"] == 0:
+        return True
+    logger.log(
+        warning=f"scan_steps={cfg.train.scan_steps} ignored: multi-process "
+        "or non-dividing batch uses the per-step placer data path"
+    )
+    return False
+
+
+def presplit_keys(rng: jax.Array, k: int) -> tuple[jax.Array, jnp.ndarray]:
+    """Reproduce a per-step ``rng, sub = split(rng)`` loop as a stacked
+    ``(k, 2)`` key array (so the scanned noise stream matches the per-step
+    dispatch loop exactly). Returns the advanced carry key and the stack."""
+    subs = []
+    for _ in range(k):
+        rng, sub = jax.random.split(rng)
+        subs.append(sub)
+    return rng, jnp.stack(subs)

@@ -136,3 +136,23 @@ def test_train_nat_sweep_resume(tmp_path):
     )
     for la, lb in zip(jax.tree.leaves(res_params), jax.tree.leaves(full_params)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5, atol=1e-6)
+
+
+def test_nat_sweep_scan_steps_match_history():
+    """train_nat_sweep with scan_steps>1 reproduces the per-step history
+    (losses per member per epoch), including the per-(step, member) noise
+    keys."""
+    import dataclasses
+
+    import numpy as np
+
+    cfg = _cfg(n_epochs=2)
+    h1 = train_nat_sweep(cfg, noise_levels=(0.0, 0.05))[1]
+    cfg2 = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, scan_steps=3))
+    h2 = train_nat_sweep(cfg2, noise_levels=(0.0, 0.05))[1]
+    np.testing.assert_allclose(
+        np.asarray(h1["train_loss"]), np.asarray(h2["train_loss"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(h1["val_acc"]), np.asarray(h2["val_acc"]), rtol=1e-5
+    )
