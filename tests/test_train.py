@@ -225,3 +225,32 @@ def test_sc_scan_fused_matches_per_step_dispatch():
     # reassociation differences between the scanned and per-step programs.
     for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-3, atol=1e-5)
+
+
+def test_scan_eligible_decision_table():
+    """Eligibility gate: single-device yes; single-process dividing mesh yes;
+    non-dividing batch no (with a logged warning); scan_steps<=1 never."""
+    from types import SimpleNamespace
+
+    from qdml_tpu.train.scan import scan_eligible
+
+    class Log:
+        def __init__(self):
+            self.warnings = []
+
+        def log(self, **kw):
+            self.warnings.append(kw)
+
+    def cfg_with(k):
+        return tiny_cfg(**{"train.scan_steps": k})
+
+    loader = SimpleNamespace(batch_size=16)
+    mesh8 = SimpleNamespace(shape={"data": 8})
+    mesh3 = SimpleNamespace(shape={"data": 3})
+
+    assert not scan_eligible(cfg_with(1), None, loader, Log())
+    assert scan_eligible(cfg_with(4), None, loader, Log())
+    assert scan_eligible(cfg_with(4), mesh8, loader, Log())  # 16 % 8 == 0
+    log = Log()
+    assert not scan_eligible(cfg_with(4), mesh3, loader, log)  # 16 % 3 != 0
+    assert log.warnings and "ignored" in log.warnings[0]["warning"]
