@@ -434,6 +434,39 @@ def _run_bench_child(env: dict, platform: str, timeout_s: int) -> dict | None:
     return None
 
 
+def _latest_committed_tpu_record() -> dict | None:
+    """Pointer to the newest committed on-chip record (by mtime), attached to
+    every non-TPU artifact so it always carries a path to real TPU evidence —
+    observed tunnel outages exceed an hour while the probe schedule spans
+    ~25 minutes. Never raises: a missing results/ dir or unreadable file
+    degrades to None/path-only."""
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        rdir = os.path.join(here, "results")
+        cands = [
+            f
+            for f in os.listdir(rdir)
+            if f.startswith("bench_tpu_") and f.endswith(".json")
+        ]
+        if not cands:
+            return None
+        newest = max(cands, key=lambda f: os.path.getmtime(os.path.join(rdir, f)))
+        path = os.path.join("results", newest)
+        try:
+            with open(os.path.join(rdir, newest)) as fh:
+                rec = json.load(fh)
+            return {
+                "path": path,
+                "value": rec.get("value"),
+                "platform": rec.get("platform"),
+                "mfu": rec.get("mfu"),
+            }
+        except (OSError, json.JSONDecodeError):
+            return {"path": path}
+    except OSError:
+        return None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", default=None)
@@ -473,18 +506,18 @@ def main() -> int:
             elif tpu_error is None:
                 tpu_error = late_err
     if details is None:
-        print(
-            json.dumps(
-                {
-                    "metric": "hdce_train_samples_per_sec_per_chip",
-                    "value": None,
-                    "unit": "samples/sec (3x3 DML grid train step, cell batch 256)",
-                    "vs_baseline": None,
-                    "platform": "none",
-                    "error": tpu_error or "all bench children failed",
-                }
-            )
-        )
+        rec = {
+            "metric": "hdce_train_samples_per_sec_per_chip",
+            "value": None,
+            "unit": "samples/sec (3x3 DML grid train step, cell batch 256)",
+            "vs_baseline": None,
+            "platform": "none",
+            "error": tpu_error or "all bench children failed",
+        }
+        committed = _latest_committed_tpu_record()
+        if committed is not None:
+            rec["latest_committed_tpu_record"] = committed
+        print(json.dumps(rec))
         return 1
 
     baseline_live = measure_torch_cpu_reference()
@@ -510,19 +543,19 @@ def main() -> int:
         (k for k in order if "samples_per_sec" in details.get(k, {})), None
     )
     if key is None:
-        print(
-            json.dumps(
-                {
-                    "metric": "hdce_train_samples_per_sec_per_chip",
-                    "value": None,
-                    "unit": "samples/sec (3x3 DML grid train step, cell batch 256)",
-                    "vs_baseline": None,
-                    "platform": platform,
-                    "error": "all HDCE measurements failed",
-                    "details": details,
-                }
-            )
-        )
+        rec = {
+            "metric": "hdce_train_samples_per_sec_per_chip",
+            "value": None,
+            "unit": "samples/sec (3x3 DML grid train step, cell batch 256)",
+            "vs_baseline": None,
+            "platform": platform,
+            "error": "all HDCE measurements failed",
+            "details": details,
+        }
+        committed = _latest_committed_tpu_record()
+        if committed is not None:
+            rec["latest_committed_tpu_record"] = committed
+        print(json.dumps(rec))
         return 1
     dtype = {
         "hdce_bf16": "bfloat16",
@@ -536,6 +569,8 @@ def main() -> int:
         if "scan_steps" in headline
         else ""
     )
+    committed_tpu = None if platform != "cpu_fallback" else _latest_committed_tpu_record()
+
     record = {
         "metric": "hdce_train_samples_per_sec_per_chip",
         "value": value,
@@ -552,6 +587,8 @@ def main() -> int:
     }
     if tpu_error is not None:
         record["tpu_error"] = tpu_error
+    if committed_tpu is not None:
+        record["latest_committed_tpu_record"] = committed_tpu
     print(json.dumps(record))
     return 0
 

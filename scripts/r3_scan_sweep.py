@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""On-chip scan-K sweep + QSC backend A/B re-run (round 3, second pass).
+
+Captures, on the real TPU:
+
+1. HDCE bf16 end-to-end training throughput (on-device generation inside the
+   scan) at K in {1, 8, 16, 32} steps per dispatch — quantifies how the
+   dispatch-gap amortization saturates and picks the best K for the bench
+   headline.
+2. A fresh 4x alternating pallas-vs-dense QSC A/B (the controlled comparison
+   behind the README's kernel claim; single-shot wall numbers for this
+   dispatch-bound step swing +-25%, see results/perf_r3/r3_qsc_ab.json for
+   the original capture).
+
+Writes results/perf_r3/r3_scan_sweep.json. Run from the repo root with the
+TPU reachable:  python scripts/r3_scan_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+import jax
+
+import bench as bench_mod
+
+# Same generation-resolved peak the bench harness uses (bench.py main()).
+_GEN = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+_PEAK = bench_mod._PEAK_BF16.get(_GEN, bench_mod._PEAK_BF16["v5e"])
+
+
+def scan_throughput(k: int) -> dict:
+    """One K point, measured by the bench harness's own scan sub-bench so the
+    sweep cannot drift from the driver-grade numbers."""
+    d = bench_mod._bench_hdce_scan("bfloat16", k, max_steps=50, budget_s=30.0)
+    d["k"] = k
+    d["mfu"] = round(d["model_tflops"] * 1e12 / _PEAK, 4)
+    return d
+
+
+def qsc_ab(rounds: int = 4) -> list[dict]:
+    out = []
+    for r in range(rounds):
+        row = {}
+        for backend in ("dense", "pallas"):
+            d = bench_mod._bench_qsc(backend, max_steps=50, budget_s=30.0)
+            row[backend] = d["samples_per_sec"]
+        row["pallas_wins"] = row["pallas"] > row["dense"]
+        out.append(row)
+        print(f"[ab] round {r}: {row}", flush=True)
+    return out
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    if backend == "cpu":
+        print("refusing to run the on-chip sweep on the CPU backend", file=sys.stderr)
+        return 1
+    record: dict = {"backend": backend, "devices": len(jax.devices())}
+    record["tpu_gen"] = _GEN
+    record["hdce_bf16_scan_sweep"] = [scan_throughput(k) for k in (1, 8, 16, 32)]
+    for row in record["hdce_bf16_scan_sweep"]:
+        print(f"[scan] K={row['k']}: {row['samples_per_sec']:,.0f} sps, "
+              f"MFU {row['mfu']}", flush=True)
+    record["qsc_ab"] = qsc_ab()
+    wins = sum(r["pallas_wins"] for r in record["qsc_ab"])
+    record["qsc_ab_pallas_wins"] = f"{wins}/{len(record['qsc_ab'])}"
+    out = os.path.join("results", "perf_r3", "r3_scan_sweep.json")
+    with open(out, "w") as fh:
+        json.dump(record, fh, indent=1)
+    print("wrote", out, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
