@@ -171,13 +171,18 @@ def _bench_hdce(dtype: str, max_steps: int, budget_s: float) -> dict:
     return {"samples_per_sec": round(samples, 1), "model_tflops": round(tflops, 3)}
 
 
-def _bench_hdce_scan(dtype: str, k: int, max_steps: int, budget_s: float) -> dict:
+def _bench_hdce_scan(
+    dtype: str, k: int, max_steps: int, budget_s: float, rng_impl: str = "threefry"
+) -> dict:
     """The scan-fused training path (qdml_tpu.train.hdce.make_hdce_scan_steps):
     K steps per device dispatch, batches synthesized on-device inside the
     scan. This is the throughput a real training run achieves with
     ``train.scan_steps=K`` — it removes the per-step host dispatch gap that
     caps the K=1 wall MFU at ~0.27 on the tunnelled backend
-    (docs/ROOFLINE.md: 1.42 ms device-busy vs 2.9 ms wall)."""
+    (docs/ROOFLINE.md: 1.42 ms device-busy vs 2.9 ms wall). ``rng_impl``
+    selects the generator PRNG (DataConfig.rng_impl): in-scan synthesis pays
+    for its random bits on device, so the hardware-RBG stream is a real
+    training-throughput lever."""
     import jax.numpy as jnp
 
     from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
@@ -185,7 +190,7 @@ def _bench_hdce_scan(dtype: str, k: int, max_steps: int, budget_s: float) -> dic
     from qdml_tpu.train.hdce import init_hdce_state, make_hdce_scan_steps
 
     cfg = ExperimentConfig(
-        data=DataConfig(),
+        data=DataConfig(rng_impl=rng_impl),
         model=ModelConfig(dtype=dtype),
         train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
     )
@@ -206,14 +211,19 @@ def _bench_hdce_scan(dtype: str, k: int, max_steps: int, budget_s: float) -> dic
     )
     samples = sps * k * s * u * _CELL_BS
     tflops = samples * 3.0 * hdce_fwd_flops_per_sample(cfg) / 1e12
-    return {
+    out = {
         "samples_per_sec": round(samples, 1),
         "model_tflops": round(tflops, 3),
         "scan_steps": k,
     }
+    if rng_impl != "threefry":
+        out["rng_impl"] = rng_impl
+    return out
 
 
-def _bench_qsc(backend: str, max_steps: int, budget_s: float) -> dict:
+def _bench_qsc(
+    backend: str, max_steps: int, budget_s: float, n_qubits: int = 6
+) -> dict:
     import jax
 
     from qdml_tpu.config import (
@@ -226,7 +236,7 @@ def _bench_qsc(backend: str, max_steps: int, budget_s: float) -> dict:
 
     cfg = ExperimentConfig(
         data=DataConfig(),
-        quantum=QuantumConfig(backend=backend),
+        quantum=QuantumConfig(backend=backend, n_qubits=n_qubits),
         train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
     )
     batch = _make_grid_batch(cfg)
@@ -273,6 +283,14 @@ def run_child(platform: str) -> int:
         # the child's budget re-measuring the same compute.
         benches.append(
             ("hdce_bf16_scan", lambda: _bench_hdce_scan("bfloat16", scan_k, max_steps, budget))
+        )
+        benches.append(
+            (
+                "hdce_bf16_scan_rbg",
+                lambda: _bench_hdce_scan(
+                    "bfloat16", scan_k, max_steps, budget, rng_impl="rbg"
+                ),
+            )
         )
     benches += [
         ("qsc_dense", lambda: _bench_qsc("dense", max_steps, budget / 2)),
@@ -365,13 +383,14 @@ def _cpu_env() -> dict:
 def probe_tpu(attempts: int | None = None, timeout_s: int | None = None) -> str | None:
     """Returns None if a TPU subprocess computes successfully, else the error.
 
-    The tunnelled axon backend drops and restores on minutes timescales
-    (two rounds of driver artifacts show a 2-attempt probe losing the race),
+    The tunnelled axon backend drops and restores on minutes-to-tens-of-
+    minutes timescales (two rounds of driver artifacts show a 2-attempt
+    probe losing the race; a round-3 session observed a >25-minute outage),
     so probing is patient AND spread: 3 backoff attempts up front, then the
-    CPU fallback bench burns ~10 further minutes, then 3 more attempts
-    (see main) — a ~25-minute window overall — before conceding a
-    cpu_fallback record, while keeping the worst-case harness runtime near
-    the envelope the driver has already tolerated.
+    CPU fallback bench burns ~10 further minutes, then single attempts every
+    ~2 minutes for as long as the QDML_BENCH_WALL_BUDGET_S wall budget
+    leaves room to still run the TPU bench child (see main) — before
+    conceding a cpu_fallback record.
     """
     attempts = attempts or int(os.environ.get("QDML_BENCH_PROBE_ATTEMPTS", "3"))
     timeout_s = timeout_s or int(os.environ.get("QDML_BENCH_PROBE_TIMEOUT", "150"))
@@ -477,15 +496,26 @@ def main() -> int:
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK_BF16.get(gen, _PEAK_BF16["v5e"])
 
-    def try_tpu_bench() -> tuple[dict | None, str | None]:
+    def try_tpu_bench(timeout_s: int = 1500) -> tuple[dict | None, str | None]:
         """(details, error): TPU measurements, or why there are none."""
-        d = _run_bench_child(dict(os.environ), "tpu", timeout_s=1500)
+        d = _run_bench_child(dict(os.environ), "tpu", timeout_s=timeout_s)
         if d is None:
             return None, "tpu bench child failed or timed out after a good probe"
         if d.get("backend") == "cpu":
             # belt-and-braces: never label CPU numbers as TPU throughput/MFU
             return None, "bench child ran on the cpu backend despite a tpu probe"
         return d, None
+
+    t_start = time.monotonic()
+    # Wall-clock budget for the whole harness. Observed tunnel outages run
+    # tens of minutes while a fixed two-round probe schedule spans ~14; the
+    # budgeted loop below keeps probing for as long as there is still time
+    # to run the TPU bench child before the budget ends, so the record goes
+    # tpu-* the moment the tunnel comes back anywhere inside the window.
+    wall_budget = int(os.environ.get("QDML_BENCH_WALL_BUDGET_S", "1800"))
+    # Conservative estimate of a warm-cache TPU bench child (backend init
+    # over the tunnel + per-bench compiles + 50-step measurements).
+    tpu_child_cost = int(os.environ.get("QDML_BENCH_TPU_CHILD_BUDGET_S", "700"))
 
     tpu_error = probe_tpu()
     details: dict | None = None
@@ -496,15 +526,39 @@ def main() -> int:
     if details is None:
         details = _run_bench_child(_cpu_env(), "cpu", timeout_s=1500)
         platform = "cpu_fallback"
-        # Last-chance TPU re-attempt: the CPU bench just spent several
-        # minutes — enough for a flapping tunnel to have come back. A late
-        # TPU record always supersedes the CPU fallback.
-        if probe_tpu() is None:  # attempts honor QDML_BENCH_PROBE_ATTEMPTS
-            late, late_err = try_tpu_bench()
-            if late is not None:
-                details, tpu_error, platform = late, None, f"tpu-{gen}"
-            elif tpu_error is None:
-                tpu_error = late_err
+        # Budgeted TPU re-attempts: the CPU bench just banked a fallback
+        # record; now spend every remaining minute of the wall budget (minus
+        # what a TPU bench child needs) waiting for the flapping tunnel to
+        # come back. At least ONE late probe always runs even if the earlier
+        # phases overran the window (the pre-loop worst case can already
+        # exceed it), so this path is never weaker than the old
+        # unconditional last-chance retry. A late TPU record always
+        # supersedes the CPU fallback. Probe timeouts honor
+        # QDML_BENCH_PROBE_TIMEOUT (probe_tpu's env default).
+        first = True
+        while first or time.monotonic() - t_start < wall_budget - tpu_child_cost:
+            first = False
+            if probe_tpu(attempts=1) is None:
+                # Cap the child so the whole harness stays near the wall
+                # budget even when the probe succeeds at the window's edge.
+                left = wall_budget - (time.monotonic() - t_start)
+                late, late_err = try_tpu_bench(
+                    timeout_s=max(tpu_child_cost, int(left))
+                )
+                if late is not None:
+                    details, tpu_error, platform = late, None, f"tpu-{gen}"
+                elif tpu_error is None:
+                    tpu_error = late_err
+                break  # good probe: the child ran (or conclusively failed)
+            left = wall_budget - tpu_child_cost - (time.monotonic() - t_start)
+            if left <= 0:
+                break
+            print(
+                f"[bench] tunnel still down, {left:.0f}s of probe window left",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(45)
     if details is None:
         rec = {
             "metric": "hdce_train_samples_per_sec_per_chip",
@@ -524,7 +578,14 @@ def main() -> int:
     # MFU vs the generation's bf16 peak (conservative for the f32 run). Only
     # meaningful on the TPU; CPU fallback reports null.
     on_tpu = platform != "cpu_fallback"
-    for k in ("hdce_f32", "hdce_bf16", "hdce_bf16_scan", "qsc_dense", "qsc_pallas"):
+    for k in (
+        "hdce_f32",
+        "hdce_bf16",
+        "hdce_bf16_scan",
+        "hdce_bf16_scan_rbg",
+        "qsc_dense",
+        "qsc_pallas",
+    ):
         d = details.get(k)
         if isinstance(d, dict) and "model_tflops" in d:
             d["mfu"] = round(d["model_tflops"] * 1e12 / peak, 4) if on_tpu else None
@@ -532,10 +593,15 @@ def main() -> int:
     # Headline: the framework's intended fast path — bf16 activations on the
     # MXU with scan-fused dispatch (what train.scan_steps=K runs) — when on
     # TPU; the reference-dtype f32 step on the CPU fallback. The dtype is
-    # part of the record so the two are never conflated. If the preferred
-    # measurement errored, fall back down the list.
+    # part of the record so the two are never conflated. The headline KEY is
+    # fixed (default-config threefry scan) so value/vs_baseline stay
+    # comparable across rounds; the rbg-generator scan variant is recorded
+    # in details and only headlines as a fallback when the default-stream
+    # measurement itself errored. (Promoting rbg to the headline is a code
+    # change backed by a committed alternating A/B, not a per-run max of
+    # two noisy single measurements.)
     order = (
-        ("hdce_bf16_scan", "hdce_bf16", "hdce_f32")
+        ("hdce_bf16_scan", "hdce_bf16_scan_rbg", "hdce_bf16", "hdce_f32")
         if on_tpu
         else ("hdce_f32", "hdce_bf16")
     )
@@ -560,6 +626,7 @@ def main() -> int:
     dtype = {
         "hdce_bf16": "bfloat16",
         "hdce_bf16_scan": "bfloat16",
+        "hdce_bf16_scan_rbg": "bfloat16",
         "hdce_f32": "float32",
     }[key]
     headline = details[key]
@@ -569,6 +636,8 @@ def main() -> int:
         if "scan_steps" in headline
         else ""
     )
+    if key == "hdce_bf16_scan_rbg":
+        scan_note += ", hardware-RBG generator"
     committed_tpu = None if platform != "cpu_fallback" else _latest_committed_tpu_record()
 
     record = {

@@ -47,6 +47,15 @@ class DataConfig:
     # reference's fixed-SNR protocol; (5, 15) trains one estimator robust
     # across the eval grid (the generalization the published curves show).
     snr_jitter: tuple[float, float] | None = None
+    # PRNG implementation for the on-device sample generator. "threefry"
+    # (default) is bit-reproducible across platforms and jax versions;
+    # "rbg" routes bit generation through the TPU's hardware generator
+    # (XLA RngBitGenerator) — substantially cheaper when synthesis runs
+    # inside the training dispatch (train.scan_steps) at the cost of
+    # cross-platform bit stability (the DISTRIBUTION is identical; the
+    # stream is not). Key derivation (fold_in/split) stays threefry-based
+    # either way, so per-sample determinism-within-a-platform holds.
+    rng_impl: str = "threefry"
 
     @property
     def pilot_num(self) -> int:

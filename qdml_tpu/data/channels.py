@@ -74,6 +74,10 @@ class ChannelGeometry:
     # dB (-2.2 dB @ 5, -12.2 dB @ 15; `channel estimation performace
     # comparison.png`, BASELINE.md).
     label_noise_factor: float = 1.9
+    # PRNG implementation for sample synthesis ("threefry" | "rbg"); see
+    # DataConfig.rng_impl. Static (geometry is a jit static argument), so
+    # the choice selects the compiled program, not a runtime branch.
+    rng_impl: str = "threefry"
 
     @classmethod
     def from_config(cls, cfg: DataConfig) -> "ChannelGeometry":
@@ -82,6 +86,7 @@ class ChannelGeometry:
             n_sub=cfg.n_sub,
             n_beam=cfg.n_beam,
             label_noise_factor=cfg.label_noise_factor,
+            rng_impl=cfg.rng_impl,
         )
 
     @property
@@ -225,14 +230,31 @@ def sound_pilots(
     return (x + CArr(scale * nre, scale * nim)).reshape(geom.pilot_num)
 
 
-def make_sample_key(seed: int | jnp.ndarray, scenario, user, index) -> jax.Array:
+def make_sample_key(
+    seed: int | jnp.ndarray, scenario, user, index, impl: str = "threefry"
+) -> jax.Array:
     """Deterministic per-sample key: (seed, scenario, user, index) -> PRNGKey.
 
     Replaces the reference's pre-generated-file determinism (``Runner...py:49-55``
     filename scheme + ``start`` offsets in ``Test.py:127-129``): sample ``index``
     of cell (scenario, user) is always the same realisation.
+
+    ``impl`` selects the jax PRNG implementation: "threefry" (default,
+    bit-reproducible everywhere) or "rbg" (key derivation still threefry;
+    bit *generation* uses XLA's RngBitGenerator — the fast path on TPU for
+    in-dispatch synthesis, see DataConfig.rng_impl).
     """
-    key = jax.random.PRNGKey(seed)
+    if impl == "threefry":
+        # Raw (legacy) key, exactly as always — keeps every committed stream
+        # bit-identical.
+        key = jax.random.PRNGKey(seed)
+    elif impl == "rbg":
+        # Typed key: a raw uint32[4] rbg key would be re-interpreted as
+        # threefry by downstream jax.random calls; the typed dtype carries
+        # the impl through fold_in/split/vmap.
+        key = jax.random.key(seed, impl="rbg")
+    else:
+        raise ValueError(f"rng_impl must be 'threefry' or 'rbg', got {impl!r}")
     key = jax.random.fold_in(key, scenario)
     key = jax.random.fold_in(key, user)
     return jax.random.fold_in(key, index)
@@ -257,7 +279,7 @@ def generate_samples(
     """
 
     def one(scenario, user, index):
-        key = make_sample_key(seed, scenario, user, index)
+        key = make_sample_key(seed, scenario, user, index, impl=geom.rng_impl)
         k_h, k_n, k_l = jax.random.split(key, 3)
         h = sample_channel(k_h, scenario, user, geom)
         yp = sound_pilots(k_n, h, snr_db, geom)

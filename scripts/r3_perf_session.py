@@ -1,0 +1,108 @@
+"""On-chip perf session: extended pallas-vs-dense A/B + generator-RNG A/B.
+
+Run on the real TPU when the tunnel is up:
+    python scripts/r3_perf_session.py [out.json]
+
+Two questions, both noise-sensitive on the tunnelled single-chip backend
+(single measurements swing ~25% run-to-run, docs/ROOFLINE.md), so both are
+answered with ALTERNATING A/B pairs — each round measures the contenders
+back-to-back under the same machine state, and the verdict is per-round wins
+plus medians, not one number:
+
+1. QSC train step, pallas whole-circuit kernel vs XLA dense — at every
+   published qubit count (4/6/8, reference ``Loss Curve.png`` legend;
+   the kernel's VMEM budget covers n<=8, ``circuits.resolve_backend``).
+   Extends the committed 4-round n=6 A/B (results/perf_r3/r3_qsc_ab.json).
+2. Scan-fused HDCE training (train.scan_steps=16) with the threefry vs
+   hardware-RBG generator stream (DataConfig.rng_impl) — in-scan synthesis
+   pays for its random bits on device (~5.5M normal draws per 2304-sample
+   batch, dominated by the 2x1024/sample label noise), so the PRNG is a
+   real throughput lever.
+"""
+
+import json
+import statistics
+import sys
+
+from qdml_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+import jax
+
+sys.path.insert(0, ".")
+import bench
+
+
+def ab(name: str, contenders: dict, rounds: int, out: dict) -> None:
+    """Alternating A/B: run each contender once per round, record sps."""
+    results = {k: [] for k in contenders}
+    for r in range(rounds):
+        for k, fn in contenders.items():
+            try:
+                sps = fn()["samples_per_sec"]
+            except Exception as e:  # noqa: BLE001
+                sps = None
+                results.setdefault("errors", []).append(f"{k}@{r}: {e}")
+            results[k].append(sps)
+        print(f"[{name}] round {r}: " + ", ".join(f"{k}={results[k][-1]}" for k in contenders), flush=True)
+    summary = {"rounds": results}
+    keys = [k for k in contenders if any(v is not None for v in results[k])]
+    for k in keys:
+        vals = [v for v in results[k] if v is not None]
+        summary[f"{k}_med"] = round(statistics.median(vals), 1)
+    if len(keys) == 2:
+        a, b = keys
+        wins = sum(
+            1
+            for x, y in zip(results[a], results[b])
+            if x is not None and y is not None and x > y
+        )
+        summary[f"{a}_wins"] = wins
+        summary["n_pairs"] = sum(
+            1 for x, y in zip(results[a], results[b]) if x is not None and y is not None
+        )
+    out[name] = summary
+
+
+def main() -> None:
+    print("backend:", jax.default_backend(), flush=True)
+    out = {"backend": jax.default_backend()}
+
+    # 1. pallas vs dense at each published qubit count, via the bench
+    #    harness's own builder so both measure exactly the program bench.py
+    #    records.
+    def qsc_step_bench(backend: str, n_qubits: int):
+        return bench._bench_qsc(backend, 50, 30.0, n_qubits=n_qubits)
+
+    for n in (4, 6, 8):
+        rounds = 8 if n == 6 else 4
+        ab(
+            f"qsc_n{n}",
+            {
+                "pallas": lambda n=n: qsc_step_bench("pallas", n),
+                "dense": lambda n=n: qsc_step_bench("dense", n),
+            },
+            rounds,
+            out,
+        )
+
+    # 2. scan-fused HDCE: threefry vs rbg generator stream.
+    ab(
+        "hdce_scan_rng",
+        {
+            "rbg": lambda: bench._bench_hdce_scan("bfloat16", 16, 50, 60.0, rng_impl="rbg"),
+            "threefry": lambda: bench._bench_hdce_scan("bfloat16", 16, 50, 60.0),
+        },
+        4,
+        out,
+    )
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "results/perf_r3/r3_perf_session.json"
+    with open(out_path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
