@@ -507,11 +507,12 @@ def main() -> int:
         return d, None
 
     t_start = time.monotonic()
-    # Wall-clock budget for the whole harness. Observed tunnel outages run
-    # tens of minutes while a fixed two-round probe schedule spans ~14; the
-    # budgeted loop below keeps probing for as long as there is still time
-    # to run the TPU bench child before the budget ends, so the record goes
-    # tpu-* the moment the tunnel comes back anywhere inside the window.
+    # Soft wall-clock budget for the whole harness (the last TPU child may
+    # overshoot it — see the loop). Observed tunnel outages run tens of
+    # minutes while a fixed two-round probe schedule spans ~14; the budgeted
+    # loop below keeps probing for as long as there is still time to run the
+    # TPU bench child before the budget ends, so the record goes tpu-* the
+    # moment the tunnel comes back anywhere inside the window.
     wall_budget = int(os.environ.get("QDML_BENCH_WALL_BUDGET_S", "1800"))
     # Conservative estimate of a warm-cache TPU bench child (backend init
     # over the tunnel + per-bench compiles + 50-step measurements).
@@ -537,14 +538,18 @@ def main() -> int:
         # QDML_BENCH_PROBE_TIMEOUT (probe_tpu's env default).
         first = True
         while first or time.monotonic() - t_start < wall_budget - tpu_child_cost:
+            # The guaranteed first pass keeps the old 3-attempt backoff
+            # spread (env default); later passes are single probes since the
+            # loop itself provides the spread.
+            probe_kw = {} if first else {"attempts": 1}
             first = False
-            if probe_tpu(attempts=1) is None:
-                # Cap the child so the whole harness stays near the wall
-                # budget even when the probe succeeds at the window's edge.
+            if probe_tpu(**probe_kw) is None:
+                # Cap the child near the remaining budget, but never below
+                # the old fixed 1500s: a child recovering from a long outage
+                # is the cold-compile case, and a TPU record is worth
+                # overshooting the (soft) wall budget for.
                 left = wall_budget - (time.monotonic() - t_start)
-                late, late_err = try_tpu_bench(
-                    timeout_s=max(tpu_child_cost, int(left))
-                )
+                late, late_err = try_tpu_bench(timeout_s=max(1500, int(left)))
                 if late is not None:
                     details, tpu_error, platform = late, None, f"tpu-{gen}"
                 elif tpu_error is None:

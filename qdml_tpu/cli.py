@@ -102,7 +102,15 @@ def main(argv: list[str] | None = None) -> int:
 
             qsc_vars, qsc_meta = restore_checkpoint(workdir, "qsc_best")
             cfg = reconcile_quantum_cfg(cfg, qsc_meta)
-        results = run_snr_sweep(cfg, hdce_vars, sc_vars, qsc_vars, logger=logger)
+        # Optional monolithic-DCE baseline curve (beyond the reference's
+        # shipped eval): included whenever `cli train-dce` has produced a
+        # best checkpoint in this workdir.
+        dce_vars = None
+        if has_checkpoint(workdir, "dce_best"):
+            dce_vars, _ = restore_checkpoint(workdir, "dce_best")
+        results = run_snr_sweep(
+            cfg, hdce_vars, sc_vars, qsc_vars, logger=logger, dce_vars=dce_vars
+        )
         out_json = save_results_json(results, cfg.eval.results_dir)
         out_png = create_comparison_plots(results, cfg.eval.results_dir)
         from qdml_tpu.eval.report import results_markdown_table

@@ -17,6 +17,7 @@ _CURVE_LABELS = {
     "ls": "LS",
     "mmse": "MMSE",
     "mmse_oracle": "MMSE (oracle prior)",
+    "dce": "DCE (monolithic)",
     "hdce_classical": "HDCE (classical SC)",
     "hdce_quantum": "HDCE (quantum SC)",
 }

@@ -11,7 +11,11 @@ For each SNR in the grid (default ``{5,7,9,11,13,15}`` dB, ``Test.py:66``) over
   PREDICTED scenario (``Test.py:167-214``) — expressed as run-all-trunks +
   ``take_along_axis`` gather (:mod:`qdml_tpu.ops.routing`), no host sync,
 - NMSE vs perfect CSI for LS / MMSE / HDCE-classical / HDCE-quantum and both
-  classifier accuracies (``Test.py:217-256``).
+  classifier accuracies (``Test.py:217-256``),
+- optionally the monolithic DCE baseline (reference ``DCE_P128``,
+  ``Estimators_QuantumNAT_onchipQNN.py:40-75`` — defined there but never
+  trained by the shipped runner): one un-routed trunk+head on the same
+  pilots, the architectural control for the hierarchical design's gain.
 
 Everything inside the per-batch step is one jitted function, data generation
 included.
@@ -32,7 +36,7 @@ from qdml_tpu.data.baselines import (
 )
 from qdml_tpu.data.channels import ChannelGeometry, label_noise_var
 from qdml_tpu.data.datasets import make_network_batch
-from qdml_tpu.models.cnn import SCP128
+from qdml_tpu.models.cnn import DCEP128, SCP128
 from qdml_tpu.models.qsc import QSCP128
 from qdml_tpu.ops.routing import select_expert
 from qdml_tpu.train.hdce import HDCE
@@ -50,6 +54,7 @@ def make_sweep_step(
     sc_vars: dict,
     qsc_vars: dict | None,
     profile: jnp.ndarray,
+    dce_vars: dict | None = None,
 ):
     """Build the jitted per-batch sweep step: ``step(start, count_base,
     snr_db)`` returns a dict of error/power sums and correct-counts for one
@@ -60,6 +65,11 @@ def make_sweep_step(
         out_dim=cfg.h_out_dim,
     )
     sc = SCP128(n_classes=cfg.quantum.n_classes)
+    dce = (
+        DCEP128(features=cfg.model.features, out_dim=cfg.h_out_dim)
+        if dce_vars is not None
+        else None
+    )
     qsc = (
         QSCP128(
             n_qubits=cfg.quantum.n_qubits,
@@ -109,6 +119,8 @@ def make_sweep_step(
         }
 
         label2 = jnp.concatenate([h.re, h.im], -1)
+        if dce is not None:
+            out["err_dce"] = _sum_sq(dce.apply(dce_vars, x, train=False) - label2)
         for name, vars_, model in (("classical", sc_vars, sc), ("quantum", qsc_vars, qsc)):
             if model is None:
                 continue
@@ -155,6 +167,7 @@ def run_snr_sweep(
     sc_vars: dict,
     qsc_vars: dict | None = None,
     logger=None,
+    dce_vars: dict | None = None,
 ) -> dict[str, Any]:
     """Full sweep; returns ``{"snr": [...], "nmse_db": {curve: [...]}, "acc": {...}}``.
 
@@ -165,7 +178,9 @@ def run_snr_sweep(
     """
     geom = ChannelGeometry.from_config(cfg.data)
     profile = beam_delay_profile(geom)
-    step = make_sweep_step(cfg, geom, hdce_vars, sc_vars, qsc_vars, profile)
+    step = make_sweep_step(
+        cfg, geom, hdce_vars, sc_vars, qsc_vars, profile, dce_vars=dce_vars
+    )
     n_batches = max(cfg.eval.test_len // cfg.eval.batch_size, 1)
     sweep_one_snr = make_snr_scan(cfg, step, n_batches)
 

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Science phase 3: the monolithic-DCE architectural control.
+#
+# The reference defines DCE_P128 (Estimators_QuantumNAT_onchipQNN.py:40-75)
+# but its shipped runner never trains it and Test.py never evaluates it —
+# the hierarchical design's gain over the monolithic baseline is asserted,
+# not measured. Train DCE under the exact reference protocol (100 epochs,
+# bs 256, Adam 1e-3 halved/30, train SNR 10) on the same data grid, then
+# re-run the sweep so results/ carries the DCE curve next to LS/MMSE/HDCE.
+set -e
+cd /root/repo
+python -m qdml_tpu.cli train-dce --train.workdir=runs/science --train.resume=true --train.scan_steps=16
+python -m qdml_tpu.cli eval --train.workdir=runs/science --eval.results_dir=results
+echo "SCIENCE PHASE 3 DONE"

@@ -21,8 +21,13 @@ plus medians, not one number:
 """
 
 import json
+import os
 import statistics
 import sys
+
+# Repo root on sys.path BEFORE any repo import: `python scripts/foo.py` puts
+# scripts/ (not the root) there, and qdml_tpu is not installed.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from qdml_tpu.utils.compile_cache import enable_compile_cache
 
@@ -30,7 +35,6 @@ enable_compile_cache()
 
 import jax
 
-sys.path.insert(0, ".")
 import bench
 
 

@@ -87,6 +87,29 @@ def test_sweep_without_quantum_checkpoint():
     results = run_snr_sweep(cfg, hdce_vars, {"params": sc_state.params}, None)
     assert "hdce_quantum" not in results["nmse_db"]
     assert "quantum" not in results["acc"]
+    assert "dce" not in results["nmse_db"]  # no DCE checkpoint -> no curve
+
+
+def test_sweep_with_dce_baseline():
+    """The monolithic-DCE control curve appears when dce_vars are passed and
+    is a plain un-routed estimate (same key scheme as the other curves)."""
+    from qdml_tpu.train.dce import init_dce_state
+
+    cfg = _sweep_cfg()
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    _, dce_state = init_dce_state(cfg, 4)
+    dce_vars = {"params": dce_state.params, "batch_stats": dce_state.batch_stats}
+    results = run_snr_sweep(
+        cfg, hdce_vars, {"params": sc_state.params}, None, dce_vars=dce_vars
+    )
+    assert len(results["nmse_db"]["dce"]) == len(results["snr"])
+    # untrained nets are far above the classical baselines; the curve just
+    # has to be finite and per-SNR
+    import math
+
+    assert all(math.isfinite(v) for v in results["nmse_db"]["dce"])
 
 
 def test_loss_curves_roundtrip(tmp_path):
@@ -120,11 +143,18 @@ def test_results_markdown_table():
 
     results = {
         "snr": [5.0, 15.0],
-        "nmse_db": {"ls": [-2.3, -12.3], "mmse": [-6.8, -13.5], "hdce_classical": [-10.0, -16.0]},
+        "nmse_db": {
+            "ls": [-2.3, -12.3],
+            "mmse": [-6.8, -13.5],
+            "dce": [-7.5, -14.0],
+            "hdce_classical": [-10.0, -16.0],
+        },
         "acc": {"classical": [0.8, 0.95]},
     }
     table = results_markdown_table(results)
     assert "| LS | -2.3 | -12.3 | -2.2 / -12 |" in table
+    # beyond-reference curve: labeled, with no published value to compare to
+    assert "| DCE (monolithic) | -7.5 | -14.0 | — |" in table
     assert "accuracy (classical SC)" in table
     assert table.count("\n") >= 5
 
