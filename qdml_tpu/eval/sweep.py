@@ -55,10 +55,20 @@ def make_sweep_step(
     qsc_vars: dict | None,
     profile: jnp.ndarray,
     dce_vars: dict | None = None,
+    mesh=None,
 ):
     """Build the jitted per-batch sweep step: ``step(start, count_base,
     snr_db)`` returns a dict of error/power sums and correct-counts for one
-    ``eval.batch_size`` batch."""
+    ``eval.batch_size`` batch.
+
+    With a ``mesh`` carrying a ``fed`` axis of size ``n_scenarios`` (and
+    ``hdce_vars`` placed by
+    :func:`qdml_tpu.parallel.federated.shard_hdce_vars`), the all-hypotheses
+    trunk pass runs expert-parallel: scenario ``s``'s trunk weights and its
+    hypothesis batch live only on fed-slice ``s``; the predicted-scenario
+    routing gather is the one cross-slice collective. A ``data`` axis
+    additionally shards the batch (and its on-device generation) within
+    each slice."""
     hdce = HDCE(
         n_scenarios=cfg.data.n_scenarios,
         features=cfg.model.features,
@@ -108,6 +118,15 @@ def make_sweep_step(
 
         # stacked-trunk HDCE outputs for every scenario hypothesis
         xs = jnp.broadcast_to(x[None], (n_scen,) + x.shape)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            fed = "fed" if mesh.shape.get("fed", 1) == n_scen else None
+            data = "data" if mesh.shape.get("data", 1) > 1 else None
+            xs = jax.lax.with_sharding_constraint(
+                xs, NamedSharding(mesh, P(fed, data, *(None,) * (xs.ndim - 2)))
+            )
         est_all = hdce.apply(hdce_vars, xs, train=False)  # (S, B, 2048)
 
         out: dict[str, jnp.ndarray] = {
@@ -168,6 +187,7 @@ def run_snr_sweep(
     qsc_vars: dict | None = None,
     logger=None,
     dce_vars: dict | None = None,
+    mesh=None,
 ) -> dict[str, Any]:
     """Full sweep; returns ``{"snr": [...], "nmse_db": {curve: [...]}, "acc": {...}}``.
 
@@ -179,7 +199,7 @@ def run_snr_sweep(
     geom = ChannelGeometry.from_config(cfg.data)
     profile = beam_delay_profile(geom)
     step = make_sweep_step(
-        cfg, geom, hdce_vars, sc_vars, qsc_vars, profile, dce_vars=dce_vars
+        cfg, geom, hdce_vars, sc_vars, qsc_vars, profile, dce_vars=dce_vars, mesh=mesh
     )
     n_batches = max(cfg.eval.test_len // cfg.eval.batch_size, 1)
     sweep_one_snr = make_snr_scan(cfg, step, n_batches)

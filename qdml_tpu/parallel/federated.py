@@ -58,12 +58,34 @@ def hdce_state_shardings(
     return jax.tree_util.tree_map_with_path(spec_for, state)
 
 
-def shard_hdce_state(
-    state: TrainState, mesh: Mesh, n_scenarios: int = 3, tensor_parallel: bool = False
-) -> TrainState:
-    shardings = hdce_state_shardings(state, mesh, n_scenarios, tensor_parallel)
+def _place(tree: Any, shardings: Any) -> Any:
     if jax.process_count() > 1:
         # device_put rejects non-addressable shardings; a jitted identity
         # with out_shardings is the multi-controller way to place state.
-        return jax.jit(lambda s: s, out_shardings=shardings)(state)
-    return jax.tree.map(jax.device_put, state, shardings)
+        return jax.jit(lambda s: s, out_shardings=shardings)(tree)
+    return jax.tree.map(jax.device_put, tree, shardings)
+
+
+def shard_hdce_state(
+    state: TrainState, mesh: Mesh, n_scenarios: int = 3, tensor_parallel: bool = False
+) -> TrainState:
+    return _place(state, hdce_state_shardings(state, mesh, n_scenarios, tensor_parallel))
+
+
+def shard_hdce_vars(vars_: Any, mesh: Mesh, n_scenarios: int = 3) -> Any:
+    """Place a raw HDCE variable dict (``{"params", "batch_stats"}`` as the
+    eval sweep consumes it) with stacked-trunk leaves sharded over ``fed``.
+
+    The eval-side twin of :func:`shard_hdce_state`: the sweep's
+    all-hypotheses pass (`eval/sweep.py` — every sample through every
+    scenario trunk, routing by predicted scenario afterwards,
+    ``Test.py:167-214``) is expert-parallel once the trunk-stacked axis is
+    fed-sharded — each scenario's trunk weights live on, and its hypothesis
+    batch is computed by, only its own mesh slice; the routing gather is the
+    single cross-slice collective XLA inserts.
+
+    Same rule set as training placement (:func:`hdce_state_shardings`
+    tree-maps over any pytree), so train- and eval-time layouts cannot
+    drift.
+    """
+    return _place(vars_, hdce_state_shardings(vars_, mesh, n_scenarios))

@@ -1,0 +1,28 @@
+#!/bin/bash
+# HDCE estimation-curve variance (extends the round-3 SC/robust-QSC spread
+# in scripts/r3_multiseed.sh to the NMSE headline): retrain the HDCE
+# trunks+head at 3 seeds (40 epochs — past the first LR halving, enough for
+# a variance estimate at a fraction of the 100-epoch cost, same shortening
+# rationale as the 30-epoch classifier spread), then sweep each against the
+# COMMON seed-2026 test stream with the
+# COMMON committed science classifiers, so across-seed differences measure
+# HDCE training variance only — not classifier variance (measured
+# separately, results/robust/) and not test resampling noise.
+#
+# Needs the TPU chip (scan-fused steps; CPU is ~3 orders slower).
+set -e
+cd /root/repo
+
+for s in 1 2 3; do
+  WD=runs/ms_hdce_s$s
+  python -m qdml_tpu.cli train-hdce --train.seed=$s --data.seed=$((2026 + s)) \
+      --train.n_epochs=40 --train.scan_steps=16 \
+      --train.workdir=$WD --train.resume=true > runs/ms_hdce_s$s.log 2>&1
+  # common classifiers: across-seed deltas isolate the estimator
+  for t in sc_best sc_best.meta.json qsc_best qsc_best.meta.json; do
+    cp -r runs/science/Pn_128/default/$t $WD/Pn_128/default/ 2>/dev/null || true
+  done
+  python -m qdml_tpu.cli eval --train.workdir=$WD \
+      --eval.results_dir=results/hdce_seeds/seed$s > runs/ms_hdce_s$s.eval.log 2>&1
+done
+echo "HDCE MULTISEED DONE"

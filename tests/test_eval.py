@@ -90,6 +90,47 @@ def test_sweep_without_quantum_checkpoint():
     assert "dce" not in results["nmse_db"]  # no DCE checkpoint -> no curve
 
 
+def test_sweep_step_expert_parallel_matches_unsharded():
+    """Fed-sharded eval: the all-hypotheses trunk pass with trunk weights
+    sharded over a (fed=3, data=2) mesh produces the same sums as the
+    unsharded step — expert parallelism as a sharding annotation, the eval
+    twin of test_parallel.py::test_federated_step_matches_single_device."""
+    from qdml_tpu.data.baselines import beam_delay_profile
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.eval.sweep import make_sweep_step
+    from qdml_tpu.parallel.federated import shard_hdce_vars
+    from qdml_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = _sweep_cfg()
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    sc_vars = {"params": sc_state.params}
+    geom = ChannelGeometry.from_config(cfg.data)
+    profile = beam_delay_profile(geom)
+
+    step = make_sweep_step(cfg, geom, hdce_vars, sc_vars, None, profile)
+    args = (jnp.asarray(0), jnp.asarray(0), jnp.float32(10.0))
+    ref = jax.device_get(step(*args))
+
+    mesh = make_mesh(MeshConfig(fed_axis=3, data_axis=2, model_axis=1))
+    vars_fed = shard_hdce_vars(hdce_vars, mesh, n_scenarios=cfg.data.n_scenarios)
+    # trunk weights really live fed-sharded
+    stacked = [
+        l
+        for p, l in jax.tree_util.tree_leaves_with_path(vars_fed["params"])
+        if "StackedConvP128" in str(p)
+    ][0]
+    assert "fed" in str(stacked.sharding.spec)
+    step_ep = make_sweep_step(
+        cfg, geom, vars_fed, sc_vars, None, profile, mesh=mesh
+    )
+    out = jax.device_get(step_ep(*args))
+    assert set(out) == set(ref)
+    for k in ref:
+        np.testing.assert_allclose(out[k], ref[k], rtol=2e-5, atol=1e-6)
+
+
 def test_sweep_with_dce_baseline():
     """The monolithic-DCE control curve appears when dce_vars are passed and
     is a plain un-routed estimate (same key scheme as the other curves)."""
