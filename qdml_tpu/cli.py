@@ -108,8 +108,21 @@ def main(argv: list[str] | None = None) -> int:
         dce_vars = None
         if has_checkpoint(workdir, "dce_best"):
             dce_vars, _ = restore_checkpoint(workdir, "dce_best")
+        # Multi-device eval: same mesh contract as the trainers. A fed axis
+        # == n_scenarios runs the all-hypotheses trunk pass expert-parallel
+        # (each scenario's trunk on its own slice); the data axis shards the
+        # test batch and its on-device generation.
+        from qdml_tpu.parallel.mesh import training_mesh
+
+        mesh = training_mesh(cfg)
+        if mesh is not None:
+            from qdml_tpu.parallel.federated import shard_hdce_vars
+
+            hdce_vars = shard_hdce_vars(
+                hdce_vars, mesh, n_scenarios=cfg.data.n_scenarios
+            )
         results = run_snr_sweep(
-            cfg, hdce_vars, sc_vars, qsc_vars, logger=logger, dce_vars=dce_vars
+            cfg, hdce_vars, sc_vars, qsc_vars, logger=logger, dce_vars=dce_vars, mesh=mesh
         )
         out_json = save_results_json(results, cfg.eval.results_dir)
         out_png = create_comparison_plots(results, cfg.eval.results_dir)

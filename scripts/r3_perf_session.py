@@ -41,16 +41,19 @@ import bench
 def ab(name: str, contenders: dict, rounds: int, out: dict) -> None:
     """Alternating A/B: run each contender once per round, record sps."""
     results = {k: [] for k in contenders}
+    errors: list[str] = []
     for r in range(rounds):
         for k, fn in contenders.items():
             try:
                 sps = fn()["samples_per_sec"]
             except Exception as e:  # noqa: BLE001
                 sps = None
-                results.setdefault("errors", []).append(f"{k}@{r}: {e}")
+                errors.append(f"{k}@{r}: {e}")
             results[k].append(sps)
         print(f"[{name}] round {r}: " + ", ".join(f"{k}={results[k][-1]}" for k in contenders), flush=True)
     summary = {"rounds": results}
+    if errors:
+        summary["errors"] = errors
     keys = [k for k in contenders if any(v is not None for v in results[k])]
     for k in keys:
         vals = [v for v in results[k] if v is not None]
