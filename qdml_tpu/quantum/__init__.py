@@ -5,6 +5,10 @@ from qdml_tpu.quantum.circuits import (  # noqa: F401
     rot_gate,
     run_circuit,
 )
+from qdml_tpu.quantum.trajectories import (  # noqa: F401
+    apply_random_paulis,
+    run_circuit_trajectories,
+)
 from qdml_tpu.quantum.statevector import (  # noqa: F401
     apply_1q,
     apply_cnot,
