@@ -30,6 +30,7 @@ import numpy as np
 
 from qdml_tpu.models.cnn import QSCPreprocess
 from qdml_tpu.quantum.circuits import run_circuit
+from qdml_tpu.quantum.trajectories import run_circuit_trajectories
 
 
 class QSCP128(nn.Module):
@@ -49,6 +50,14 @@ class QSCP128(nn.Module):
     # shift pushes the tanh angles off their trained range; normalizing makes
     # the encoding scale-invariant.
     input_norm: bool = False
+    # State-level hardware-noise evaluation (beyond reference): with
+    # depolarizing_p > 0 the clean circuit is replaced by Pauli-twirl
+    # trajectory averaging (:mod:`qdml_tpu.quantum.trajectories`) — every
+    # wire suffers a random Pauli with this probability after the embedding
+    # and after each layer. Requires an rng stream at apply time:
+    # ``model.apply(vars, x, rngs={"trajectories": key})``.
+    depolarizing_p: float = 0.0
+    n_trajectories: int = 32
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -70,6 +79,17 @@ class QSCP128(nn.Module):
             )
             weights = weights + noise  # gradient at the noisy point (C7 semantics)
 
-        expz = run_circuit(angles, weights, self.n_qubits, self.n_layers, self.backend)
+        if self.depolarizing_p > 0.0:
+            expz = run_circuit_trajectories(
+                angles,
+                weights,
+                self.n_qubits,
+                self.n_layers,
+                self.depolarizing_p,
+                self.make_rng("trajectories"),
+                self.n_trajectories,
+            )
+        else:
+            expz = run_circuit(angles, weights, self.n_qubits, self.n_layers, self.backend)
         logits = nn.Dense(self.n_classes)(expz)
         return nn.log_softmax(logits, axis=-1)

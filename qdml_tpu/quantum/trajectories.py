@@ -56,14 +56,21 @@ _PAULI_IM = np.array(
 def apply_random_paulis(
     psi: CArr, key: jax.Array, p: float, n: int
 ) -> CArr:
-    """One twirl: independently on each wire, apply I with prob 1-p or a
-    uniform random Pauli (X/Y/Z each p/3)."""
+    """One twirl: independently on each wire AND each batched sample, apply
+    I with prob 1-p or a uniform random Pauli (X/Y/Z each p/3).
+
+    Per-sample draws matter statistically: sharing one realization across a
+    batch would make every sample's Monte-Carlo error perfectly correlated,
+    so a batch-aggregated estimate (e.g. test accuracy) would not tighten
+    with batch size. ``apply_1q`` broadcasts a ``lead + (2, 2)`` gate, so
+    per-sample gates cost one gather per wire."""
+    lead = psi.re.shape[:-1]
     probs = jnp.array([1.0 - p, p / 3.0, p / 3.0, p / 3.0], jnp.float32)
-    r = jax.random.choice(key, 4, (n,), p=probs)
+    r = jax.random.choice(key, 4, lead + (n,), p=probs)
     pre = jnp.asarray(_PAULI_RE)
     pim = jnp.asarray(_PAULI_IM)
     for q in range(n):
-        psi = sv.apply_1q(psi, n, q, CArr(pre[r[q]], pim[r[q]]))
+        psi = sv.apply_1q(psi, n, q, CArr(pre[r[..., q]], pim[r[..., q]]))
     return psi
 
 

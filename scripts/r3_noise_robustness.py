@@ -1,0 +1,100 @@
+"""Hardware-noise robustness study: does QuantumNAT training help under
+STATE-level noise?
+
+QuantumNAT (arXiv:2110.11331; reference ``Estimators...py:176-199``) injects
+parameter noise during training to prepare the classifier for noisy quantum
+hardware. The reference can never test that premise — its PennyLane
+``default.qubit`` is noiseless. This framework's trajectory simulator
+(:mod:`qdml_tpu.quantum.trajectories`) can: evaluate two trained QSCs (one
+QuantumNAT-trained, one plain) under depolarizing noise of increasing
+strength and compare accuracy degradation.
+
+Usage (after scripts/r3_noise_robustness.sh trains the two checkpoints):
+    python scripts/r3_noise_robustness.py [plain_workdir nat_workdir out_dir]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()
+
+import jax
+import jax.numpy as jnp
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import make_network_batch
+from qdml_tpu.models.qsc import QSCP128
+from qdml_tpu.train.checkpoint import restore_checkpoint
+
+P_GRID = (0.0, 0.01, 0.03, 0.1, 0.2)
+N_TRAJ = 32
+TEST_N = 4608  # 2 full grid batches of fresh samples
+SNRS = (5.0, 10.0)
+
+
+def accuracy(model: QSCP128, vars_: dict, batch, key) -> float:
+    rngs = {"trajectories": key} if model.depolarizing_p > 0 else None
+    logp = model.apply(vars_, batch["yp_img"], train=False, rngs=rngs)
+    pred = jnp.argmax(logp, -1)
+    return float(jnp.mean((pred == batch["indicator"]).astype(jnp.float32)))
+
+
+def main() -> None:
+    plain_wd = sys.argv[1] if len(sys.argv) > 1 else "runs/nr_plain/Pn_128/default"
+    nat_wd = sys.argv[2] if len(sys.argv) > 2 else "runs/nr_nat/Pn_128/default"
+    out_dir = sys.argv[3] if len(sys.argv) > 3 else "results/noise_robustness"
+
+    cfg = ExperimentConfig()
+    geom = ChannelGeometry.from_config(cfg.data)
+    # common fresh test stream, offset past training data (Test.py:127)
+    start = cfg.data.data_len * 3
+    i = jnp.arange(start, start + TEST_N)
+    batches = {
+        snr: make_network_batch(
+            jnp.uint32(cfg.data.seed), i % 3, (i // 3) % 3, i, jnp.float32(snr), geom
+        )
+        for snr in SNRS
+    }
+
+    out = {"p_grid": list(P_GRID), "n_trajectories": N_TRAJ, "test_n": TEST_N, "curves": {}}
+    for label, wd in (("plain", plain_wd), ("quantumnat", nat_wd)):
+        vars_, meta = restore_checkpoint(wd, "qsc_best")
+        q = meta.get("quantum", {})
+        for snr in SNRS:
+            accs = []
+            for p in P_GRID:
+                model = QSCP128(
+                    n_qubits=q.get("n_qubits", 6),
+                    n_layers=q.get("n_layers", 3),
+                    backend="tensor",
+                    depolarizing_p=float(p),
+                    n_trajectories=N_TRAJ,
+                )
+                accs.append(
+                    round(accuracy(model, vars_, batches[snr], jax.random.PRNGKey(17)), 4)
+                )
+            out["curves"][f"{label}_snr{snr:g}"] = accs
+            print(f"{label} @ SNR {snr:g}: {accs}", flush=True)
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "results.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+    lines = [
+        "| model / SNR | " + " | ".join(f"p={p:g}" for p in P_GRID) + " |",
+        "|---|" + "---|" * len(P_GRID),
+    ]
+    for k, accs in out["curves"].items():
+        lines.append(f"| {k} | " + " | ".join(f"{a:.3f}" for a in accs) + " |")
+    with open(os.path.join(out_dir, "results_table.md"), "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

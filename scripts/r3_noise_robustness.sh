@@ -1,0 +1,14 @@
+#!/bin/bash
+# Train the two classifiers for the hardware-noise robustness study
+# (scripts/r3_noise_robustness.py): identical protocol except QuantumNAT.
+# 30 epochs (the multiseed shortening rationale); CPU-feasible — the
+# classifiers are small.
+set -e
+cd /root/repo
+mkdir -p runs
+python -m qdml_tpu.cli train-qsc --train.n_epochs=30 --train.resume=true \
+    --train.workdir=runs/nr_plain > runs/nr_plain.log 2>&1
+python -m qdml_tpu.cli train-qsc --quantum.use_quantumnat=true --train.n_epochs=30 \
+    --train.resume=true --train.workdir=runs/nr_nat > runs/nr_nat.log 2>&1
+python scripts/r3_noise_robustness.py
+echo "NOISE ROBUSTNESS DONE"

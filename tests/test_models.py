@@ -104,3 +104,31 @@ def test_qsc_input_norm_scale_invariant():
     np.testing.assert_allclose(
         np.asarray(m.apply(v, x)), np.asarray(m.apply(v, 7.5 * x)), rtol=1e-4
     )
+
+
+def test_qsc_depolarizing_eval_mode():
+    """A trained/initialised QSC evaluates under state-level noise by
+    swapping the module config only — same param tree, trajectory-averaged
+    circuit, valid log-probabilities, key-deterministic."""
+    import jax
+
+    from qdml_tpu.models.qsc import QSCP128
+
+    x = jnp.ones((4, 16, 8, 2), jnp.float32)
+    clean_model = QSCP128(n_qubits=4, n_layers=2, backend="tensor")
+    vars_ = clean_model.init(jax.random.PRNGKey(0), x, train=False)
+    clean = clean_model.apply(vars_, x, train=False)
+
+    noisy_model = QSCP128(
+        n_qubits=4, n_layers=2, depolarizing_p=0.2, n_trajectories=8
+    )
+    rngs = {"trajectories": jax.random.PRNGKey(1)}
+    noisy = noisy_model.apply(vars_, x, train=False, rngs=rngs)
+    assert noisy.shape == clean.shape == (4, 3)
+    np.testing.assert_allclose(
+        np.exp(np.asarray(noisy)).sum(-1), 1.0, rtol=1e-5
+    )
+    # same key -> same trajectories; heavy noise -> different logits
+    again = noisy_model.apply(vars_, x, train=False, rngs=rngs)
+    np.testing.assert_array_equal(np.asarray(noisy), np.asarray(again))
+    assert not np.allclose(np.asarray(noisy), np.asarray(clean), atol=1e-4)

@@ -270,3 +270,18 @@ def test_trajectory_noise_is_deterministic_in_key():
     a = run_circuit_trajectories(angles, w, n, layers, 0.1, jax.random.PRNGKey(7), 8)
     b = run_circuit_trajectories(angles, w, n, layers, 0.1, jax.random.PRNGKey(7), 8)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_trajectory_noise_decorrelated_across_batch():
+    """Identical samples in one batch must draw DIFFERENT noise: shared
+    realizations would freeze the Monte-Carlo error across the batch and
+    batch-aggregated estimates would not tighten with batch size."""
+    from qdml_tpu.quantum.trajectories import run_circuit_trajectories
+
+    n, layers = 3, 1
+    angles = jnp.zeros((8, n), jnp.float32)  # 8 identical samples
+    w = jnp.ones((layers, n, 2), jnp.float32)
+    out = run_circuit_trajectories(
+        angles, w, n, layers, 0.3, jax.random.PRNGKey(2), n_traj=1
+    )
+    assert np.unique(np.asarray(out), axis=0).shape[0] > 1
