@@ -1,0 +1,19 @@
+#!/bin/bash
+# On-chip-QNN gradient pruning (reference C8, Estimators...py:205-228 —
+# shipped OFF, never measured): train the QSC with magnitude pruning of
+# gradients (threshold 0.1, the reference default) under the same 30-epoch
+# protocol as the robustness study, and evaluate it on the same common
+# stream + noise grid. Quantifies what the reference's dormant feature
+# actually costs/buys.
+set -e
+cd /root/repo
+mkdir -p runs
+python -m qdml_tpu.cli train-qsc --quantum.use_gradient_pruning=true \
+    --train.n_epochs=30 --train.resume=true \
+    --train.workdir=runs/nr_prune > runs/nr_prune.log 2>&1
+# reuse the study evaluator: "plain" slot = pruned model, "nat" slot = the
+# seed-1 NAT model for side-by-side context
+python scripts/r3_noise_robustness.py runs/nr_prune/Pn_128/default \
+    runs/nr_nat/Pn_128/default results/noise_robustness/grad_prune \
+    grad_prune quantumnat
+echo "GRAD PRUNE RUN DONE"

@@ -49,6 +49,7 @@ def main() -> None:
     plain_wd = sys.argv[1] if len(sys.argv) > 1 else "runs/nr_plain/Pn_128/default"
     nat_wd = sys.argv[2] if len(sys.argv) > 2 else "runs/nr_nat/Pn_128/default"
     out_dir = sys.argv[3] if len(sys.argv) > 3 else "results/noise_robustness"
+    labels = sys.argv[4:6] if len(sys.argv) > 5 else ["plain", "quantumnat"]
 
     cfg = ExperimentConfig()
     geom = ChannelGeometry.from_config(cfg.data)
@@ -63,7 +64,7 @@ def main() -> None:
     }
 
     out = {"p_grid": list(P_GRID), "n_trajectories": N_TRAJ, "test_n": TEST_N, "curves": {}}
-    for label, wd in (("plain", plain_wd), ("quantumnat", nat_wd)):
+    for label, wd in ((labels[0], plain_wd), (labels[1], nat_wd)):
         vars_, meta = restore_checkpoint(wd, "qsc_best")
         q = meta.get("quantum", {})
         for snr in SNRS:

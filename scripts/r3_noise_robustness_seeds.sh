@@ -1,0 +1,19 @@
+#!/bin/bash
+# Seed spread for the hardware-noise robustness study: repeat the
+# plain-vs-QuantumNAT comparison (scripts/r3_noise_robustness.sh protocol)
+# at 2 more training seeds. Eval keeps the COMMON seed-2026 test stream so
+# across-seed differences measure training variance (same discipline as
+# scripts/r3_multiseed.sh).
+set -e
+cd /root/repo
+mkdir -p runs
+for s in 2 3; do
+  SEEDS="--train.seed=$s --data.seed=$((2026 + s))"
+  python -m qdml_tpu.cli train-qsc $SEEDS --train.n_epochs=30 --train.resume=true \
+      --train.workdir=runs/nr_plain_s$s > runs/nr_plain_s$s.log 2>&1
+  python -m qdml_tpu.cli train-qsc $SEEDS --quantum.use_quantumnat=true --train.n_epochs=30 \
+      --train.resume=true --train.workdir=runs/nr_nat_s$s > runs/nr_nat_s$s.log 2>&1
+  python scripts/r3_noise_robustness.py runs/nr_plain_s$s/Pn_128/default \
+      runs/nr_nat_s$s/Pn_128/default results/noise_robustness/seed$s
+done
+echo "NOISE ROBUSTNESS SEEDS DONE"
