@@ -1,0 +1,87 @@
+"""Does LARGER QuantumNAT training noise buy state-level robustness?
+
+The 3-seed study (results/noise_robustness/seed_spread.md) found no
+seed-stable depolarizing-noise advantage at the reference's shipped
+σ=0.01. This evaluates the full σ ensemble trained by the vmapped
+noise-sweep trainer (config 5, ``cli nat-sweep``: every member trained
+simultaneously in ONE jitted step): each member (σ ∈ noise_sweep) is
+extracted from the stacked ``nat_sweep_last`` checkpoint and scored on the
+common test stream under the trajectory depolarizing grid.
+
+Usage: python scripts/r3_sigma_robustness.py [sweep_workdir out_dir]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()
+
+import jax
+import jax.numpy as jnp
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import make_network_batch
+from qdml_tpu.models.qsc import QSCP128
+from qdml_tpu.train.checkpoint import restore_checkpoint
+
+P_GRID = (0.0, 0.03, 0.1, 0.2)
+N_TRAJ = 32
+TEST_N = 4608
+
+
+def main() -> None:
+    wd = sys.argv[1] if len(sys.argv) > 1 else "runs/nr_sweep/Pn_128/default"
+    out_dir = sys.argv[2] if len(sys.argv) > 2 else "results/noise_robustness/sigma_sweep"
+
+    stacked, meta = restore_checkpoint(wd, "nat_sweep_last")
+    sigmas = meta["noise_levels"]
+
+    cfg = ExperimentConfig()
+    geom = ChannelGeometry.from_config(cfg.data)
+    start = cfg.data.data_len * 3
+    i = jnp.arange(start, start + TEST_N)
+    batch = make_network_batch(
+        jnp.uint32(cfg.data.seed), i % 3, (i // 3) % 3, i,
+        jnp.float32(cfg.data.snr_db), geom,
+    )
+
+    out = {"p_grid": list(P_GRID), "sigmas": sigmas, "n_trajectories": N_TRAJ,
+           "test_n": TEST_N, "snr_db": cfg.data.snr_db, "curves": {}}
+    for m, sigma in enumerate(sigmas):
+        vars_ = {"params": jax.tree.map(lambda x: x[m], stacked["params"])}
+        accs = []
+        for p in P_GRID:
+            model = QSCP128(
+                n_qubits=cfg.quantum.n_qubits,
+                n_layers=cfg.quantum.n_layers,
+                backend="tensor",
+                depolarizing_p=float(p),
+                n_trajectories=N_TRAJ,
+            )
+            rngs = {"trajectories": jax.random.PRNGKey(17)} if p > 0 else None
+            logp = model.apply(vars_, batch["yp_img"], train=False, rngs=rngs)
+            pred = jnp.argmax(logp, -1)
+            accs.append(round(float(jnp.mean((pred == batch["indicator"]).astype(jnp.float32))), 4))
+        out["curves"][f"sigma={sigma:g}"] = accs
+        print(f"sigma={sigma:g}: {accs}", flush=True)
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "results.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+    lines = ["| training sigma | " + " | ".join(f"p={p:g}" for p in P_GRID) + " |",
+             "|---|" + "---|" * len(P_GRID)]
+    for k, accs in out["curves"].items():
+        lines.append(f"| {k} | " + " | ".join(f"{a:.3f}" for a in accs) + " |")
+    with open(os.path.join(out_dir, "results_table.md"), "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()
