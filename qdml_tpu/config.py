@@ -231,10 +231,13 @@ def presets() -> dict[str, ExperimentConfig]:
         ),
         # 4. Federated RIS: per-BS local QNN + psum aggregation
         "federated": _preset("federated", **{"mesh.fed_axis": 3, "mesh.data_axis": 1}),
-        # 5. Noise-aware training sweep batched over hosts
-        "nat_sweep": _preset(
-            "nat_sweep", **{"quantum.use_quantumnat": True, "quantum.use_gradient_pruning": True}
-        ),
+        # 5. Noise-aware training sweep batched over hosts. Pruning is OFF:
+        # at the reference's threshold (0.1) magnitude pruning zeroes every
+        # Adam-scale NLL gradient and freezes training at chance
+        # (results/noise_robustness/grad_prune/); enable it explicitly with
+        # --quantum.use_gradient_pruning=true and a calibrated
+        # --quantum.gradient_threshold.
+        "nat_sweep": _preset("nat_sweep", **{"quantum.use_quantumnat": True}),
         # 6. (beyond BASELINE.json) robust quantum classifier: scale-invariant
         # angle encoding + SNR-jittered training — fixes the raw-pilot QSC's
         # low-SNR collapse and beats the classical CNN at SNR 5

@@ -180,6 +180,16 @@ def train_nat_sweep(
     train_step = make_sweep_train_step(model, tx)
     eval_step = make_sweep_eval_step(model)
     n_members = len(noise_levels)
+    # Same architecture-fact record the QSC trainer writes (train/qsc.py):
+    # a member extracted from the stacked checkpoint must be rebuildable
+    # without guessing the training config (input_norm has no params, so a
+    # mismatch at eval would otherwise be silent).
+    quantum_meta = {
+        "n_qubits": cfg.quantum.n_qubits,
+        "n_layers": cfg.quantum.n_layers,
+        "n_classes": cfg.quantum.n_classes,
+        "input_norm": cfg.quantum.input_norm,
+    }
 
     start_epoch = 0
     best_acc = -1.0
@@ -282,6 +292,7 @@ def train_nat_sweep(
                         "sigma": float(noise_levels[top]),
                         "val_acc": best_acc,
                         "name": cfg.name,
+                        "quantum": quantum_meta,
                     },
                 )
             save_checkpoint(
@@ -293,6 +304,7 @@ def train_nat_sweep(
                     "best_acc": best_acc,
                     "noise_levels": list(map(float, noise_levels)),
                     "name": cfg.name,
+                    "quantum": quantum_meta,
                 },
             )
     if workdir is not None:
@@ -300,6 +312,10 @@ def train_nat_sweep(
             workdir,
             "nat_sweep_last",
             {"params": params},
-            {"noise_levels": list(map(float, noise_levels)), "name": cfg.name},
+            {
+                "noise_levels": list(map(float, noise_levels)),
+                "name": cfg.name,
+                "quantum": quantum_meta,
+            },
         )
     return params, history

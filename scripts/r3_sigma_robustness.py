@@ -30,6 +30,10 @@ from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.models.qsc import QSCP128
 from qdml_tpu.train.checkpoint import restore_checkpoint
 
+# single eval-protocol definition shared with the plain-vs-NAT study
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from r3_noise_robustness import accuracy  # noqa: E402
+
 P_GRID = (0.0, 0.03, 0.1, 0.2)
 N_TRAJ = 32
 TEST_N = 4608
@@ -41,6 +45,10 @@ def main() -> None:
 
     stacked, meta = restore_checkpoint(wd, "nat_sweep_last")
     sigmas = meta["noise_levels"]
+    # architecture facts from the checkpoint (input_norm carries no params,
+    # so rebuilding from defaults would silently mismatch the training
+    # preprocess); absent only in pre-round-3 checkpoints
+    q = meta.get("quantum", {})
 
     cfg = ExperimentConfig()
     geom = ChannelGeometry.from_config(cfg.data)
@@ -58,16 +66,17 @@ def main() -> None:
         accs = []
         for p in P_GRID:
             model = QSCP128(
-                n_qubits=cfg.quantum.n_qubits,
-                n_layers=cfg.quantum.n_layers,
+                n_qubits=q.get("n_qubits", cfg.quantum.n_qubits),
+                n_layers=q.get("n_layers", cfg.quantum.n_layers),
+                n_classes=q.get("n_classes", cfg.quantum.n_classes),
+                input_norm=q.get("input_norm", cfg.quantum.input_norm),
                 backend="tensor",
                 depolarizing_p=float(p),
                 n_trajectories=N_TRAJ,
             )
-            rngs = {"trajectories": jax.random.PRNGKey(17)} if p > 0 else None
-            logp = model.apply(vars_, batch["yp_img"], train=False, rngs=rngs)
-            pred = jnp.argmax(logp, -1)
-            accs.append(round(float(jnp.mean((pred == batch["indicator"]).astype(jnp.float32))), 4))
+            accs.append(
+                round(accuracy(model, vars_, batch, jax.random.PRNGKey(17)), 4)
+            )
         out["curves"][f"sigma={sigma:g}"] = accs
         print(f"sigma={sigma:g}: {accs}", flush=True)
 

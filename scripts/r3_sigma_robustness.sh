@@ -1,0 +1,14 @@
+#!/bin/bash
+# Training launcher for the sigma dose-response study
+# (results/noise_robustness/sigma_sweep/): ONE vmapped noise-sweep ensemble
+# run (every sigma in quantum.noise_sweep trained simultaneously), then the
+# per-member trajectory-noise evaluation. Default config (no preset) — the
+# nat_sweep preset also enables gradient pruning at the reference's 0.1
+# threshold, which freezes training (results/noise_robustness/grad_prune/).
+set -e
+cd /root/repo
+mkdir -p runs
+python -m qdml_tpu.cli nat-sweep --train.n_epochs=30 --train.resume=true \
+    --train.workdir=runs/nr_sweep > runs/nr_sweep.log 2>&1
+python scripts/r3_sigma_robustness.py
+echo "SIGMA ROBUSTNESS DONE"
