@@ -92,6 +92,11 @@ class QuantumConfig:
     use_gradient_pruning: bool = False
     noise_level: float = 0.01         # QuantumNAT sigma (Estimators...py:118)
     gradient_threshold: float = 0.1   # on-chip-QNN pruning threshold (Estimators...py:119)
+    # Pruning mode: "absolute" (reference parity: zero |g| <= threshold —
+    # unusable at the shipped 0.1, see results/noise_robustness/grad_prune/)
+    # or "quantile" (threshold = fraction of elements pruned per step, the
+    # scale-free usable form; e.g. 0.5 keeps the largest half).
+    gradient_prune_mode: str = "absolute"
     # QuantumNAT sigma grid for the vmapped noise-sweep ensemble (config 5)
     noise_sweep: tuple[float, ...] = (0.0, 0.01, 0.05, 0.1)
     # simulator backend: "auto" (default) resolves by platform and qubit

@@ -46,5 +46,8 @@ def get_optimizer(
     else:
         raise NotImplementedError(f"optimizer {cfg.optimizer!r}")  # Runner...py:46
     if quantum is not None and quantum.use_gradient_pruning:
-        return optax.chain(gradient_prune(quantum.gradient_threshold), base)
+        return optax.chain(
+            gradient_prune(quantum.gradient_threshold, quantum.gradient_prune_mode),
+            base,
+        )
     return base

@@ -30,7 +30,7 @@ from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
 from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.models.qsc import QSCP128
-from qdml_tpu.train.checkpoint import restore_checkpoint
+from qdml_tpu.train.checkpoint import reconcile_quantum_cfg, restore_checkpoint
 
 P_GRID = (0.0, 0.01, 0.03, 0.1, 0.2)
 N_TRAJ = 32
@@ -66,13 +66,17 @@ def main() -> None:
     out = {"p_grid": list(P_GRID), "n_trajectories": N_TRAJ, "test_n": TEST_N, "curves": {}}
     for label, wd in ((labels[0], plain_wd), (labels[1], nat_wd)):
         vars_, meta = restore_checkpoint(wd, "qsc_best")
-        q = meta.get("quantum", {})
+        # standard architecture reconciliation (input_norm has no params, so
+        # a mismatch would silently change the preprocess)
+        mcfg = reconcile_quantum_cfg(cfg, meta)
         for snr in SNRS:
             accs = []
             for p in P_GRID:
                 model = QSCP128(
-                    n_qubits=q.get("n_qubits", 6),
-                    n_layers=q.get("n_layers", 3),
+                    n_qubits=mcfg.quantum.n_qubits,
+                    n_layers=mcfg.quantum.n_layers,
+                    n_classes=mcfg.quantum.n_classes,
+                    input_norm=mcfg.quantum.input_norm,
                     backend="tensor",
                     depolarizing_p=float(p),
                     n_trajectories=N_TRAJ,

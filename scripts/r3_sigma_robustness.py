@@ -28,7 +28,7 @@ from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
 from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.models.qsc import QSCP128
-from qdml_tpu.train.checkpoint import restore_checkpoint
+from qdml_tpu.train.checkpoint import reconcile_quantum_cfg, restore_checkpoint
 
 # single eval-protocol definition shared with the plain-vs-NAT study
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -45,12 +45,9 @@ def main() -> None:
 
     stacked, meta = restore_checkpoint(wd, "nat_sweep_last")
     sigmas = meta["noise_levels"]
-    # architecture facts from the checkpoint (input_norm carries no params,
-    # so rebuilding from defaults would silently mismatch the training
-    # preprocess); absent only in pre-round-3 checkpoints
-    q = meta.get("quantum", {})
-
-    cfg = ExperimentConfig()
+    # Architecture facts come from the checkpoint via the standard
+    # reconciliation (no-op for pre-round-3 checkpoints without the meta).
+    cfg = reconcile_quantum_cfg(ExperimentConfig(), meta)
     geom = ChannelGeometry.from_config(cfg.data)
     start = cfg.data.data_len * 3
     i = jnp.arange(start, start + TEST_N)
@@ -66,10 +63,10 @@ def main() -> None:
         accs = []
         for p in P_GRID:
             model = QSCP128(
-                n_qubits=q.get("n_qubits", cfg.quantum.n_qubits),
-                n_layers=q.get("n_layers", cfg.quantum.n_layers),
-                n_classes=q.get("n_classes", cfg.quantum.n_classes),
-                input_norm=q.get("input_norm", cfg.quantum.input_norm),
+                n_qubits=cfg.quantum.n_qubits,
+                n_layers=cfg.quantum.n_layers,
+                n_classes=cfg.quantum.n_classes,
+                input_norm=cfg.quantum.input_norm,
                 backend="tensor",
                 depolarizing_p=float(p),
                 n_trajectories=N_TRAJ,

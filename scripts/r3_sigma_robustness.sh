@@ -2,9 +2,10 @@
 # Training launcher for the sigma dose-response study
 # (results/noise_robustness/sigma_sweep/): ONE vmapped noise-sweep ensemble
 # run (every sigma in quantum.noise_sweep trained simultaneously), then the
-# per-member trajectory-noise evaluation. Default config (no preset) — the
-# nat_sweep preset also enables gradient pruning at the reference's 0.1
-# threshold, which freezes training (results/noise_robustness/grad_prune/).
+# per-member trajectory-noise evaluation. Run at the default config; the
+# nat_sweep preset is equivalent for this study since the grad_prune
+# measurement (results/noise_robustness/grad_prune/) led to pruning being
+# removed from it.
 set -e
 cd /root/repo
 mkdir -p runs

@@ -6,6 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig, override
 from qdml_tpu.ops import gradient_prune
@@ -81,6 +82,36 @@ def test_gradient_prune_transform():
     out, st = tx.update(grads, st, params)
     np.testing.assert_allclose(np.asarray(out["w"]), [0.0, -0.9, 0.6, 0.0])
     np.testing.assert_allclose(float(st.prune_ratio), 0.5)
+
+
+def test_gradient_prune_quantile_mode():
+    """Quantile mode prunes a FRACTION of elements (scale-free): threshold
+    0.5 zeroes the smallest half across the whole tree regardless of the
+    gradients' absolute scale — the usable on-chip-QNN form (the reference's
+    absolute 0.1 freezes Adam-scale training, results/noise_robustness/)."""
+    tx = gradient_prune(threshold=0.5, mode="quantile")
+    params = {"a": jnp.zeros((2,)), "b": jnp.zeros((2,))}
+    st = tx.init(params)
+    # tiny absolute scale: absolute-0.1 would zero ALL of these
+    grads = {"a": jnp.asarray([1e-5, -9e-4]), "b": jnp.asarray([6e-4, -2e-5])}
+    out, st = tx.update(grads, st, params)
+    np.testing.assert_allclose(np.asarray(out["a"]), [0.0, -9e-4])
+    np.testing.assert_allclose(np.asarray(out["b"]), [6e-4, 0.0])
+    np.testing.assert_allclose(float(st.prune_ratio), 0.5)
+    # boundary: threshold=0 is a no-op (cutoff = min |g|, inclusive keep)
+    tx0 = gradient_prune(threshold=0.0, mode="quantile")
+    out0, st0 = tx0.update(grads, tx0.init(params), params)
+    np.testing.assert_allclose(np.asarray(out0["a"]), np.asarray(grads["a"]))
+    np.testing.assert_allclose(float(st0.prune_ratio), 0.0)
+    # boundary: all-equal magnitudes must never fully prune (cutoff ties keep)
+    eq = {"a": jnp.full((4,), 1e-3)}
+    txe = gradient_prune(threshold=0.5, mode="quantile")
+    oute, ste = txe.update(eq, txe.init(eq), eq)
+    np.testing.assert_allclose(np.asarray(oute["a"]), np.asarray(eq["a"]))
+    with pytest.raises(ValueError, match="quantile threshold"):
+        gradient_prune(threshold=1.5, mode="quantile")
+    with pytest.raises(ValueError, match="mode"):
+        gradient_prune(mode="topk")
 
 
 def test_gradient_prune_all_pruned_freezes_params():
