@@ -583,15 +583,7 @@ def main() -> int:
     # MFU vs the generation's bf16 peak (conservative for the f32 run). Only
     # meaningful on the TPU; CPU fallback reports null.
     on_tpu = platform != "cpu_fallback"
-    for k in (
-        "hdce_f32",
-        "hdce_bf16",
-        "hdce_bf16_scan",
-        "hdce_bf16_scan_rbg",
-        "qsc_dense",
-        "qsc_pallas",
-    ):
-        d = details.get(k)
+    for d in details.values():
         if isinstance(d, dict) and "model_tflops" in d:
             d["mfu"] = round(d["model_tflops"] * 1e12 / peak, 4) if on_tpu else None
 

@@ -45,6 +45,26 @@ def accuracy(model: QSCP128, vars_: dict, batch, key) -> float:
     return float(jnp.mean((pred == batch["indicator"]).astype(jnp.float32)))
 
 
+def write_results(out_dir: str, out: dict, row_header: str) -> str:
+    """results.json + markdown accuracy-vs-p table, shared by the noise
+    studies so the artifact format cannot drift between them. The table's
+    p columns come from ``out["p_grid"]`` — the same grid the JSON records —
+    so the two artifacts cannot disagree."""
+    p_grid = out["p_grid"]
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "results.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+    lines = [
+        f"| {row_header} | " + " | ".join(f"p={p:g}" for p in p_grid) + " |",
+        "|---|" + "---|" * len(p_grid),
+    ]
+    for k, accs in out["curves"].items():
+        lines.append(f"| {k} | " + " | ".join(f"{a:.3f}" for a in accs) + " |")
+    with open(os.path.join(out_dir, "results_table.md"), "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return "\n".join(lines)
+
+
 def main() -> None:
     plain_wd = sys.argv[1] if len(sys.argv) > 1 else "runs/nr_plain/Pn_128/default"
     nat_wd = sys.argv[2] if len(sys.argv) > 2 else "runs/nr_nat/Pn_128/default"
@@ -87,18 +107,7 @@ def main() -> None:
             out["curves"][f"{label}_snr{snr:g}"] = accs
             print(f"{label} @ SNR {snr:g}: {accs}", flush=True)
 
-    os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, "results.json"), "w") as fh:
-        json.dump(out, fh, indent=1)
-    lines = [
-        "| model / SNR | " + " | ".join(f"p={p:g}" for p in P_GRID) + " |",
-        "|---|" + "---|" * len(P_GRID),
-    ]
-    for k, accs in out["curves"].items():
-        lines.append(f"| {k} | " + " | ".join(f"{a:.3f}" for a in accs) + " |")
-    with open(os.path.join(out_dir, "results_table.md"), "w") as fh:
-        fh.write("\n".join(lines) + "\n")
-    print("\n".join(lines))
+    print(write_results(out_dir, out, "model / SNR"))
 
 
 if __name__ == "__main__":

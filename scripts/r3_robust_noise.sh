@@ -1,0 +1,16 @@
+#!/bin/bash
+# Robust-preset QSC under state-level hardware noise
+# (results/noise_robustness/robust_vs_nat/): train the input-norm +
+# SNR-jitter classifier (robust_qsc preset, NO quantum-noise injection)
+# under the study's common 30-epoch protocol, then evaluate it on the
+# shared test stream + depolarizing grid side by side with the seed-1
+# QuantumNAT model from scripts/r3_noise_robustness.sh.
+set -e
+cd /root/repo
+mkdir -p runs
+python -m qdml_tpu.cli train-qsc --preset=robust_qsc --train.n_epochs=30 \
+    --train.resume=true --train.workdir=runs/nr_robust > runs/nr_robust.log 2>&1
+python scripts/r3_noise_robustness.py runs/nr_robust/Pn_128/robust_qsc \
+    runs/nr_nat/Pn_128/default results/noise_robustness/robust_vs_nat \
+    robust quantumnat
+echo "ROBUST NOISE DONE"

@@ -3,7 +3,7 @@
 The 3-seed study (results/noise_robustness/seed_spread.md) found no
 seed-stable depolarizing-noise advantage at the reference's shipped
 σ=0.01. This evaluates the full σ ensemble trained by the vmapped
-noise-sweep trainer (config 5, ``cli nat-sweep``: every member trained
+noise-sweep trainer (``cli nat-sweep``: every member trained
 simultaneously in ONE jitted step): each member (σ ∈ noise_sweep) is
 extracted from the stacked ``nat_sweep_last`` checkpoint and scored on the
 common test stream under the trajectory depolarizing grid.
@@ -11,7 +11,6 @@ common test stream under the trajectory depolarizing grid.
 Usage: python scripts/r3_sigma_robustness.py [sweep_workdir out_dir]
 """
 
-import json
 import os
 import sys
 
@@ -30,14 +29,14 @@ from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.models.qsc import QSCP128
 from qdml_tpu.train.checkpoint import reconcile_quantum_cfg, restore_checkpoint
 
-# single eval-protocol definition shared with the plain-vs-NAT study
+# single eval-protocol + artifact-format definition shared with the
+# plain-vs-NAT study
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from r3_noise_robustness import accuracy  # noqa: E402
+from r3_noise_robustness import accuracy, write_results  # noqa: E402
 
 P_GRID = (0.0, 0.03, 0.1, 0.2)
 N_TRAJ = 32
 TEST_N = 4608
-
 
 def main() -> None:
     wd = sys.argv[1] if len(sys.argv) > 1 else "runs/nr_sweep/Pn_128/default"
@@ -77,17 +76,7 @@ def main() -> None:
         out["curves"][f"sigma={sigma:g}"] = accs
         print(f"sigma={sigma:g}: {accs}", flush=True)
 
-    os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, "results.json"), "w") as fh:
-        json.dump(out, fh, indent=1)
-    lines = ["| training sigma | " + " | ".join(f"p={p:g}" for p in P_GRID) + " |",
-             "|---|" + "---|" * len(P_GRID)]
-    for k, accs in out["curves"].items():
-        lines.append(f"| {k} | " + " | ".join(f"{a:.3f}" for a in accs) + " |")
-    with open(os.path.join(out_dir, "results_table.md"), "w") as fh:
-        fh.write("\n".join(lines) + "\n")
-    print("\n".join(lines))
-
+    print(write_results(out_dir, out, "training sigma"))
 
 if __name__ == "__main__":
     main()
