@@ -80,6 +80,17 @@ class QSCP128(nn.Module):
             weights = weights + noise  # gradient at the noisy point (C7 semantics)
 
         if self.depolarizing_p > 0.0:
+            if self.backend not in ("auto", "tensor"):
+                # the trajectory simulator only has the gate-wise tensor
+                # formulation; silently ignoring an explicit dense/pallas/
+                # sharded choice would e.g. drop a sharded high-qubit model
+                # to a full per-device statevector without warning
+                raise ValueError(
+                    f"depolarizing_p={self.depolarizing_p} uses the trajectory "
+                    f"simulator (tensor formulation only); backend="
+                    f"{self.backend!r} cannot be honored — configure "
+                    "backend='tensor' (or leave 'auto') for noisy evaluation"
+                )
             expz = run_circuit_trajectories(
                 angles,
                 weights,

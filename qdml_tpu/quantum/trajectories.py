@@ -53,6 +53,22 @@ _PAULI_IM = np.array(
 )
 
 
+def _check_p(p) -> None:
+    """Eager guard on the Pauli probability: outside [0, 1] the choice
+    distribution [1-p, p/3, p/3, p/3] is invalid and ``jax.random.choice``
+    samples garbage SILENTLY under jit rather than erroring (the explicit-
+    validation discipline of :mod:`qdml_tpu.ops.grad_prune`). Every entry
+    point takes ``p`` as a config-derived Python float, so the concrete
+    check is the real gate; a value already traced by an enclosing jit is
+    unverifiable here and passes through."""
+    try:
+        pv = float(p)
+    except (jax.errors.ConcretizationTypeError, TypeError):
+        return
+    if not 0.0 <= pv <= 1.0:  # also rejects nan
+        raise ValueError(f"depolarizing probability p must be in [0, 1], got {pv}")
+
+
 def apply_random_paulis(
     psi: CArr, key: jax.Array, p: float, n: int
 ) -> CArr:
@@ -64,6 +80,7 @@ def apply_random_paulis(
     so a batch-aggregated estimate (e.g. test accuracy) would not tighten
     with batch size. ``apply_1q`` broadcasts a ``lead + (2, 2)`` gate, so
     per-sample gates cost one gather per wire."""
+    _check_p(p)
     lead = psi.re.shape[:-1]
     probs = jnp.array([1.0 - p, p / 3.0, p / 3.0, p / 3.0], jnp.float32)
     r = jax.random.choice(key, 4, lead + (n,), p=probs)
@@ -74,7 +91,6 @@ def apply_random_paulis(
     return psi
 
 
-@partial(jax.jit, static_argnames=("n_qubits", "n_layers", "n_traj"))
 def run_circuit_trajectories(
     angles: jnp.ndarray,
     weights: jnp.ndarray,
@@ -91,6 +107,22 @@ def run_circuit_trajectories(
     twirl per site per trajectory. ``p = 0`` reproduces the clean ``tensor``
     backend exactly (every outcome draws the identity).
     """
+    # validate OUTSIDE the jit boundary: inside, p is already a tracer and
+    # the concrete check in apply_random_paulis can no longer fire
+    _check_p(p)
+    return _run_circuit_trajectories(angles, weights, n_qubits, n_layers, p, key, n_traj)
+
+
+@partial(jax.jit, static_argnames=("n_qubits", "n_layers", "n_traj"))
+def _run_circuit_trajectories(
+    angles: jnp.ndarray,
+    weights: jnp.ndarray,
+    n_qubits: int,
+    n_layers: int,
+    p: jnp.ndarray | float,
+    key: jax.Array,
+    n_traj: int = 32,
+) -> jnp.ndarray:
     n, nl = n_qubits, n_layers
 
     def one(k: jax.Array) -> jnp.ndarray:

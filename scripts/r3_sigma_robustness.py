@@ -8,6 +8,16 @@ simultaneously in ONE jitted step): each member (σ ∈ noise_sweep) is
 extracted from the stacked ``nat_sweep_last`` checkpoint and scored on the
 common test stream under the trajectory depolarizing grid.
 
+MODEL-SELECTION CAVEAT (ADVICE r3): members are scored from FINAL-EPOCH
+params (``nat_sweep_last`` is the only checkpoint the vmapped ensemble
+trainer writes) while the plain/NAT seed studies score best-validation
+checkpoints (``qsc_best``). Final-epoch selection can depress ensemble
+clean accuracies relative to those studies, so small clean-accuracy
+differences between the two artifact families (e.g. the σ=0.2/0.3 "clean
+cost" onset) partially confound selection rule with σ — compare clean
+numbers only WITHIN an ensemble, and treat cross-study clean deltas
+under ~2 pp as method noise.
+
 Usage: python scripts/r3_sigma_robustness.py [sweep_workdir out_dir]
 """
 

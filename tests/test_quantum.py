@@ -285,3 +285,22 @@ def test_trajectory_noise_decorrelated_across_batch():
         angles, w, n, layers, 0.3, jax.random.PRNGKey(2), n_traj=1
     )
     assert np.unique(np.asarray(out), axis=0).shape[0] > 1
+
+
+def test_trajectory_p_out_of_range_rejected():
+    """ADVICE r3: p outside [0, 1] makes the Pauli-choice distribution
+    invalid and jax.random.choice samples garbage silently under jit —
+    the entry points must reject it eagerly."""
+    import pytest
+
+    from qdml_tpu.quantum.trajectories import run_circuit_trajectories
+
+    n, layers = 3, 1
+    angles = jnp.zeros((2, n), jnp.float32)
+    w = jnp.ones((layers, n, 2), jnp.float32)
+    for bad in (-0.1, 1.5, float("nan")):
+        with pytest.raises(ValueError, match="must be in"):
+            run_circuit_trajectories(angles, w, n, layers, bad, jax.random.PRNGKey(0), 2)
+    # boundary values stay accepted
+    run_circuit_trajectories(angles, w, n, layers, 0.0, jax.random.PRNGKey(0), 2)
+    run_circuit_trajectories(angles, w, n, layers, 1.0, jax.random.PRNGKey(0), 2)
