@@ -79,6 +79,11 @@ class ModelConfig:
     kernel_size: int = 3
     n_conv_layers: int = 3   # Conv_P128/DCE_P128 trunk depth
     dtype: str = "float32"   # activation dtype ("bfloat16" for the MXU fast path)
+    # Conv lowering: "auto" (lax conv on TPU; shifted matmuls elsewhere —
+    # XLA:CPU's batched-conv gradients are ~23x slower than the identical
+    # work unbatched, results/perf_r4/cpu_fallback_profile.json),
+    # "conv", or "shift_matmul" (models.cnn.resolve_conv_impl).
+    conv_impl: str = "auto"
 
 
 @dataclass(frozen=True)

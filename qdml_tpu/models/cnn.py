@@ -33,6 +33,74 @@ def activation_dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
 
 
+def resolve_conv_impl(impl: str) -> str:
+    """Resolve ``auto`` to a concrete conv lowering for this backend.
+
+    ``conv``: ``lax.conv_general_dilated`` — the right call on TPU, where
+    XLA's conv emitter tiles onto the MXU. ``shift_matmul``: the same SAME
+    convolution as kh*kw shifted-input matmuls. The choice exists because
+    XLA:CPU's gradient kernels for BATCHED convs — what the vmapped
+    per-scenario trunks (:class:`StackedConvP128`) lower to — are
+    pathologically slow: 23x a plain conv's fwd+bwd at identical total work
+    (58.5 ms -> 1357.4 ms when the same conv is vmapped over 3 kernels;
+    the 3-layer trunk: 2.78 s conv vs 0.57 s shift_matmul per
+    quarter-batch; ``results/perf_r4/cpu_fallback_profile.json``). Batched
+    matmuls have no such cliff, so ``auto`` picks ``shift_matmul`` off-TPU.
+    """
+    if impl not in ("auto", "conv", "shift_matmul"):
+        raise ValueError(
+            f"conv_impl must be auto|conv|shift_matmul, got {impl!r}"
+        )
+    if impl != "auto":
+        return impl
+    return "conv" if jax.default_backend() == "tpu" else "shift_matmul"
+
+
+class SpatialConv(nn.Module):
+    """'SAME' no-bias convolution with a selectable lowering.
+
+    Param-compatible with the ``nn.Conv`` it replaces inside
+    :class:`ConvBlock` — same param name ("kernel"), shape
+    ``(kh, kw, cin, cout)``, and lecun-normal init, so checkpoints trained
+    under either lowering (or by earlier rounds' ``nn.Conv`` modules, via
+    ``name="Conv_0"``) load interchangeably; the two impls agree to float
+    tolerance (``tests/test_models.py::test_conv_impls_agree``).
+    """
+
+    features: int
+    kernel_size: tuple = (3, 3)
+    dtype: Any = jnp.float32
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        if kh % 2 == 0 or kw % 2 == 0:
+            # the shift lowering pads k//2 both sides, which only equals
+            # 'SAME' for odd kernels — an even size would make the two
+            # impls (and so the two platforms under "auto") disagree
+            raise ValueError(f"SpatialConv requires odd kernel sizes, got {(kh, kw)}")
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (kh, kw, x.shape[-1], self.features),
+        )
+        xd = x.astype(self.dtype)
+        kd = kernel.astype(self.dtype)
+        if resolve_conv_impl(self.impl) == "conv":
+            return jax.lax.conv_general_dilated(
+                xd, kd, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+            )
+        xp = jnp.pad(xd, ((0, 0), (kh // 2, kh // 2), (kw // 2, kw // 2), (0, 0)))
+        out = None
+        for dy in range(kh):
+            for dx in range(kw):
+                xs = jax.lax.dynamic_slice(xp, (0, dy, dx, 0), xd.shape)
+                y = jnp.einsum("bhwc,cd->bhwd", xs, kd[dy, dx])
+                out = y if out is None else out + y
+        return out
+
+
 class ConvBlock(nn.Module):
     """Conv3x3(no bias) + BatchNorm + ReLU (reference trunk block).
 
@@ -48,10 +116,15 @@ class ConvBlock(nn.Module):
     features: int = 32
     dtype: Any = jnp.float32
     bn_momentum: float = 0.9
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        # name="Conv_0": keep the nn.Conv-era param path (same tree, same
+        # init RNG derivation) so existing checkpoints load unchanged
+        x = SpatialConv(
+            self.features, (3, 3), dtype=self.dtype, impl=self.conv_impl, name="Conv_0"
+        )(x)
         x = nn.BatchNorm(
             use_running_average=not train, momentum=self.bn_momentum, dtype=jnp.float32
         )(x)
@@ -68,11 +141,14 @@ class ConvP128(nn.Module):
     n_layers: int = 3
     dtype: Any = jnp.float32
     bn_momentum: float = 0.9
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         for _ in range(self.n_layers):
-            x = ConvBlock(self.features, self.dtype, self.bn_momentum)(x, train=train)
+            x = ConvBlock(self.features, self.dtype, self.bn_momentum, self.conv_impl)(
+                x, train=train
+            )
         return x.reshape(x.shape[0], -1).astype(jnp.float32)
 
 
@@ -93,10 +169,13 @@ class DCEP128(nn.Module):
     features: int = 32
     out_dim: int = 2048
     dtype: Any = jnp.float32
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = ConvP128(self.features, dtype=self.dtype)(x, train=train)
+        x = ConvP128(self.features, dtype=self.dtype, conv_impl=self.conv_impl)(
+            x, train=train
+        )
         return FCP128(self.out_dim, dtype=self.dtype)(x)
 
 
@@ -137,6 +216,7 @@ class StackedConvP128(nn.Module):
     features: int = 32
     dtype: Any = jnp.float32
     bn_momentum: float = 0.9
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -149,7 +229,12 @@ class StackedConvP128(nn.Module):
             methods=["__call__"],
         )
         # NOTE: train must be positional — flax nn.vmap drops kwargs.
-        return vconv(self.features, dtype=self.dtype, bn_momentum=self.bn_momentum)(x, train)
+        return vconv(
+            self.features,
+            dtype=self.dtype,
+            bn_momentum=self.bn_momentum,
+            conv_impl=self.conv_impl,
+        )(x, train)
 
 
 class QSCPreprocess(nn.Module):

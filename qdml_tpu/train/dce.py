@@ -91,6 +91,7 @@ def init_dce_state(cfg: ExperimentConfig, steps_per_epoch: int):
         features=cfg.model.features,
         out_dim=cfg.h_out_dim,
         dtype=activation_dtype(cfg.model.dtype),
+        conv_impl=cfg.model.conv_impl,
     )
     dummy = jnp.zeros((2, *cfg.image_hw, 2), jnp.float32)
     variables = model.init(jax.random.PRNGKey(cfg.train.seed), dummy, train=False)

@@ -62,6 +62,7 @@ class HDCE(nn.Module):
     features: int = 32
     out_dim: int = 2048
     dtype: Any = jnp.float32
+    conv_impl: str = "auto"  # conv lowering (models.cnn.resolve_conv_impl)
     # torch's per-update BN decay (BatchNorm2d momentum=0.1,
     # Estimators...py:52). init_hdce_state is the single place that
     # compensates the fused step's ONE update per grid-step with
@@ -72,7 +73,7 @@ class HDCE(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         feats = StackedConvP128(
-            self.n_scenarios, self.features, self.dtype, self.bn_momentum
+            self.n_scenarios, self.features, self.dtype, self.bn_momentum, self.conv_impl
         )(x, train=train)
         return FCP128(self.out_dim, self.dtype)(feats)
 
@@ -166,6 +167,7 @@ def init_hdce_state(cfg: ExperimentConfig, steps_per_epoch: int) -> tuple[HDCE, 
         out_dim=cfg.h_out_dim,
         dtype=activation_dtype(cfg.model.dtype),
         bn_momentum=0.9**cfg.data.n_users,
+        conv_impl=cfg.model.conv_impl,
     )
     dummy = jnp.zeros(
         (cfg.data.n_scenarios, 2, *cfg.image_hw, 2), jnp.float32

@@ -1,0 +1,138 @@
+"""Why the CPU-fallback bench trails the torch reference (VERDICT r3 ask #7).
+
+Run on the CPU backend:
+    JAX_PLATFORMS=cpu python scripts/r4_cpu_fallback_profile.py [out.json]
+
+BENCH_r03 recorded the XLA:CPU HDCE step at 174.5 sps vs the same host's
+torch 1,385.9 (vs_baseline 0.13). This script localises the gap with paired
+micro-measurements at the bench shapes and records them as the committed
+evidence behind ``bench.py``'s ``cpu_fallback_note``:
+
+1. one plain 3x3 conv (B=576, 16x8x32): XLA:CPU fwd and fwd+bwd vs torch —
+   parity (XLA conv/matmul kernels are fine);
+2. the SAME total work as the model actually runs it — a 3-scenario VMAPPED
+   3-layer trunk — fwd+bwd under the ``conv`` lowering vs the
+   ``shift_matmul`` lowering: the batched-conv gradient is the cliff
+   (~5x on the trunk; 23x on a single vmapped layer vs the identical work
+   unbatched);
+3. the full bench HDCE f32 step under both lowerings.
+
+The fix shipped with this script: ``ModelConfig.conv_impl = "auto"`` lowers
+convs to shifted matmuls off-TPU (``qdml_tpu.models.cnn.SpatialConv``), the
+formulation whose vmap is a batched matmul XLA:CPU compiles well.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import bench
+
+
+def t_ms(f, n=3) -> float:
+    f()
+    r = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f()
+        r.append(time.perf_counter() - t0)
+    return round(1e3 * min(r), 1)
+
+
+def conv_ref(x, k):
+    return lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+def main() -> None:
+    out: dict = {"backend": jax.default_backend(), "note": "B=576 = quarter bench batch"}
+    rng = np.random.default_rng(0)
+    B = 576
+
+    # 1. plain conv parity vs torch
+    x = jnp.asarray(rng.normal(size=(B, 16, 8, 32)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(3, 3, 32, 32)).astype(np.float32))
+    fwd = jax.jit(conv_ref)
+    out["xla_conv_fwd_ms"] = t_ms(lambda: fwd(x, k).block_until_ready())
+    g = jax.jit(jax.grad(lambda x, k: jnp.sum(conv_ref(x, k) ** 2), argnums=(0, 1)))
+    out["xla_conv_fwdbwd_ms"] = t_ms(lambda: jax.block_until_ready(g(x, k)))
+
+    try:
+        import torch
+        import torch.nn.functional as F
+
+        torch.set_num_threads(1)
+        xt = torch.asarray(np.asarray(x).transpose(0, 3, 1, 2)).requires_grad_(True)
+        kt = torch.asarray(np.asarray(k).transpose(3, 2, 0, 1)).requires_grad_(True)
+        out["torch_conv_fwd_ms"] = t_ms(lambda: F.conv2d(xt, kt, padding=1))
+
+        def tb():
+            xt.grad = kt.grad = None
+            F.conv2d(xt, kt, padding=1).pow(2).sum().backward()
+
+        out["torch_conv_fwdbwd_ms"] = t_ms(tb)
+    except ImportError:
+        out["torch_conv_fwd_ms"] = None
+
+    # 1b. the same single conv VMAPPED over 3 kernel instances (what the
+    # stacked trunk actually lowers to): the batched-conv gradient cliff
+    xs1 = jnp.asarray(rng.normal(size=(3, B // 3, 16, 8, 32)).astype(np.float32))
+    ks1 = jnp.asarray(rng.normal(size=(3, 3, 3, 32, 32)).astype(np.float32))
+    gv = jax.jit(
+        jax.grad(lambda x, k: jnp.sum(jax.vmap(conv_ref)(x, k) ** 2), argnums=(0, 1))
+    )
+    out["xla_vmap3_conv_fwdbwd_ms"] = t_ms(lambda: jax.block_until_ready(gv(xs1, ks1)))
+
+    # 2. the model's actual shape: vmapped 3-scenario trunk, conv vs shift
+    from qdml_tpu.models.cnn import StackedConvP128
+
+    xs = jnp.asarray(rng.normal(size=(3, B // 3, 16, 8, 2)).astype(np.float32))
+    for impl in ("conv", "shift_matmul"):
+        trunk = StackedConvP128(conv_impl=impl)
+        v = trunk.init(jax.random.PRNGKey(0), xs, train=False)
+
+        def loss(p):
+            return jnp.sum(trunk.apply({"params": p["params"], "batch_stats": v["batch_stats"]}, xs, train=False) ** 2)
+
+        gt = jax.jit(jax.grad(loss))
+        out[f"vmap_trunk_{impl}_fwdbwd_ms"] = t_ms(
+            lambda: jax.block_until_ready(gt(v))
+        )
+
+    # 3. full bench step under both lowerings
+    for impl in ("conv", "shift_matmul"):
+        try:
+            out[f"bench_hdce_f32_{impl}"] = bench._bench_hdce(
+                "float32", 6, 60.0, conv_impl=impl
+            )
+        except Exception as e:  # noqa: BLE001
+            out[f"bench_hdce_f32_{impl}"] = {"error": str(e)}
+
+    out["torch_reference_step_sps"] = bench.measure_torch_cpu_reference()
+
+    out_path = (
+        sys.argv[1] if len(sys.argv) > 1 else "results/perf_r4/cpu_fallback_profile.json"
+    )
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -132,3 +132,59 @@ def test_qsc_depolarizing_eval_mode():
     again = noisy_model.apply(vars_, x, train=False, rngs=rngs)
     np.testing.assert_array_equal(np.asarray(noisy), np.asarray(again))
     assert not np.allclose(np.asarray(noisy), np.asarray(clean), atol=1e-4)
+
+
+def test_qsc_depolarizing_rejects_non_tensor_backend():
+    """ADVICE r3: with depolarizing_p > 0 the trajectory simulator (tensor
+    formulation) runs regardless of the configured backend; an explicit
+    dense/pallas/sharded choice must error instead of being silently
+    ignored."""
+    import pytest
+
+    from qdml_tpu.models.qsc import QSCP128
+
+    x = jnp.ones((2, 16, 8, 2), jnp.float32)
+    model = QSCP128(n_qubits=4, n_layers=1, backend="dense", depolarizing_p=0.1)
+    with pytest.raises(ValueError, match="cannot be honored"):
+        model.init(jax.random.PRNGKey(0), x, train=False)
+
+
+def test_conv_impls_agree():
+    """The shift_matmul lowering is the same convolution as lax conv — same
+    param tree (checkpoint-interchangeable), same outputs and gradients to
+    float tolerance — so `auto`'s platform choice can never change results,
+    only speed (the XLA:CPU batched-conv gradient cliff,
+    results/perf_r4/cpu_fallback_profile.json)."""
+    from qdml_tpu.models.cnn import SpatialConv
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 16, 8, 2)), jnp.float32)
+    conv = SpatialConv(8, (3, 3), impl="conv")
+    shift = SpatialConv(8, (3, 3), impl="shift_matmul")
+    v = conv.init(jax.random.PRNGKey(1), x)
+    assert jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(
+        shift.init(jax.random.PRNGKey(1), x)
+    )
+    np.testing.assert_allclose(
+        np.asarray(conv.apply(v, x)), np.asarray(shift.apply(v, x)), atol=2e-5
+    )
+    gc = jax.grad(lambda p: jnp.sum(conv.apply(p, x) ** 2))(v)
+    gs = jax.grad(lambda p: jnp.sum(shift.apply(p, x) ** 2))(v)
+    np.testing.assert_allclose(
+        np.asarray(gc["params"]["kernel"]), np.asarray(gs["params"]["kernel"]), atol=2e-3
+    )
+
+
+def test_stacked_trunk_conv_impl_override():
+    """conv_impl threads through the vmapped trunk; both lowerings produce
+    the same stacked features from the same params."""
+    from qdml_tpu.models.cnn import StackedConvP128
+
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(3, 2, 16, 8, 2)), jnp.float32)
+    a = StackedConvP128(conv_impl="conv")
+    b = StackedConvP128(conv_impl="shift_matmul")
+    v = a.init(jax.random.PRNGKey(0), x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(a.apply(v, x, train=False)),
+        np.asarray(b.apply(v, x, train=False)),
+        atol=1e-4,
+    )
