@@ -25,7 +25,9 @@ with backoff (the tunnelled axon backend has been observed both to fail fast
 and to hang at interpreter start); every measurement runs in a child with its
 own timeout. If the TPU is unreachable the harness still emits a finite
 number measured on CPU (``platform: "cpu_fallback"``) plus the TPU error —
-a structured record instead of a bare traceback.
+a structured record instead of a bare traceback — and every record carries
+``probe_attempts``, the timestamped outcome of each probe, so a
+down-all-window tunnel is provable from the artifact alone.
 
 ``vs_baseline`` is the speedup over a faithful torch-CPU implementation of
 the reference training step, measured against a FIXED committed constant
@@ -150,13 +152,24 @@ def _make_grid_batch(cfg):
     )
 
 
-def _bench_hdce(dtype: str, max_steps: int, budget_s: float) -> dict:
+def _bench_hdce(
+    dtype: str,
+    max_steps: int,
+    budget_s: float,
+    features: int = 32,
+    conv_impl: str = "auto",
+) -> dict:
+    """``features`` widens the conv trunk beyond the reference's 32 channels
+    — the round-4 lane-occupancy scaling probe (scripts/r4_perf_session.py);
+    the FLOP model derives from the same cfg so MFU stays consistent.
+    ``conv_impl`` overrides the platform-resolved conv lowering
+    (scripts/r4_cpu_fallback_profile.py measures both on CPU)."""
     from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
     from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
 
     cfg = ExperimentConfig(
         data=DataConfig(),
-        model=ModelConfig(dtype=dtype),
+        model=ModelConfig(dtype=dtype, features=features, conv_impl=conv_impl),
         train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
     )
     batch = _make_grid_batch(cfg)
@@ -380,52 +393,105 @@ def _cpu_env() -> dict:
     return env
 
 
+# Timestamped log of every probe attempt this harness run, embedded in the
+# final record as ``probe_attempts`` — a cpu_fallback artifact thereby PROVES
+# the tunnel was down across the whole window instead of asserting it
+# (VERDICT r3 ask #5). ``t`` is seconds since harness start.
+PROBE_LOG: list[dict] = []
+_T0 = time.monotonic()
+
+
+def _probe_timeouts() -> tuple[int, int]:
+    """(cheap_s, full_s) — the two probe-timeout tiers, single-sourced for
+    probe_tpu's up-front schedule and main()'s late loop."""
+    full = int(os.environ.get("QDML_BENCH_PROBE_TIMEOUT", "150"))
+    cheap = min(int(os.environ.get("QDML_BENCH_PROBE_TIMEOUT_CHEAP", "45")), full)
+    return cheap, full
+
+
+def _probe_once_tiered(i: int) -> str | None:
+    """One probe at the tier the attempt index selects: cheap, with every
+    4th escalated to the full timeout (slow-but-live tunnel)."""
+    cheap_s, full_s = _probe_timeouts()
+    return _probe_once(full_s if i % 4 == 3 else cheap_s)
+
+
+def _probe_once(timeout_s: int) -> str | None:
+    """One probe subprocess; returns None on a verified-TPU success. Every
+    attempt (outcome + timestamp + timeout used) is appended to PROBE_LOG."""
+    t = round(time.monotonic() - _T0, 1)
+    err: str | None
+    try:
+        # cwd = repo root so the '-c' child resolves qdml_tpu regardless
+        # of where the harness itself was invoked from (python -c puts
+        # the cwd, not the script dir, on sys.path).
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        err = f"probe timed out after {timeout_s}s (backend init hang)"
+    else:
+        if r.returncode == 0 and r.stdout.strip().endswith("64"):
+            # parse the probe's OWN output line (the last one): earlier stdout
+            # noise from plugin imports must not defeat the backend check
+            backend = r.stdout.strip().splitlines()[-1].split()[0]
+            err = (
+                None
+                if backend != "cpu"
+                else f"jax silently fell back to backend {backend!r}"
+            )
+        else:
+            lines = (r.stderr.strip() or r.stdout.strip()).splitlines()
+            # prefer the actual exception line over jax's trailing filter notes
+            err_lines = [ln for ln in lines if "Error" in ln or "error" in ln]
+            err = (err_lines or lines or ["rc!=0"])[-1].strip()
+    PROBE_LOG.append(
+        {"t": t, "timeout_s": timeout_s, "result": "ok" if err is None else err}
+    )
+    return err
+
+
 def probe_tpu(attempts: int | None = None, timeout_s: int | None = None) -> str | None:
     """Returns None if a TPU subprocess computes successfully, else the error.
 
     The tunnelled axon backend drops and restores on minutes-to-tens-of-
     minutes timescales (two rounds of driver artifacts show a 2-attempt
     probe losing the race; a round-3 session observed a >25-minute outage),
-    so probing is patient AND spread: 3 backoff attempts up front, then the
-    CPU fallback bench burns ~10 further minutes, then single attempts every
-    ~2 minutes for as long as the QDML_BENCH_WALL_BUDGET_S wall budget
-    leaves room to still run the TPU bench child (see main) — before
-    conceding a cpu_fallback record.
+    so probing is patient AND spread: cheap attempts up front, then the
+    CPU fallback bench burns ~10 further minutes, then continuous cheap
+    probes for as long as the QDML_BENCH_WALL_BUDGET_S wall budget leaves
+    room to still run the TPU bench child (see main) — before conceding a
+    cpu_fallback record.
+
+    Two-tier timeouts (VERDICT r3 ask #5 — the old flat 150s probe bought
+    only ~6 attempts across the window): a DOWN tunnel hangs at backend
+    init, and a HEALTHY one with the warmed persistent compile cache
+    answers in well under QDML_BENCH_PROBE_TIMEOUT_CHEAP (45s), so most
+    attempts use the cheap timeout and every 4th escalates to the full
+    QDML_BENCH_PROBE_TIMEOUT (150s) to keep catching a live-but-slow
+    tunnel (cold cache, loaded host). The liveness check IS the real
+    resource check — it computes on the device — just time-bounded.
     """
     attempts = attempts or int(os.environ.get("QDML_BENCH_PROBE_ATTEMPTS", "3"))
-    timeout_s = timeout_s or int(os.environ.get("QDML_BENCH_PROBE_TIMEOUT", "150"))
+    cheap_env, full_env = _probe_timeouts()
+    timeout_s = timeout_s or full_env
+    cheap_s = min(cheap_env, timeout_s)
     err = "unknown"
     for i in range(attempts):
         if i:
             backoff = min(20 * 2 ** (i - 1), 300)
             print(f"[bench] TPU probe retry in {backoff}s", file=sys.stderr, flush=True)
             time.sleep(backoff)
-        try:
-            # cwd = repo root so the '-c' child resolves qdml_tpu regardless
-            # of where the harness itself was invoked from (python -c puts
-            # the cwd, not the script dir, on sys.path).
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE],
-                capture_output=True,
-                text=True,
-                timeout=timeout_s,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-        except subprocess.TimeoutExpired:
-            err = f"probe timed out after {timeout_s}s (backend init hang)"
-            continue
-        if r.returncode == 0 and r.stdout.strip().endswith("64"):
-            # parse the probe's OWN output line (the last one): earlier stdout
-            # noise from plugin imports must not defeat the backend check
-            backend = r.stdout.strip().splitlines()[-1].split()[0]
-            if backend != "cpu":
-                return None
-            err = f"jax silently fell back to backend {backend!r}"
-            continue
-        lines = (r.stderr.strip() or r.stdout.strip()).splitlines()
-        # prefer the actual exception line over jax's trailing filter notes
-        err_lines = [ln for ln in lines if "Error" in ln or "error" in ln]
-        err = (err_lines or lines or ["rc!=0"])[-1].strip()
+        # escalate to the full timeout on the last of the up-front attempts
+        # and on every 4th attempt of a longer schedule
+        full = i == attempts - 1 if attempts <= 4 else i % 4 == 3
+        err = _probe_once(timeout_s if full else cheap_s)
+        if err is None:
+            return None
     return err
 
 
@@ -537,13 +603,21 @@ def main() -> int:
         # supersedes the CPU fallback. Probe timeouts honor
         # QDML_BENCH_PROBE_TIMEOUT (probe_tpu's env default).
         first = True
+        late_i = 0
         while first or time.monotonic() - t_start < wall_budget - tpu_child_cost:
-            # The guaranteed first pass keeps the old 3-attempt backoff
-            # spread (env default); later passes are single probes since the
-            # loop itself provides the spread.
-            probe_kw = {} if first else {"attempts": 1}
+            # The guaranteed first pass keeps the old multi-attempt backoff
+            # spread (env default); later passes are single cheap probes —
+            # the loop itself provides the spread, and a 45s probe buys ~3x
+            # the attempts of the old flat-150s one — with every 4th
+            # escalated to the full timeout (slow-but-live tunnel).
+            t_probe = time.monotonic()
+            if first:
+                ok = probe_tpu() is None
+            else:
+                ok = _probe_once_tiered(late_i) is None
+                late_i += 1
             first = False
-            if probe_tpu(**probe_kw) is None:
+            if ok:
                 # Cap the child near the remaining budget, but never below
                 # the old fixed 1500s: a child recovering from a long outage
                 # is the cold-compile case, and a TPU record is worth
@@ -563,7 +637,11 @@ def main() -> int:
                 file=sys.stderr,
                 flush=True,
             )
-            time.sleep(45)
+            # hold ~one probe per minute in BOTH outage modes: a hanging
+            # tunnel burns the probe timeout (sleep bottoms out at 15s),
+            # while a fail-fast one returns in seconds (sleep stretches to
+            # keep the cadence — and the subprocess churn — bounded)
+            time.sleep(max(15.0, 60.0 - (time.monotonic() - t_probe)))
     if details is None:
         rec = {
             "metric": "hdce_train_samples_per_sec_per_chip",
@@ -572,6 +650,7 @@ def main() -> int:
             "vs_baseline": None,
             "platform": "none",
             "error": tpu_error or "all bench children failed",
+            "probe_attempts": PROBE_LOG,
         }
         committed = _latest_committed_tpu_record()
         if committed is not None:
@@ -614,6 +693,7 @@ def main() -> int:
             "platform": platform,
             "error": "all HDCE measurements failed",
             "details": details,
+            "probe_attempts": PROBE_LOG,
         }
         committed = _latest_committed_tpu_record()
         if committed is not None:
@@ -650,11 +730,29 @@ def main() -> int:
         "torch_cpu_reference_sps": REFERENCE_TORCH_CPU_SPS,
         "torch_cpu_reference_sps_live": round(baseline_live, 1) if baseline_live else None,
         "details": details,
+        "probe_attempts": PROBE_LOG,
     }
     if tpu_error is not None:
         record["tpu_error"] = tpu_error
     if committed_tpu is not None:
         record["latest_committed_tpu_record"] = committed_tpu
+    if platform == "cpu_fallback":
+        # Why this number trails the torch-CPU baseline (VERDICT r3 ask #7),
+        # measured in results/perf_r4/cpu_fallback_profile.json: XLA:CPU's
+        # gradient kernels for BATCHED convs (what the vmapped per-scenario
+        # trunks lower to) run 23x slower than the identical work unbatched,
+        # while its plain conv/matmul kernels sit within ~2x of torch. The
+        # framework now lowers convs to shifted matmuls off-TPU
+        # (ModelConfig.conv_impl "auto", models/cnn.py), lifting the
+        # fallback step 172 -> 451 sps; the remaining ~3x is torch's fused
+        # oneDNN kernels vs XLA:CPU's emission at these tiny 16x8 spatial
+        # shapes — a CPU code-path quality issue, no bearing on the TPU
+        # design.
+        record["cpu_fallback_note"] = (
+            "XLA:CPU batched-conv gradients are the cliff (23x vs the same "
+            "work unbatched); convs lower to shift_matmul off-TPU since r4 "
+            "(172 -> 451 sps) — see results/perf_r4/cpu_fallback_profile.json"
+        )
     print(json.dumps(record))
     return 0
 

@@ -1,0 +1,335 @@
+"""On-chip perf session, round 4: device-time decompositions.
+
+Run on the real TPU when the tunnel is up:
+    python scripts/r4_perf_session.py [out.json]
+
+Round 3 left two claims resting on WALL-time measurements that the tunnelled
+single-chip backend contaminates with a ~1.5 ms/dispatch host gap
+(docs/ROOFLINE.md). This session separates device-busy time from wall time by
+parsing ``jax.profiler`` traces (the same extraction ROOFLINE.md did by hand
+for the r3 HDCE step), and answers:
+
+1. **Conv-width scaling probe** (VERDICT r4 ask #1): HDCE bf16 step at trunk
+   features 32 / 64 / 128 — wall sps, wall MFU AND device-busy MFU per
+   width. If device-busy MFU rises materially with width, the roofline's
+   "32-channel lane occupancy caps the step" claim is confirmed; if flat,
+   the ceiling lives elsewhere.
+2. **Generator device cost** (ask #1): device-busy ms/step of the scan-fused
+   path minus the fixed-batch step isolates the in-scan generator; measured
+   for the threefry vs hardware-RBG streams (~5.5 M normal draws/step,
+   dominated by the 2x1024/sample label noise). Top per-op durations inside
+   the scan module are recorded so the tail has names.
+3. **Pallas story reconciliation** (ask #2): QSC circuit forward AND
+   backward, dense vs whole-circuit pallas kernel — wall time (the r3
+   microbench's only metric) next to device-busy time per call, plus the
+   full-step alternating A/B. The r3 contradiction (kernel forward 2.5x
+   slower at 2069 us wall yet the step wins 4/4 A/B) is decided by whether
+   the forward gap survives in device time.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+
+OUT_DIR = "results/perf_r4"
+
+
+# ---------------------------------------------------------------------------
+# Trace-based device-busy extraction
+# ---------------------------------------------------------------------------
+
+
+def _load_trace_events(trace_dir: str) -> list:
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    newest = max(paths, key=os.path.getmtime)
+    with gzip.open(newest) as fh:
+        return json.load(fh)["traceEvents"]
+
+
+def _device_tids(ev: list, thread: str) -> set:
+    dev_pids = {
+        e["pid"]
+        for e in ev
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and "device" in str(e.get("args", {}).get("name", "")).lower()
+    }
+    return {
+        (e["pid"], e["tid"])
+        for e in ev
+        if e.get("ph") == "M"
+        and e.get("name") == "thread_name"
+        and e.get("args", {}).get("name") == thread
+        and e["pid"] in dev_pids
+    }
+
+
+def device_busy_profile(fn, reps: int, keep_trace: str | None = None) -> dict:
+    """Trace ``reps`` calls of ``fn`` (which must force completion itself via
+    a host transfer) and return device-busy stats from the XLA Modules
+    timeline: total busy ms per call + the top ops by accumulated duration.
+
+    ``keep_trace``: optional path to copy the raw .trace.json.gz to (committed
+    evidence)."""
+    fn()  # warmup/compile outside the trace
+    tmp = tempfile.mkdtemp(prefix="r4trace_")
+    try:
+        with jax.profiler.trace(tmp):
+            for _ in range(reps):
+                fn()
+        ev = _load_trace_events(tmp)
+        if keep_trace:
+            src = max(
+                glob.glob(os.path.join(tmp, "**", "*.trace.json.gz"), recursive=True),
+                key=os.path.getmtime,
+            )
+            os.makedirs(os.path.dirname(keep_trace), exist_ok=True)
+            shutil.copy(src, keep_trace)
+    finally:
+        if not keep_trace:
+            shutil.rmtree(tmp, ignore_errors=True)
+    mod_tids = _device_tids(ev, "XLA Modules")
+    op_tids = _device_tids(ev, "XLA Ops")
+    busy_us = sum(
+        e.get("dur", 0)
+        for e in ev
+        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in mod_tids
+    )
+    ops = collections.Counter()
+    for e in ev:
+        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in op_tids:
+            ops[e["name"]] += e.get("dur", 0)
+    top = [
+        {"op": k, "total_us": round(v, 1), "per_call_us": round(v / reps, 1)}
+        for k, v in ops.most_common(12)
+    ]
+    return {
+        "device_busy_ms_per_call": round(busy_us / 1e3 / reps, 3),
+        "reps": reps,
+        "top_ops": top,
+    }
+
+
+def _save(out: dict, out_path: str) -> None:
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump(out, fh, indent=1)
+
+
+def _guard(out: dict, key: str, fn) -> None:
+    try:
+        out[key] = fn()
+    except Exception as e:  # noqa: BLE001
+        out[key] = {"error": f"{type(e).__name__}: {e}"}
+    print(key, json.dumps(out[key])[:400], flush=True)
+
+
+# ---------------------------------------------------------------------------
+# 1. Conv-width scaling probe
+# ---------------------------------------------------------------------------
+
+
+def width_probe(features: int, trace_path: str | None) -> dict:
+    from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
+    from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
+
+    # wall measurement through the shared bench harness (same program)
+    wall = bench._bench_hdce("bfloat16", 50, 45.0, features=features)
+
+    cfg = ExperimentConfig(
+        data=DataConfig(),
+        model=ModelConfig(dtype="bfloat16", features=features),
+        train=TrainConfig(batch_size=bench._CELL_BS, n_epochs=1),
+    )
+    batch = bench._make_grid_batch(cfg)
+    batch = {k: batch[k] for k in ("yp_img", "h_label", "h_perf")}
+    model, state = init_hdce_state(cfg, steps_per_epoch=100)
+    step = make_hdce_train_step(model, state.tx)
+    holder = {"state": state}
+
+    def once():
+        holder["state"], m = step(holder["state"], batch)
+        float(m["loss"])
+
+    prof = device_busy_profile(once, reps=10, keep_trace=trace_path)
+    n_samples = 9 * bench._CELL_BS
+    step_flops = 3.0 * bench.hdce_fwd_flops_per_sample(cfg) * n_samples
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = bench._PEAK_BF16.get(gen, bench._PEAK_BF16["v5e"])
+    busy_s = prof["device_busy_ms_per_call"] / 1e3
+    return {
+        "features": features,
+        "wall_sps": wall["samples_per_sec"],
+        "wall_mfu": round(wall["model_tflops"] * 1e12 / peak, 4),
+        "device_busy_ms": prof["device_busy_ms_per_call"],
+        "device_busy_mfu": round(step_flops / busy_s / peak, 4) if busy_s else None,
+        "step_gflops": round(step_flops / 1e9, 2),
+        "top_ops": prof["top_ops"][:6],
+    }
+
+
+# ---------------------------------------------------------------------------
+# 2. Generator device cost (scan minus fixed-batch)
+# ---------------------------------------------------------------------------
+
+
+def scan_probe(rng_impl: str, keep_trace: str | None = None) -> dict:
+    from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.train.hdce import init_hdce_state, make_hdce_scan_steps
+
+    k = 16
+    wall = bench._bench_hdce_scan("bfloat16", k, 50, 45.0, rng_impl=rng_impl)
+
+    cfg = ExperimentConfig(
+        data=DataConfig(rng_impl=rng_impl),
+        model=ModelConfig(dtype="bfloat16"),
+        train=TrainConfig(batch_size=bench._CELL_BS, n_epochs=1),
+    )
+    geom = ChannelGeometry.from_config(cfg.data)
+    s, u = bench._GRID
+    scen, user, idx1 = bench._grid_coords()
+    idx = jnp.broadcast_to(idx1[None], (k, s, u, bench._CELL_BS)).astype(jnp.int32)
+    snrs = jnp.full((k,), float(cfg.data.snr_db), jnp.float32)
+    model, state = init_hdce_state(cfg, steps_per_epoch=100)
+    run = make_hdce_scan_steps(model, geom)
+    seed = jnp.uint32(0)
+    holder = {"state": state}
+
+    def once():
+        holder["state"], ms = run(holder["state"], seed, scen, user, idx, snrs)
+        float(ms["loss"][-1])
+
+    prof = device_busy_profile(once, reps=4, keep_trace=keep_trace)
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = bench._PEAK_BF16.get(gen, bench._PEAK_BF16["v5e"])
+    busy_per_step = prof["device_busy_ms_per_call"] / k
+    step_flops = 3.0 * bench.hdce_fwd_flops_per_sample(cfg) * 9 * bench._CELL_BS
+    return {
+        "rng_impl": rng_impl,
+        "wall_sps": wall["samples_per_sec"],
+        "wall_mfu": round(wall["model_tflops"] * 1e12 / peak, 4),
+        "device_busy_ms_per_step": round(busy_per_step, 3),
+        "device_busy_mfu": round(step_flops / (busy_per_step / 1e3) / peak, 4),
+        "top_ops": prof["top_ops"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# 3. QSC circuit forward/backward device decomposition
+# ---------------------------------------------------------------------------
+
+
+def qsc_circuit_probe(backend: str) -> dict:
+    from qdml_tpu.quantum.circuits import run_circuit
+
+    B, N, L = 2304, 6, 3
+    rng = np.random.default_rng(0)
+    angles = jnp.asarray(rng.uniform(-1, 1, (B, N)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(-3, 3, (L, N, 2)).astype(np.float32))
+
+    fwd = jax.jit(lambda a, ww: run_circuit(a, ww, N, L, backend))
+    bwd = jax.jit(
+        jax.grad(lambda a, ww: jnp.sum(run_circuit(a, ww, N, L, backend) ** 2), (0, 1))
+    )
+
+    def wall(fn, *args, reps=50):
+        out = fn(*args)
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+        return (time.perf_counter() - t0) / reps * 1e6
+
+    res = {"backend": backend}
+    res["fwd_wall_us"] = round(wall(fwd, angles, w), 1)
+    res["fwd_device"] = device_busy_profile(
+        lambda: float(jnp.sum(fwd(angles, w))), reps=30
+    )
+    res["bwd_wall_us"] = round(wall(bwd, angles, w), 1)
+    res["bwd_device"] = device_busy_profile(
+        lambda: float(jnp.sum(bwd(angles, w)[0])), reps=30
+    )
+    return res
+
+
+# ---------------------------------------------------------------------------
+# 4. QSC full-step alternating A/B (r3 machinery)
+# ---------------------------------------------------------------------------
+
+
+def qsc_step_ab(rounds: int = 6) -> dict:
+    results: dict = {"dense": [], "pallas": []}
+    for r in range(rounds):
+        for k in ("dense", "pallas"):
+            try:
+                results[k].append(bench._bench_qsc(k, 50, 25.0)["samples_per_sec"])
+            except Exception as e:  # noqa: BLE001
+                results[k].append(None)
+                results.setdefault("errors", []).append(f"{k}@{r}: {e}")
+        print(f"[qsc_ab] round {r}: {results['dense'][-1]} vs {results['pallas'][-1]}", flush=True)
+    out = {"rounds": results}
+    for k in ("dense", "pallas"):
+        vals = [v for v in results[k] if v is not None]
+        if vals:
+            out[f"{k}_med"] = round(statistics.median(vals), 1)
+    out["pallas_wins"] = sum(
+        1
+        for d, p in zip(results["dense"], results["pallas"])
+        if d is not None and p is not None and p > d
+    )
+    return out
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else f"{OUT_DIR}/r4_perf_session.json"
+    print("backend:", jax.default_backend(), flush=True)
+    out: dict = {"backend": jax.default_backend()}
+    if out["backend"] != "tpu":
+        print("WARNING: not on TPU — numbers will not be committed evidence", flush=True)
+
+    for feats in (32, 64, 128):
+        trace = f"{OUT_DIR}/hdce_w{feats}.trace.json.gz" if feats in (32, 128) else None
+        _guard(out, f"width_{feats}", lambda f=feats, t=trace: width_probe(f, t))
+        _save(out, out_path)
+
+    for impl in ("threefry", "rbg"):
+        trace = f"{OUT_DIR}/scan_{impl}.trace.json.gz"
+        _guard(out, f"scan_{impl}", lambda i=impl, t=trace: scan_probe(i, t))
+        _save(out, out_path)
+
+    for backend in ("dense", "pallas"):
+        _guard(out, f"qsc_fwd_bwd_{backend}", lambda b=backend: qsc_circuit_probe(b))
+        _save(out, out_path)
+
+    _guard(out, "qsc_step_ab", qsc_step_ab)
+    _save(out, out_path)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
