@@ -291,9 +291,10 @@ def run_child(platform: str) -> int:
     ]
     if on_tpu:
         # The scan-fused path exists to remove the per-step host dispatch gap
-        # of the tunnelled accelerator; on the CPU fallback a single
-        # full-geometry step is ~13 s, so the K-step variant would only burn
-        # the child's budget re-measuring the same compute.
+        # of the tunnelled accelerator; the CPU fallback is compute-bound
+        # (~5 s per full-geometry step even after the r4 shift_matmul
+        # lowering), so the K-step variant would only burn the child's
+        # budget re-measuring the same compute.
         benches.append(
             ("hdce_bf16_scan", lambda: _bench_hdce_scan("bfloat16", scan_k, max_steps, budget))
         )

@@ -169,6 +169,13 @@ def train_nat_sweep(
     ``nat_sweep_best`` checkpoint holding the single best member's params
     (loadable into one :class:`QSCP128`) alongside the stacked
     ``nat_sweep_last``/``nat_sweep_resume``.
+
+    ``nat_sweep_member_best`` (ADVICE r3): EVERY member's best-validation
+    params as one stacked tree (meta: per-member best acc + epoch), so
+    ensemble studies can score best-val selections — the same rule the
+    single-model seed studies use (``qsc_best``) — instead of final-epoch
+    params; the last-vs-best asymmetry confounded small clean-accuracy
+    comparisons across the two artifact families.
     """
     logger = logger or MetricsLogger(echo=False)
     geom = ChannelGeometry.from_config(cfg.data)
@@ -208,6 +215,34 @@ def train_nat_sweep(
         start_epoch = int(rmeta.get("epoch", -1)) + 1
         best_acc = float(rmeta.get("best_acc", best_acc))
 
+    # Per-member best-validation tracking (stacked, like the params). COPY,
+    # not alias: the train step donates its params argument on accelerator
+    # backends, so an aliased member_best would reference deleted buffers at
+    # the first best-update.
+    member_best = jax.tree.map(jnp.copy, params)
+    member_best_acc = np.full(n_members, -1.0)
+    member_best_epoch = np.full(n_members, -1)
+    # Only trust a member_best checkpoint when it belongs to the run being
+    # resumed (start_epoch > 0 — i.e. nat_sweep_resume was restored, which
+    # already validated noise_levels) AND its own levels match: a stale
+    # member_best from an abandoned workdir would otherwise suppress
+    # `improved` with a previous run's accs and ship that run's params.
+    if start_epoch > 0 and has_checkpoint(workdir, "nat_sweep_member_best"):
+        restored_mb, mb_meta = restore_checkpoint(
+            workdir, "nat_sweep_member_best", {"params": params}
+        )
+        mb_levels = mb_meta.get("noise_levels")
+        if mb_levels is not None and list(mb_levels) != list(map(float, noise_levels)):
+            raise ValueError(
+                f"nat_sweep_member_best noise_levels mismatch: checkpoint has "
+                f"{mb_levels}, requested {list(map(float, noise_levels))}"
+            )
+        member_best = restored_mb["params"]
+        member_best_acc = np.asarray(mb_meta.get("member_best_acc", member_best_acc), float)
+        member_best_epoch = np.asarray(
+            mb_meta.get("member_best_epoch", member_best_epoch), int
+        )
+
     # Multi-device: replicate the stacked ensemble, shard batches over the
     # data axis (same placement policy as the other trainers).
     from qdml_tpu.parallel.dp import replicate
@@ -216,7 +251,12 @@ def train_nat_sweep(
 
     mesh = training_mesh(cfg)
     if mesh is not None:
-        params, opt_state = replicate((params, opt_state), mesh)
+        # member_best included: a fresh copy shares params' placement, but a
+        # RESTORED one is committed to device 0 by orbax and would clash
+        # with the replicated params inside the best-update where()
+        params, opt_state, member_best = replicate(
+            (params, opt_state, member_best), mesh
+        )
     place_train = make_grid_placer(train_loader, mesh)
     place_val = make_grid_placer(val_loader, mesh)
 
@@ -276,6 +316,32 @@ def train_nat_sweep(
             per_member[f"val_loss_sigma{s:g}"] = float(vloss[i])
             per_member[f"val_acc_sigma{s:g}"] = float(vacc[i])
         logger.log(epoch=epoch, **per_member)
+
+        improved = vacc > member_best_acc
+        if improved.any():
+            mask = jnp.asarray(improved)
+            member_best = jax.tree.map(
+                lambda b, p: jnp.where(
+                    mask.reshape(mask.shape + (1,) * (p.ndim - 1)), p, b
+                ),
+                member_best,
+                params,
+            )
+            member_best_acc = np.where(improved, vacc, member_best_acc)
+            member_best_epoch = np.where(improved, epoch, member_best_epoch)
+            if workdir is not None:
+                save_checkpoint(
+                    workdir,
+                    "nat_sweep_member_best",
+                    {"params": member_best},
+                    {
+                        "member_best_acc": [float(a) for a in member_best_acc],
+                        "member_best_epoch": [int(e) for e in member_best_epoch],
+                        "noise_levels": list(map(float, noise_levels)),
+                        "name": cfg.name,
+                        "quantum": quantum_meta,
+                    },
+                )
 
         if workdir is not None:
             top = int(np.argmax(vacc))

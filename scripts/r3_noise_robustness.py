@@ -38,6 +38,20 @@ TEST_N = 4608  # 2 full grid batches of fresh samples
 SNRS = (5.0, 10.0)
 
 
+def common_test_batches(cfg: ExperimentConfig, geom: ChannelGeometry) -> dict:
+    """The studies' COMMON fresh test stream: one batch per SNR, offset past
+    the training data (``Test.py:127`` start-offset convention), keyed by the
+    shared ``cfg.data.seed`` so every noise study scores the same samples."""
+    start = cfg.data.data_len * 3
+    i = jnp.arange(start, start + TEST_N)
+    return {
+        snr: make_network_batch(
+            jnp.uint32(cfg.data.seed), i % 3, (i // 3) % 3, i, jnp.float32(snr), geom
+        )
+        for snr in SNRS
+    }
+
+
 def accuracy(model: QSCP128, vars_: dict, batch, key) -> float:
     rngs = {"trajectories": key} if model.depolarizing_p > 0 else None
     logp = model.apply(vars_, batch["yp_img"], train=False, rngs=rngs)
@@ -73,15 +87,7 @@ def main() -> None:
 
     cfg = ExperimentConfig()
     geom = ChannelGeometry.from_config(cfg.data)
-    # common fresh test stream, offset past training data (Test.py:127)
-    start = cfg.data.data_len * 3
-    i = jnp.arange(start, start + TEST_N)
-    batches = {
-        snr: make_network_batch(
-            jnp.uint32(cfg.data.seed), i % 3, (i // 3) % 3, i, jnp.float32(snr), geom
-        )
-        for snr in SNRS
-    }
+    batches = common_test_batches(cfg, geom)
 
     out = {"p_grid": list(P_GRID), "n_trajectories": N_TRAJ, "test_n": TEST_N, "curves": {}}
     for label, wd in ((labels[0], plain_wd), (labels[1], nat_wd)):

@@ -8,15 +8,15 @@ simultaneously in ONE jitted step): each member (σ ∈ noise_sweep) is
 extracted from the stacked ``nat_sweep_last`` checkpoint and scored on the
 common test stream under the trajectory depolarizing grid.
 
-MODEL-SELECTION CAVEAT (ADVICE r3): members are scored from FINAL-EPOCH
-params (``nat_sweep_last`` is the only checkpoint the vmapped ensemble
-trainer writes) while the plain/NAT seed studies score best-validation
-checkpoints (``qsc_best``). Final-epoch selection can depress ensemble
-clean accuracies relative to those studies, so small clean-accuracy
-differences between the two artifact families (e.g. the σ=0.2/0.3 "clean
-cost" onset) partially confound selection rule with σ — compare clean
-numbers only WITHIN an ensemble, and treat cross-study clean deltas
-under ~2 pp as method noise.
+MODEL-SELECTION CAVEAT (ADVICE r3): workdirs trained before round 4 only
+have FINAL-EPOCH stacked params (``nat_sweep_last``) while the plain/NAT
+seed studies score best-validation checkpoints (``qsc_best``); final-epoch
+selection can depress ensemble clean accuracies, so for those artifacts
+small cross-study clean deltas (≲2 pp, e.g. the σ=0.2/0.3 "clean cost"
+onset) partially confound selection rule with σ. The round-4 trainer also
+writes ``nat_sweep_member_best`` (every member's best-val params), which
+this script PREFERS when present — aligning the selection rule with the
+seed studies; the artifact records which source was used.
 
 Usage: python scripts/r3_sigma_robustness.py [sweep_workdir out_dir]
 """
@@ -52,7 +52,12 @@ def main() -> None:
     wd = sys.argv[1] if len(sys.argv) > 1 else "runs/nr_sweep/Pn_128/default"
     out_dir = sys.argv[2] if len(sys.argv) > 2 else "results/noise_robustness/sigma_sweep"
 
-    stacked, meta = restore_checkpoint(wd, "nat_sweep_last")
+    from qdml_tpu.train.checkpoint import has_checkpoint
+
+    selection = "member_best" if has_checkpoint(wd, "nat_sweep_member_best") else "last"
+    stacked, meta = restore_checkpoint(
+        wd, "nat_sweep_member_best" if selection == "member_best" else "nat_sweep_last"
+    )
     sigmas = meta["noise_levels"]
     # Architecture facts come from the checkpoint via the standard
     # reconciliation (no-op for pre-round-3 checkpoints without the meta).
@@ -66,7 +71,8 @@ def main() -> None:
     )
 
     out = {"p_grid": list(P_GRID), "sigmas": sigmas, "n_trajectories": N_TRAJ,
-           "test_n": TEST_N, "snr_db": cfg.data.snr_db, "curves": {}}
+           "test_n": TEST_N, "snr_db": cfg.data.snr_db,
+           "param_selection": selection, "curves": {}}
     for m, sigma in enumerate(sigmas):
         vars_ = {"params": jax.tree.map(lambda x: x[m], stacked["params"])}
         accs = []

@@ -156,3 +156,25 @@ def test_nat_sweep_scan_steps_match_history():
     np.testing.assert_allclose(
         np.asarray(h1["val_acc"]), np.asarray(h2["val_acc"]), rtol=1e-5
     )
+
+
+def test_member_best_checkpoint_tracks_per_member_max(tmp_path):
+    """ADVICE r3: the ensemble trainer keeps EVERY member's best-validation
+    params (nat_sweep_member_best), so ensemble studies can use the same
+    best-val selection rule as the single-model seed studies. The recorded
+    per-member best accs must equal the elementwise max of the per-epoch
+    val-acc history."""
+    from qdml_tpu.train.checkpoint import restore_checkpoint
+    from qdml_tpu.train.nat_sweep import train_nat_sweep
+
+    cfg = _cfg(n_epochs=3)
+    params, hist = train_nat_sweep(
+        cfg, noise_levels=(0.0, 0.3), workdir=str(tmp_path / "wd")
+    )
+    restored, meta = restore_checkpoint(str(tmp_path / "wd"), "nat_sweep_member_best")
+    va = np.stack(hist["val_acc"])  # (epochs, members)
+    np.testing.assert_allclose(meta["member_best_acc"], va.max(0), rtol=1e-6)
+    for m, ep in enumerate(meta["member_best_epoch"]):
+        assert va[ep, m] == va[:, m].max()
+    # stacked structure matches the training params
+    assert jax.tree_util.tree_structure(restored["params"]) == jax.tree_util.tree_structure(params)
