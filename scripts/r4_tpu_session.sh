@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-4 on-chip session: run the moment the tunnel is up, cheapest
+# evidence first (windows between outages can be short):
+#   1. full bench harness self-capture  -> results/bench_tpu_v5e_r4.json
+#   2. perf decompositions (VERDICT r4 asks #1/#2) -> results/perf_r4/
+#   3. the DCE control study (ask #3)   -> results/dce/ + runs/science
+# Each phase is independent; a dropped tunnel mid-way keeps earlier
+# artifacts. Training phases are resume-capable, so re-running this script
+# after an outage continues where it stopped.
+set -x
+cd /root/repo
+mkdir -p results/perf_r4 runs
+
+echo "=== phase 1: bench capture ==="
+# the harness emits the one-line record on stdout; keep the TPU record only
+python bench.py > /tmp/r4_bench_out.txt 2>/tmp/r4_bench_err.txt
+tail -1 /tmp/r4_bench_out.txt > /tmp/r4_bench_line.json
+python - <<'EOF'
+import json, shutil
+rec = json.load(open("/tmp/r4_bench_line.json"))
+if str(rec.get("platform", "")).startswith("tpu"):
+    with open("results/bench_tpu_v5e_r4.json", "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print("bench captured:", rec["value"], rec.get("mfu"))
+else:
+    print("bench did NOT run on TPU:", rec.get("platform"), rec.get("tpu_error"))
+EOF
+
+echo "=== phase 2: perf session ==="
+timeout 2400 python scripts/r4_perf_session.py results/perf_r4/r4_perf_session.json
+
+echo "=== phase 3: science3 (DCE control) ==="
+# stop any CPU-side insurance training still writing runs/science (two
+# writers on one orbax workdir corrupt checkpoints); [b]racket avoids
+# matching this script's own command line
+pkill -f "[w]orkdir=runs/science" 2>/dev/null
+sleep 3
+timeout 5400 bash run_science3.sh
+
+echo "R4 TPU SESSION DONE"
