@@ -100,10 +100,15 @@ def resolve_backend(backend: str, n_qubits: int) -> str:
     and the gate-wise tensor path wins; from ~14 qubits the statevector
     should be mesh-sharded instead (select "sharded" explicitly — it needs a
     multi-device mesh this helper cannot assume). Within the dense regime,
-    on a real TPU the whole-circuit Pallas kernel is the measured-fastest
-    path at the reference shapes (1.22x the XLA dense step on v5e,
-    ``results/bench_tpu_v5e_r3.json``) up to its n<=8 VMEM budget; on
-    non-TPU backends the kernel only has interpret mode, so XLA dense wins.
+    on a real TPU the whole-circuit Pallas kernel wins the FULL TRAIN STEP
+    in the controlled alternating A/B — 4/4 rounds, median 826k vs 647k
+    sps (``results/perf_r3/r3_qsc_ab.json``) — which is the evidence this
+    auto-choice rests on. Single wall captures at this dispatch-bound size
+    land on both sides, and the kernel's standalone forward measures
+    SLOWER at wall (``r3_quantum_microbench.json``); the device-time
+    decomposition that attributes the step win is the round-4 perf
+    session's job. On non-TPU backends the kernel only has interpret mode,
+    so XLA dense wins.
     """
     if backend != "auto":
         return backend
