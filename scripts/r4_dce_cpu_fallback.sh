@@ -1,0 +1,36 @@
+#!/bin/bash
+# REDUCED-protocol CPU fallback for the monolithic-DCE control study
+# (VERDICT r3 ask #3). The full reference protocol (100 epochs, 20k
+# samples/cell) is a minutes-scale job on the TPU but ~20 hours on this
+# 1-core host, so if the tunnel stays down for the whole round this
+# trains the control at 30 epochs x 4k samples/cell — enough to measure
+# the architectural ordering (hierarchical HDCE vs monolithic DCE vs
+# LS/MMSE) with every estimator under ONE consistent protocol, clearly
+# labelled as reduced. run_science3.sh (TPU, full protocol) writes the
+# same results/dce/ and supersedes this when it runs.
+set -e
+cd /root/repo
+WD=runs/science_cpu
+RED="--data.data_len=4000 --train.n_epochs=30"
+for cmd in train-hdce train-sc train-qsc train-dce; do
+  echo "=== $cmd (REDUCED protocol: 30 epochs, 4k/cell) ==="
+  python -m qdml_tpu.cli $cmd $RED --train.workdir=$WD --train.resume=true \
+      --train.scan_steps=16
+done
+python -m qdml_tpu.cli eval --data.data_len=4000 --train.workdir=$WD \
+    --eval.results_dir=results/dce
+cat > results/dce/PROTOCOL.md <<'EOF'
+# Protocol note
+
+These curves were produced by `scripts/r4_dce_cpu_fallback.sh` under a
+REDUCED training protocol — 30 epochs, 4,000 samples per (scenario, user)
+cell — on the CPU backend, because the TPU tunnel was down for the whole
+round-4 window (see BENCH_r04.json probe_attempts). The reference
+protocol is 100 epochs x 20,000 samples/cell (`Runner...py:20-38`);
+`run_science3.sh` trains exactly that on-chip in minutes and overwrites
+this directory when the tunnel allows. All four estimators here
+(LS / MMSE / monolithic DCE / hierarchical HDCE) share the one reduced
+protocol, so the architectural ORDERING is internally consistent even
+though absolute NMSE is a few dB short of the full-protocol curves.
+EOF
+echo "DCE CPU FALLBACK DONE"
