@@ -233,7 +233,10 @@ def scan_probe(rng_impl: str, keep_trace: str | None = None) -> dict:
         "wall_sps": wall["samples_per_sec"],
         "wall_mfu": round(wall["model_tflops"] * 1e12 / peak, 4),
         "device_busy_ms_per_step": round(busy_per_step, 3),
-        "device_busy_mfu": round(step_flops / (busy_per_step / 1e3) / peak, 4),
+        # busy == 0 when the trace has no device timeline (non-TPU smoke runs)
+        "device_busy_mfu": (
+            round(step_flops / (busy_per_step / 1e3) / peak, 4) if busy_per_step else None
+        ),
         "top_ops": prof["top_ops"],
     }
 
