@@ -27,4 +27,7 @@ for cmd in train-hdce train-sc train-qsc; do
 done
 python -m qdml_tpu.cli train-dce --train.workdir=$WD --train.resume=true --train.scan_steps=16
 python -m qdml_tpu.cli eval --train.workdir=$WD --eval.results_dir=results/dce
+# the per-SNR eval rows land in the (gitignored) run dir; copy them next to
+# the curves so the committed artifact set carries the JSONL evidence too
+cp $WD/Pn_128/*/eval.metrics.jsonl results/dce/ 2>/dev/null || true
 echo "SCIENCE PHASE 3 DONE"

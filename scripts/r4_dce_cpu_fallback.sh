@@ -19,6 +19,8 @@ for cmd in train-hdce train-sc train-qsc train-dce; do
 done
 python -m qdml_tpu.cli eval --data.data_len=4000 --train.workdir=$WD \
     --eval.results_dir=results/dce
+# commit-durable copy of the per-SNR eval rows (run dirs are gitignored)
+cp $WD/Pn_128/*/eval.metrics.jsonl results/dce/ 2>/dev/null || true
 cat > results/dce/PROTOCOL.md <<'EOF'
 # Protocol note
 
