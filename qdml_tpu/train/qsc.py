@@ -227,6 +227,12 @@ def train_classifier(
                     ),
                     "input_norm": cfg.quantum.input_norm,
                 }
+                # provenance, not architecture (reconcile ignores it): which
+                # noise-aware-training recipe produced these params
+                meta["training"] = {
+                    "use_quantumnat": cfg.quantum.use_quantumnat,
+                    "noise_level": cfg.quantum.noise_level,
+                }
             if val_acc > best_acc:
                 best_acc = val_acc
                 save_checkpoint(workdir, f"{tag}_best", {"params": state.params}, meta)
