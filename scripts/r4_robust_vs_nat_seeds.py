@@ -51,11 +51,18 @@ from r3_noise_robustness import (  # noqa: E402
     write_results,
 )
 
-# seed 0 = the original round-3 pair; 2/3 = the seed-study extensions
+# seed 0 = the original round-3 pair; 2/3 = the seed-study extensions.
+# robust_nat is the COMBINATION the compose claim implies (robust preset
+# + QuantumNAT sigma=0.05 — the sigma-ensemble's protected group) — round
+# 3 never actually trained it; all three of its seeds are round-4 runs.
 SEEDS = (0, 2, 3)
 MODELS = {
     "robust": {0: "runs/nr_robust/Pn_128/robust_qsc", "t": "runs/nr_robust_s{s}/Pn_128/robust_qsc"},
     "quantumnat": {0: "runs/nr_nat/Pn_128/default", "t": "runs/nr_nat_s{s}/Pn_128/default"},
+    "robust_nat": {
+        0: "runs/nr_robustnat_s0/Pn_128/robust_qsc",
+        "t": "runs/nr_robustnat_s{s}/Pn_128/robust_qsc",
+    },
 }
 
 
