@@ -12,10 +12,13 @@ set -e
 cd /root/repo
 WD=runs/science_cpu
 RED="--data.data_len=4000 --train.n_epochs=30"
+# NO scan_steps here: in-scan synthesis regenerates every batch on device
+# each step — the right trade on the TPU (it removes the dispatch gap,
+# docs/ROOFLINE.md) but pure overhead on CPU, where the loader path
+# generates the epoch data once and re-serves it (~4x faster end to end).
 for cmd in train-hdce train-sc train-qsc train-dce; do
   echo "=== $cmd (REDUCED protocol: 30 epochs, 4k/cell) ==="
-  python -m qdml_tpu.cli $cmd $RED --train.workdir=$WD --train.resume=true \
-      --train.scan_steps=16
+  python -m qdml_tpu.cli $cmd $RED --train.workdir=$WD --train.resume=true
 done
 python -m qdml_tpu.cli eval --data.data_len=4000 --train.workdir=$WD \
     --eval.results_dir=results/dce
