@@ -1,0 +1,23 @@
+#!/bin/bash
+# Seed spread for the DCE-vs-HDCE architectural gap (results/dce/).
+# Two more training seeds of the reduced-protocol control study (training
+# data drawn from independent generator streams via data.seed; evaluation
+# stays on the COMMON default-seed test stream, the same discipline as the
+# noise studies). The quantum classifier is not retrained — the gap under
+# measurement is DCE-vs-HDCE, and eval falls back gracefully without a
+# QSC checkpoint (Test.py:81-86 semantics).
+set -e
+cd /root/repo
+RED="--data.data_len=4000 --train.n_epochs=30"
+for s in 2 3; do
+  WD=runs/science_cpu_s$s
+  SEEDS="--train.seed=$s --data.seed=$((2026+s))"
+  for cmd in train-hdce train-sc train-dce; do
+    echo "=== seed $s $cmd ==="
+    python -m qdml_tpu.cli $cmd $RED $SEEDS --train.workdir=$WD --train.resume=true
+  done
+  python -m qdml_tpu.cli eval --data.data_len=4000 --train.workdir=$WD \
+      --eval.results_dir=results/dce/seed$s
+  cp $WD/Pn_128/*/eval.metrics.jsonl results/dce/seed$s/ 2>/dev/null || true
+done
+echo "DCE SEEDS DONE"
