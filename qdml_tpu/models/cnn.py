@@ -96,9 +96,15 @@ class SpatialConv(nn.Module):
         for dy in range(kh):
             for dx in range(kw):
                 xs = jax.lax.dynamic_slice(xp, (0, dy, dx, 0), xd.shape)
-                y = jnp.einsum("bhwc,cd->bhwd", xs, kd[dy, dx])
+                # accumulate the kh*kw window in f32 like lax.conv does, so
+                # the two lowerings agree in bfloat16 too (ADVICE r4): the
+                # cast back to the activation dtype happens once, at the end
+                y = jnp.einsum(
+                    "bhwc,cd->bhwd", xs, kd[dy, dx],
+                    preferred_element_type=jnp.float32,
+                )
                 out = y if out is None else out + y
-        return out
+        return out.astype(self.dtype)
 
 
 class ConvBlock(nn.Module):

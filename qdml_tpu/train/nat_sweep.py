@@ -222,6 +222,13 @@ def train_nat_sweep(
     member_best = jax.tree.map(jnp.copy, params)
     member_best_acc = np.full(n_members, -1.0)
     member_best_epoch = np.full(n_members, -1)
+    # The epoch best-val selection STARTS considering. Normally 0; resuming a
+    # workdir trained before member-best tracking existed (nat_sweep_resume
+    # present, nat_sweep_member_best absent) makes it start_epoch — the
+    # pre-resume epochs were never scored, so the meta must say the selection
+    # window excludes them instead of silently reporting post-resume maxima
+    # as all-run bests (ADVICE r4).
+    member_best_from_epoch = start_epoch
     # Only trust a member_best checkpoint when it belongs to the run being
     # resumed (start_epoch > 0 — i.e. nat_sweep_resume was restored, which
     # already validated noise_levels) AND its own levels match: a stale
@@ -242,6 +249,11 @@ def train_nat_sweep(
         member_best_epoch = np.asarray(
             mb_meta.get("member_best_epoch", member_best_epoch), int
         )
+        # a restored tracker inherits its own window; a checkpoint that
+        # predates window recording could itself have been started mid-run
+        # (legacy resume under the old code), so its window start is
+        # UNKNOWN — record -1 rather than claiming full coverage
+        member_best_from_epoch = int(mb_meta.get("member_best_from_epoch", -1))
 
     # Multi-device: replicate the stacked ensemble, shard batches over the
     # data axis (same placement policy as the other trainers).
@@ -337,6 +349,7 @@ def train_nat_sweep(
                     {
                         "member_best_acc": [float(a) for a in member_best_acc],
                         "member_best_epoch": [int(e) for e in member_best_epoch],
+                        "member_best_from_epoch": member_best_from_epoch,
                         "noise_levels": list(map(float, noise_levels)),
                         "name": cfg.name,
                         "quantum": quantum_meta,

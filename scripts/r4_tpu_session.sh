@@ -30,10 +30,11 @@ echo "=== phase 2: perf session ==="
 timeout 2400 python scripts/r4_perf_session.py results/perf_r4/r4_perf_session.json
 
 echo "=== phase 3: science3 (DCE control) ==="
-# stop any CPU-side insurance training still writing runs/science (two
-# writers on one orbax workdir corrupt checkpoints); [b]racket avoids
-# matching this script's own command line
-pkill -f "[w]orkdir=runs/science" 2>/dev/null
+# stop any CPU-side insurance training still writing the EXACT workdir
+# runs/science (two writers on one orbax workdir corrupt checkpoints);
+# anchored so runs/science_cpu* seed-study trainers are untouched (ADVICE
+# r4); [b]racket avoids matching this script's own command line
+pkill -f "[w]orkdir=runs/science( |$)" 2>/dev/null
 sleep 3
 timeout 5400 bash run_science3.sh
 

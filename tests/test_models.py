@@ -174,6 +174,24 @@ def test_conv_impls_agree():
     )
 
 
+def test_conv_impls_agree_bf16():
+    """The agreement holds in bfloat16 as well (ADVICE r4): the shift
+    lowering accumulates its kh*kw partials in f32 — same as lax.conv's
+    internal accumulator — so the bf16 disagreement is one output rounding
+    step, not a kh*kw-term error sum. Tolerance is bf16 ulp-scale."""
+    from qdml_tpu.models.cnn import SpatialConv
+
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(4, 16, 8, 8)), jnp.float32)
+    conv = SpatialConv(8, (3, 3), dtype=jnp.bfloat16, impl="conv")
+    shift = SpatialConv(8, (3, 3), dtype=jnp.bfloat16, impl="shift_matmul")
+    v = conv.init(jax.random.PRNGKey(1), x)
+    oc, os_ = conv.apply(v, x), shift.apply(v, x)
+    assert oc.dtype == os_.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(oc, np.float32), np.asarray(os_, np.float32), atol=0.06, rtol=0.03
+    )
+
+
 def test_stacked_trunk_conv_impl_override():
     """conv_impl threads through the vmapped trunk; both lowerings produce
     the same stacked features from the same params."""

@@ -137,6 +137,25 @@ def test_train_nat_sweep_resume(tmp_path):
     for la, lb in zip(jax.tree.leaves(res_params), jax.tree.leaves(full_params)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5, atol=1e-6)
 
+    # ADVICE r4 — legacy-workdir window: resume once more with the member-best
+    # tracker deleted (a workdir trained before tracking existed). The tracker
+    # restarts and its meta must record that the selection window starts at
+    # the resume epoch, not 0 — post-resume maxima are not all-run bests.
+    import shutil
+
+    from qdml_tpu.train.checkpoint import restore_checkpoint
+
+    shutil.rmtree(tmp_path / "part" / "nat_sweep_member_best")
+    mb_meta = tmp_path / "part" / "nat_sweep_member_best.meta.json"
+    if mb_meta.exists():
+        mb_meta.unlink()
+    cfg3 = _cfg(n_epochs=3)
+    cfg3 = dataclasses.replace(cfg3, train=dataclasses.replace(cfg3.train, resume=True))
+    train_nat_sweep(cfg3, noise_levels=(0.0, 0.05), workdir=part_dir)
+    _, meta = restore_checkpoint(part_dir, "nat_sweep_member_best")
+    assert meta["member_best_from_epoch"] == 2  # epochs 0-1 were never scored
+    assert list(meta["member_best_epoch"]) == [2, 2]
+
 
 def test_nat_sweep_scan_steps_match_history():
     """train_nat_sweep with scan_steps>1 reproduces the per-step history
@@ -178,3 +197,7 @@ def test_member_best_checkpoint_tracks_per_member_max(tmp_path):
         assert va[ep, m] == va[:, m].max()
     # stacked structure matches the training params
     assert jax.tree_util.tree_structure(restored["params"]) == jax.tree_util.tree_structure(params)
+    # an uninterrupted run's selection window covers every epoch
+    assert meta["member_best_from_epoch"] == 0
+
+
