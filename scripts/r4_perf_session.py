@@ -52,7 +52,9 @@ import numpy as np
 
 import bench
 
-OUT_DIR = "results/perf_r4"
+# Round-4 asks, re-armed for round 5: QDML_PERF_OUT_DIR redirects the whole
+# artifact set (traces + json) without touching the probe code.
+OUT_DIR = os.environ.get("QDML_PERF_OUT_DIR", "results/perf_r4")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Full-protocol seed-2 replicate of the DCE-vs-HDCE control (VERDICT r4
+# ask #5): the reduced-protocol ordering (results/dce/seed2/, 30 ep x
+# 4k/cell) replicated at the exact reference protocol (100 ep x 20k/cell,
+# Runner...py:20-38) so the README's hierarchy-gain claim can graduate to a
+# measured full-protocol number with spread. Training data draws from an
+# independent generator stream (data.seed), evaluation stays on the COMMON
+# default test stream — the repo's standing seed discipline. The quantum
+# classifier is not retrained (the gap under measurement is DCE-vs-HDCE;
+# eval degrades gracefully without a QSC checkpoint, Test.py:81-86
+# semantics). On-chip only: pass scan_steps=16 (a ~4x CPU loss otherwise).
+set -e
+cd /root/repo
+WD=runs/science_s2
+SEEDS="--train.seed=2 --data.seed=2028"
+for cmd in train-hdce train-sc train-dce; do
+  echo "=== seed2 full $cmd ==="
+  python -m qdml_tpu.cli $cmd $SEEDS --train.workdir=$WD --train.resume=true \
+      --train.scan_steps=16
+done
+python -m qdml_tpu.cli eval --train.workdir=$WD --eval.results_dir=results/dce/seed2
+cp $WD/Pn_128/*/eval.metrics.jsonl results/dce/seed2/ 2>/dev/null || true
+echo "protocol: full reference (100 ep x 20k/cell), on-chip, $(date -u +%F)" \
+    > results/dce/seed2/PROTOCOL_STAMP.txt
+echo "DCE SEED2 FULL DONE"

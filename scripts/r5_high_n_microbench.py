@@ -1,0 +1,128 @@
+"""Single-chip high-n circuit backend microbench (VERDICT r4 -> r5 ask #3).
+
+Run on the real TPU when the tunnel is up:
+    python scripts/r5_high_n_microbench.py [out.json]
+
+BASELINE config 3 (16-qubit sharded statevector) has a correctness story
+(n=14/16 equivalence tests, the driver dryrun's sharded QSC step) but no
+single-chip performance story. ``resolve_backend``
+(qdml_tpu/quantum/circuits.py) switches from the dense per-ansatz unitary to
+the gate-wise tensor path above n=10 on a complexity argument
+(2^n x 2^n unitary build vs O(n * 2^n) gate application) that has never been
+measured, and the per-layer fused Pallas rotation kernel
+(``pallas_tensor``, quantum/pallas_kernels.py:365) — whose entire reason to
+exist is this regime — is only correctness-tested (tests/test_pallas.py).
+
+This session measures, at n = 8 / 10 / 12 / 14 with a fixed ~2M-amplitude
+batch budget (B * 2^n = 2^21, so each point moves the same state memory):
+
+  - forward and forward+backward WALL time per call, dense vs tensor vs
+    pallas_tensor (dense capped at n <= 12: its unitary build is 2.1 GB of
+    intermediates at n=14);
+  - device-busy ms per call from the profiler timeline (the tunnelled
+    backend adds ~1.5 ms/dispatch host gap that wall time can't separate);
+  - amps/sec throughput so the points are comparable across n.
+
+Output: the crossover table that either justifies or corrects
+``resolve_backend``'s n>10 policy, committed as
+results/perf_r5/high_n_microbench.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from qdml_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r4_perf_session import device_busy_profile  # shared trace extraction
+
+L = 3  # reference ansatz depth (Estimators_QuantumNAT_onchipQNN.py:128-138)
+AMP_BUDGET = 1 << 21  # B * 2^n held constant across n
+
+
+def wall_us(fn, *args, reps: int = 30) -> float:
+    out = fn(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    return round((time.perf_counter() - t0) / reps * 1e6, 1)
+
+
+def probe(n: int, backend: str) -> dict:
+    from qdml_tpu.quantum.circuits import run_circuit
+
+    b = max(64, AMP_BUDGET >> n)
+    rng = np.random.default_rng(0)
+    angles = jnp.asarray(rng.uniform(-1, 1, (b, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(-3, 3, (L, n, 2)).astype(np.float32))
+
+    fwd = jax.jit(lambda a, ww: run_circuit(a, ww, n, L, backend))
+    bwd = jax.jit(
+        jax.grad(lambda a, ww: jnp.sum(run_circuit(a, ww, n, L, backend) ** 2), (0, 1))
+    )
+    res = {"n": n, "backend": backend, "batch": b}
+    res["fwd_wall_us"] = wall_us(fwd, angles, w)
+    res["fwdbwd_wall_us"] = wall_us(bwd, angles, w)
+    res["fwd_device"] = device_busy_profile(
+        lambda: float(jnp.sum(fwd(angles, w))), reps=20
+    )
+    res["fwdbwd_device"] = device_busy_profile(
+        lambda: float(jnp.sum(bwd(angles, w)[0])), reps=20
+    )
+    # throughput normalized across n: amplitudes touched per second (fwd)
+    res["fwd_amps_per_s"] = round(b * (1 << n) / (res["fwd_wall_us"] / 1e6), 1)
+    # trim the op lists: only the top-3 matter for the crossover story
+    for k in ("fwd_device", "fwdbwd_device"):
+        res[k]["top_ops"] = res[k]["top_ops"][:3]
+    return res
+
+
+def main() -> None:
+    out_path = (
+        sys.argv[1] if len(sys.argv) > 1 else "results/perf_r5/high_n_microbench.json"
+    )
+    out: dict = {"backend": jax.default_backend(), "layers": L, "points": []}
+    if out["backend"] != "tpu":
+        print("WARNING: not on TPU — numbers will not be committed evidence", flush=True)
+    for n in (8, 10, 12, 14):
+        for backend in ("dense", "tensor", "pallas_tensor"):
+            if backend == "dense" and n > 12:
+                continue  # 2^14 x 2^14 unitary build: ~2.1 GB intermediates
+            try:
+                p = probe(n, backend)
+            except Exception as e:  # noqa: BLE001
+                p = {"n": n, "backend": backend, "error": f"{type(e).__name__}: {e}"}
+            print(json.dumps(p)[:300], flush=True)
+            out["points"].append(p)
+            os.makedirs(os.path.dirname(out_path), exist_ok=True)
+            with open(out_path, "w") as fh:
+                json.dump(out, fh, indent=1)
+    # crossover summary: fastest backend per n (fwd+bwd wall — the train path)
+    best: dict = {}
+    for p in out["points"]:
+        if "fwdbwd_wall_us" in p:
+            cur = best.get(p["n"])
+            if cur is None or p["fwdbwd_wall_us"] < cur[1]:
+                best[p["n"]] = (p["backend"], p["fwdbwd_wall_us"])
+    out["fastest_fwdbwd_by_n"] = {str(k): v[0] for k, v in sorted(best.items())}
+    with open(out_path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out["fastest_fwdbwd_by_n"]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

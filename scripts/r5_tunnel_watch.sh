@@ -1,0 +1,48 @@
+#!/bin/bash
+# Tunnel watcher (round 5): probe the axon backend every ~2.5 min with a
+# hard timeout (a DOWN tunnel hangs at backend init; outages last hours).
+# When a probe computes on a real TPU, fire the r5 session. If the tunnel
+# dropped mid-session (artifacts incomplete), go back to probing and
+# re-fire — every session phase is resume-capable / idempotent — up to
+# MAX_FIRES times. Logs to /tmp/r5_watch.log; sessions to
+# /tmp/r5_session_N.log.
+cd /root/repo
+LOG=/tmp/r5_watch.log
+START_MARK=/tmp/r5_watch_start
+touch "$START_MARK"
+PROBE='import jax, jax.numpy as jnp; assert jax.default_backend()=="tpu", jax.default_backend(); print("probe-ok", int(jnp.ones((8,8)).sum()))'
+MAX_FIRES=5
+fires=0
+
+complete() {
+  # all five phase artifacts present and fresher than watcher start
+  [ -f results/bench_tpu_v5e_r5.json ] || return 1
+  grep -q qsc_step_ab results/perf_r5/r5_perf_session.json 2>/dev/null || return 1
+  grep -q fastest_fwdbwd_by_n results/perf_r5/high_n_microbench.json 2>/dev/null || return 1
+  [ results/dce/results_table.md -nt "$START_MARK" ] || return 1
+  [ results/dce/seed2/results_table.md -nt "$START_MARK" ] || return 1
+  return 0
+}
+
+echo "$(date -u +%F' '%T) watcher start" >> "$LOG"
+while true; do
+  if timeout 90 env JAX_PLATFORMS=axon python -c "$PROBE" >> "$LOG" 2>&1; then
+    fires=$((fires + 1))
+    echo "$(date -u +%F' '%T) tunnel UP — firing r5 session (#$fires)" >> "$LOG"
+    bash scripts/r5_tpu_session.sh > "/tmp/r5_session_$fires.log" 2>&1
+    rc=$?
+    echo "$(date -u +%F' '%T) session #$fires done rc=$rc" >> "$LOG"
+    if complete; then
+      echo "$(date -u +%F' '%T) all artifacts complete — watcher exiting" >> "$LOG"
+      exit 0
+    fi
+    if [ "$fires" -ge "$MAX_FIRES" ]; then
+      echo "$(date -u +%F' '%T) max fires reached with incomplete artifacts" >> "$LOG"
+      exit 1
+    fi
+    echo "$(date -u +%F' '%T) artifacts incomplete — resuming watch" >> "$LOG"
+  else
+    echo "$(date -u +%F' '%T) tunnel down" >> "$LOG"
+  fi
+  sleep 150
+done
