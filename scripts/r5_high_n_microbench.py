@@ -51,6 +51,20 @@ from r4_perf_session import device_busy_profile  # shared trace extraction
 L = 3  # reference ansatz depth (Estimators_QuantumNAT_onchipQNN.py:128-138)
 AMP_BUDGET = 1 << 21  # B * 2^n held constant across n
 
+# Smoke overrides so the script's plumbing can be exercised on CPU before
+# the one real tunnel window (a crash on-chip wastes the window):
+# QDML_HIGHN_NS="8,10" shrinks the n sweep, QDML_HIGHN_AMPS shrinks the
+# amplitude budget, QDML_HIGHN_REPS the measurement reps. UNSET, the real
+# protocol is unchanged: wall reps 30, device-profile reps 20.
+NS = tuple(
+    int(x) for x in os.environ.get("QDML_HIGHN_NS", "8,10,12,14").split(",")
+)
+AMP_BUDGET = int(os.environ.get("QDML_HIGHN_AMPS", AMP_BUDGET))
+_reps_env = os.environ.get("QDML_HIGHN_REPS")
+WALL_REPS = int(_reps_env) if _reps_env else 30
+DEV_REPS = max(4, int(_reps_env) // 2) if _reps_env else 20
+SMOKE = any(os.environ.get(k) for k in ("QDML_HIGHN_NS", "QDML_HIGHN_AMPS", "QDML_HIGHN_REPS"))
+
 
 def wall_us(fn, *args, reps: int = 30) -> float:
     out = fn(*args)
@@ -65,7 +79,7 @@ def wall_us(fn, *args, reps: int = 30) -> float:
 def probe(n: int, backend: str) -> dict:
     from qdml_tpu.quantum.circuits import run_circuit
 
-    b = max(64, AMP_BUDGET >> n)
+    b = max(8, AMP_BUDGET >> n)  # floor rarely binds at the real budget
     rng = np.random.default_rng(0)
     angles = jnp.asarray(rng.uniform(-1, 1, (b, n)).astype(np.float32))
     w = jnp.asarray(rng.uniform(-3, 3, (L, n, 2)).astype(np.float32))
@@ -75,13 +89,13 @@ def probe(n: int, backend: str) -> dict:
         jax.grad(lambda a, ww: jnp.sum(run_circuit(a, ww, n, L, backend) ** 2), (0, 1))
     )
     res = {"n": n, "backend": backend, "batch": b}
-    res["fwd_wall_us"] = wall_us(fwd, angles, w)
-    res["fwdbwd_wall_us"] = wall_us(bwd, angles, w)
+    res["fwd_wall_us"] = wall_us(fwd, angles, w, reps=WALL_REPS)
+    res["fwdbwd_wall_us"] = wall_us(bwd, angles, w, reps=WALL_REPS)
     res["fwd_device"] = device_busy_profile(
-        lambda: float(jnp.sum(fwd(angles, w))), reps=20
+        lambda: float(jnp.sum(fwd(angles, w))), reps=DEV_REPS
     )
     res["fwdbwd_device"] = device_busy_profile(
-        lambda: float(jnp.sum(bwd(angles, w)[0])), reps=20
+        lambda: float(jnp.sum(bwd(angles, w)[0])), reps=DEV_REPS
     )
     # throughput normalized across n: amplitudes touched per second (fwd)
     res["fwd_amps_per_s"] = round(b * (1 << n) / (res["fwd_wall_us"] / 1e6), 1)
@@ -96,9 +110,16 @@ def main() -> None:
         sys.argv[1] if len(sys.argv) > 1 else "results/perf_r5/high_n_microbench.json"
     )
     out: dict = {"backend": jax.default_backend(), "layers": L, "points": []}
-    if out["backend"] != "tpu":
-        print("WARNING: not on TPU — numbers will not be committed evidence", flush=True)
-    for n in (8, 10, 12, 14):
+    if out["backend"] != "tpu" or SMOKE:
+        # never let a smoke / off-chip run overwrite the committed-evidence
+        # path with CPU timings and a wrong crossover verdict
+        if out_path == "results/perf_r5/high_n_microbench.json":
+            out_path = "/tmp/high_n_microbench_smoke.json"
+        print(
+            f"WARNING: smoke/off-TPU run — writing to {out_path}, not committed evidence",
+            flush=True,
+        )
+    for n in NS:
         for backend in ("dense", "tensor", "pallas_tensor"):
             if backend == "dense" and n > 12:
                 continue  # 2^14 x 2^14 unitary build: ~2.1 GB intermediates
@@ -108,7 +129,8 @@ def main() -> None:
                 p = {"n": n, "backend": backend, "error": f"{type(e).__name__}: {e}"}
             print(json.dumps(p)[:300], flush=True)
             out["points"].append(p)
-            os.makedirs(os.path.dirname(out_path), exist_ok=True)
+            if os.path.dirname(out_path):
+                os.makedirs(os.path.dirname(out_path), exist_ok=True)
             with open(out_path, "w") as fh:
                 json.dump(out, fh, indent=1)
     # crossover summary: fastest backend per n (fwd+bwd wall — the train path)
