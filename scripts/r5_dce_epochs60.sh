@@ -1,0 +1,38 @@
+#!/bin/bash
+# Reduced-DATA protocol, doubled training: extend the round-4 CPU control
+# study (30 epochs x 4k/cell, runs/science_cpu) to 60 epochs by resume.
+#
+# Question under test: results/dce/PROTOCOL.md attributes the learned
+# estimators' below-MMSE tail at 13-15 dB SNR to the REDUCED protocol
+# ("the reduced training leaves them below MMSE") — an assertion, not a
+# measurement. Doubling epochs at the same 4k/cell data separates the two
+# reduction axes: if the 13-15 dB tail closes toward MMSE at 60 epochs,
+# the shortfall was training length; if it persists, it is data volume.
+# Also re-measures the HDCE-vs-DCE hierarchy gain at 60 epochs (does the
+# architectural ordering survive longer training?).
+#
+# Writes results/dce/epochs60/ — results/dce/ itself stays the 30-epoch
+# protocol the committed PROTOCOL.md describes (reduced30ep/ holds the
+# backup). Resume-capable; safe to re-run. The quantum classifier is not
+# extended (the gap under measurement is DCE-vs-HDCE; eval degrades
+# gracefully, Test.py:81-86 semantics).
+set -e
+cd /root/repo
+WD=runs/science_cpu
+RED="--data.data_len=4000 --train.n_epochs=60"
+for cmd in train-hdce train-sc train-dce; do
+  echo "=== $cmd (REDUCED data, 60 epochs, resume from 30) ==="
+  python -m qdml_tpu.cli $cmd $RED --train.workdir=$WD --train.resume=true
+done
+python -m qdml_tpu.cli eval --data.data_len=4000 --train.workdir=$WD \
+    --eval.results_dir=results/dce/epochs60
+cp $WD/Pn_128/*/eval.metrics.jsonl results/dce/epochs60/ 2>/dev/null || true
+cat > results/dce/epochs60/PROTOCOL.md <<'EOF'
+# Protocol: 4k samples/cell (reduced), 60 epochs (2x the reduced runs)
+
+Same training data volume as `results/dce/` (the 30-epoch reduced-protocol
+study, preserved in `../reduced30ep/`), twice the epochs, trained by
+resuming the same checkpoints (`scripts/r5_dce_epochs60.sh`). Separates
+the two axes of the round-4 protocol reduction: epochs vs data volume.
+EOF
+echo "DCE EPOCHS60 DONE"

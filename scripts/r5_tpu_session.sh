@@ -13,6 +13,14 @@ set -x
 cd /root/repo
 mkdir -p results/perf_r5 runs
 
+# Stop ALL CPU insurance trainers for the perf phases: on the 1-core host
+# they contend with the session's host-side dispatch and would contaminate
+# wall measurements (the r4 bench window's 2x contention, BENCH_r04 weak
+# #1). Every trainer is resume-capable, so this loses nothing; [q]bracket
+# avoids self-match.
+pkill -f "[q]dml_tpu.cli train" 2>/dev/null
+sleep 3
+
 echo "=== phase 1: bench capture ==="
 # the harness emits the one-line record on stdout; keep the TPU record only
 timeout 2000 python bench.py > /tmp/r5_bench_out.txt 2>/tmp/r5_bench_err.txt
