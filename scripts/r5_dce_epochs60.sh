@@ -18,21 +18,36 @@
 # gracefully, Test.py:81-86 semantics).
 set -e
 cd /root/repo
-WD=runs/science_cpu
+# Optional seed arg: `r5_dce_epochs60.sh 2` extends the seed-2 study
+# (runs/science_cpu_s2, the r4_dce_seeds.sh seed convention) so the
+# gain-widening finding can meet the repo's 3-seed README standard.
+S=${1:-}
+if [ -n "$S" ]; then
+  WD=runs/science_cpu_s$S
+  SEEDS="--train.seed=$S --data.seed=$((2026 + S))"
+  OUT=results/dce/epochs60/seed$S
+else
+  WD=runs/science_cpu
+  SEEDS=""
+  OUT=results/dce/epochs60
+fi
 RED="--data.data_len=4000 --train.n_epochs=60"
 for cmd in train-hdce train-sc train-dce; do
-  echo "=== $cmd (REDUCED data, 60 epochs, resume from 30) ==="
-  python -m qdml_tpu.cli $cmd $RED --train.workdir=$WD --train.resume=true
+  echo "=== $cmd (REDUCED data, 60 epochs, resume from 30, seed=${S:-default}) ==="
+  python -m qdml_tpu.cli $cmd $RED $SEEDS --train.workdir=$WD --train.resume=true
 done
 python -m qdml_tpu.cli eval --data.data_len=4000 --train.workdir=$WD \
-    --eval.results_dir=results/dce/epochs60
-cp $WD/Pn_128/*/eval.metrics.jsonl results/dce/epochs60/ 2>/dev/null || true
-cat > results/dce/epochs60/PROTOCOL.md <<'EOF'
+    --eval.results_dir=$OUT
+cp $WD/Pn_128/*/eval.metrics.jsonl $OUT/ 2>/dev/null || true
+# never clobber an existing PROTOCOL.md — findings get appended to it
+if [ ! -f $OUT/PROTOCOL.md ]; then
+  cat > $OUT/PROTOCOL.md <<'EOF'
 # Protocol: 4k samples/cell (reduced), 60 epochs (2x the reduced runs)
 
-Same training data volume as `results/dce/` (the 30-epoch reduced-protocol
-study, preserved in `../reduced30ep/`), twice the epochs, trained by
-resuming the same checkpoints (`scripts/r5_dce_epochs60.sh`). Separates
-the two axes of the round-4 protocol reduction: epochs vs data volume.
+Same training data volume as the 30-epoch reduced-protocol study, twice
+the epochs, trained by resuming the same checkpoints
+(`scripts/r5_dce_epochs60.sh`). Separates the two axes of the round-4
+protocol reduction: epochs vs data volume.
 EOF
-echo "DCE EPOCHS60 DONE"
+fi
+echo "DCE EPOCHS60 DONE (seed=${S:-default})"
