@@ -197,7 +197,13 @@ def _bench_hdce(
 
 
 def _bench_hdce_scan(
-    dtype: str, k: int, max_steps: int, budget_s: float, rng_impl: str = "threefry"
+    dtype: str,
+    k: int,
+    max_steps: int,
+    budget_s: float,
+    rng_impl: str = "threefry",
+    trig_impl: str = "direct",
+    moments_dtype: str = "float32",
 ) -> dict:
     """The scan-fused training path (qdml_tpu.train.hdce.make_hdce_scan_steps):
     K steps per device dispatch, batches synthesized on-device inside the
@@ -215,9 +221,11 @@ def _bench_hdce_scan(
     from qdml_tpu.train.hdce import init_hdce_state, make_hdce_scan_steps
 
     cfg = ExperimentConfig(
-        data=DataConfig(rng_impl=rng_impl),
+        data=DataConfig(rng_impl=rng_impl, trig_impl=trig_impl),
         model=ModelConfig(dtype=dtype),
-        train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
+        train=TrainConfig(
+            batch_size=_CELL_BS, n_epochs=1, moments_dtype=moments_dtype
+        ),
     )
     geom = ChannelGeometry.from_config(cfg.data)
     s, u = _GRID
@@ -243,6 +251,10 @@ def _bench_hdce_scan(
     }
     if rng_impl != "threefry":
         out["rng_impl"] = rng_impl
+    if trig_impl != "direct":
+        out["trig_impl"] = trig_impl
+    if moments_dtype != "float32":
+        out["moments_dtype"] = moments_dtype
     return out
 
 
@@ -315,6 +327,43 @@ def run_child(platform: str) -> int:
                 "hdce_bf16_scan_rbg",
                 lambda: _bench_hdce_scan(
                     "bfloat16", scan_k, max_steps, budget, rng_impl="rbg"
+                ),
+            )
+        )
+        # The generator-tail levers, stacked (r5 trace decomposition,
+        # results/perf_r5/scan_rbg.trace.json.gz): hardware-RBG bits +
+        # angle-split phase ramps — both algorithm-equivalent (same
+        # distribution / same values to f32 rounding). Recorded next to the
+        # default-stream scan; headline promotion is gated on the committed
+        # alternating A/B (scripts/r5_scan_ab.py).
+        benches.append(
+            (
+                "hdce_bf16_scan_fast",
+                lambda: _bench_hdce_scan(
+                    "bfloat16",
+                    scan_k,
+                    max_steps,
+                    budget,
+                    rng_impl="rbg",
+                    trig_impl="split",
+                ),
+            )
+        )
+        # + bfloat16 Adam moments: halved optimizer-state HBM traffic on the
+        # bandwidth-bound fused update. A documented OPTIMIZER deviation
+        # (torch Adam carries f32 moments), so it never headlines; recorded
+        # to quantify what the knob buys on real training runs.
+        benches.append(
+            (
+                "hdce_bf16_scan_fast_bf16m",
+                lambda: _bench_hdce_scan(
+                    "bfloat16",
+                    scan_k,
+                    max_steps,
+                    budget,
+                    rng_impl="rbg",
+                    trig_impl="split",
+                    moments_dtype="bfloat16",
                 ),
             )
         )
@@ -690,7 +739,13 @@ def main() -> int:
     # change backed by a committed alternating A/B, not a per-run max of
     # two noisy single measurements.)
     order = (
-        ("hdce_bf16_scan", "hdce_bf16_scan_rbg", "hdce_bf16", "hdce_f32")
+        (
+            "hdce_bf16_scan",
+            "hdce_bf16_scan_rbg",
+            "hdce_bf16_scan_fast",
+            "hdce_bf16",
+            "hdce_f32",
+        )
         if on_tpu
         else ("hdce_f32", "hdce_bf16")
     )
@@ -717,6 +772,7 @@ def main() -> int:
         "hdce_bf16": "bfloat16",
         "hdce_bf16_scan": "bfloat16",
         "hdce_bf16_scan_rbg": "bfloat16",
+        "hdce_bf16_scan_fast": "bfloat16",
         "hdce_f32": "float32",
     }[key]
     headline = details[key]
@@ -728,6 +784,8 @@ def main() -> int:
     )
     if key == "hdce_bf16_scan_rbg":
         scan_note += ", hardware-RBG generator"
+    elif key == "hdce_bf16_scan_fast":
+        scan_note += ", hardware-RBG generator, angle-split trig"
     committed_tpu = None if platform != "cpu_fallback" else _latest_committed_tpu_record()
 
     record = {

@@ -56,6 +56,11 @@ class DataConfig:
     # stream is not). Key derivation (fold_in/split) stays threefry-based
     # either way, so per-sample determinism-within-a-platform holds.
     rng_impl: str = "threefry"
+    # Steering/delay phase-ramp evaluation: "direct" (default, bit-compatible
+    # with all committed streams) or "split" (angle-addition factorization —
+    # ~4x fewer sin/cos, the generator-tail hot spot on TPU; identical values
+    # to f32 rounding, see complexops.cexp_i_ramp).
+    trig_impl: str = "direct"
 
     @property
     def pilot_num(self) -> int:
@@ -143,6 +148,13 @@ class TrainConfig:
     # device-busy figure. Used by the on-device-generation training path;
     # ignored (with a warning) under multi-host sliced loaders.
     scan_steps: int = 1
+    # Adam moment (m, v) storage dtype: "float32" (default, the reference's
+    # torch.optim.Adam semantics) or "bfloat16" (halves the optimizer-state
+    # HBM traffic; the fused head-weight grad+update is bandwidth-bound at
+    # ~730 GB/s on v5e — results/perf_r5/scan_rbg.trace.json.gz,
+    # multiply_add_fusion.53). Accumulation still happens in f32; only the
+    # stored moments are rounded. A documented deviation, never the default.
+    moments_dtype: str = "float32"
     seed: int = 0
     workdir: str = "workspace"   # checkpoint root (reference ./workspace/Pn_128/HDCE)
     resume: bool = False         # reference cannot resume; we can

@@ -2,6 +2,7 @@ from qdml_tpu.utils.complexops import (  # noqa: F401
     CArr,
     ceinsum,
     cexp_i,
+    cexp_i_ramp,
     cmatmul,
     complex_to_real_pair,
     cconcat,

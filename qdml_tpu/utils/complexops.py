@@ -177,6 +177,38 @@ def cexp_i(theta: jnp.ndarray) -> CArr:
     return CArr(jnp.cos(theta), jnp.sin(theta))
 
 
+def cexp_i_ramp(theta: jnp.ndarray, n: int, split: int | None = None) -> CArr:
+    """``exp(i * theta[..., None] * arange(n))`` with ~2*sqrt(n) instead of n
+    transcendental pairs per theta element.
+
+    Factoring the ramp index ``k = a + split*b`` and applying the angle-
+    addition identity ``e^{i theta (a + split b)} = e^{i theta a} e^{i theta
+    split b}`` needs ``split + ceil(n/split)`` sin/cos pairs plus one complex
+    outer product. sin/cos throughput is the VPU bottleneck of the channel
+    generator's steering/delay phase ramps (the two trig fusions are 325
+    us/step of the scan-fused HDCE step on v5e, ~40% of the generator tail —
+    results/perf_r5/scan_rbg.trace.json.gz), so quartering the transcendental
+    count is the lever; the outer product it adds is cheap elementwise work.
+    Exact to f32 rounding — no recurrence error accumulation.
+    """
+    if split is None:
+        split = max(1, int(round(n**0.5)))
+        while n % split:  # prefer a divisor of n: no tail slice needed
+            split -= 1
+    n_hi = -(-n // split)
+    a = jnp.arange(split, dtype=theta.dtype)
+    b = jnp.arange(n_hi, dtype=theta.dtype) * split
+    lo = cexp_i(theta[..., None] * a)  # (..., split)
+    hi = cexp_i(theta[..., None] * b)  # (..., n_hi)
+    out = CArr(
+        hi.re[..., :, None] * lo.re[..., None, :]
+        - hi.im[..., :, None] * lo.im[..., None, :],
+        hi.re[..., :, None] * lo.im[..., None, :]
+        + hi.im[..., :, None] * lo.re[..., None, :],
+    ).reshape(tuple(theta.shape) + (n_hi * split,))
+    return out[..., :n] if n_hi * split != n else out
+
+
 def cstack(arrs: list[CArr], axis: int = 0) -> CArr:
     return CArr(
         jnp.stack([a.re for a in arrs], axis), jnp.stack([a.im for a in arrs], axis)

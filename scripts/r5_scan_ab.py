@@ -1,0 +1,75 @@
+"""Alternating A/B: default scan path vs the generator-tail levers (round 5).
+
+The bench headline is the fixed default-stream scan measurement
+(``hdce_bf16_scan``); promoting a faster variant requires a committed
+alternating A/B, not a per-run max of noisy single captures (bench.py
+headline-policy comment). This session measures, interleaved per round so
+tunnel-window drift cancels:
+
+  A. ``default``  — threefry bits, direct trig          (current headline)
+  B. ``fast``     — hardware-RBG bits, angle-split trig (algorithm-equivalent)
+  C. ``fast_b16m``— B + bfloat16 Adam moments           (documented deviation)
+
+Usage:  python scripts/r5_scan_ab.py [out.json] [rounds]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+import jax
+
+import bench
+
+VARIANTS = {
+    "default": dict(rng_impl="threefry", trig_impl="direct"),
+    "fast": dict(rng_impl="rbg", trig_impl="split"),
+    "fast_b16m": dict(rng_impl="rbg", trig_impl="split", moments_dtype="bfloat16"),
+}
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "results/perf_r5/scan_ab.json"
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    print("backend:", jax.default_backend(), flush=True)
+    results: dict = {"backend": jax.default_backend(), "rounds": {k: [] for k in VARIANTS}}
+    for r in range(rounds):
+        for name, kw in VARIANTS.items():
+            try:
+                sps = bench._bench_hdce_scan("bfloat16", 16, 40, 30.0, **kw)[
+                    "samples_per_sec"
+                ]
+            except Exception as e:  # noqa: BLE001
+                sps = None
+                results.setdefault("errors", []).append(f"{name}@{r}: {e}")
+            results["rounds"][name].append(sps)
+        print(
+            f"[scan_ab] round {r}: "
+            + " vs ".join(f"{k}={results['rounds'][k][-1]}" for k in VARIANTS),
+            flush=True,
+        )
+    for name in VARIANTS:
+        vals = [v for v in results["rounds"][name] if v is not None]
+        if vals:
+            results[f"{name}_med"] = round(statistics.median(vals), 1)
+    pairs = zip(results["rounds"]["default"], results["rounds"]["fast"])
+    results["fast_wins"] = sum(
+        1 for d, f in pairs if d is not None and f is not None and f > d
+    )
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump(results, fh, indent=1)
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

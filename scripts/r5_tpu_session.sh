@@ -60,6 +60,14 @@ else
       python scripts/r4_perf_session.py results/perf_r5/r5_perf_session.json
 fi
 
+echo "=== phase 2.5: scan-variant A/B (headline-promotion evidence) ==="
+if grep -q '"fast_wins"' results/perf_r5/scan_ab.json 2>/dev/null; then
+  echo "phase 2.5 already complete — skipping"
+else
+  probe_or_exit
+  timeout 1200 python scripts/r5_scan_ab.py results/perf_r5/scan_ab.json 5
+fi
+
 echo "=== phase 3: high-n microbench ==="
 if grep -q fastest_fwdbwd_by_n results/perf_r5/high_n_microbench.json 2>/dev/null; then
   echo "phase 3 already complete — skipping"

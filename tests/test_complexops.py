@@ -57,6 +57,21 @@ def test_cexp_i():
     )
 
 
+@pytest.mark.parametrize("n", [64, 16, 13, 1])
+def test_cexp_i_ramp_matches_direct(rng, n):
+    """Angle-split ramp == direct per-element sin/cos to f32 rounding, at the
+    generator's steering (n=64), delay (n=16), a non-divisible n, and n=1."""
+    from qdml_tpu.utils import cexp_i_ramp
+
+    theta = rng.uniform(-4.0, 4.0, (5, 7)).astype(np.float32)
+    got = cexp_i_ramp(jnp.asarray(theta), n).to_numpy()
+    assert got.shape == (5, 7, n)
+    want = np.exp(1j * theta[..., None] * np.arange(n, dtype=np.float32))
+    # Tolerance: the split path rounds theta*a and theta*split*b separately;
+    # at |theta| <= 4, k <= 63 the f32 ulp of the ~250-radian angle is ~3e-5.
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
 def test_pack_unpack(rng):
     h = _rand_c(rng, 4, 10)
     packed = pack_h(CArr.from_numpy(h))

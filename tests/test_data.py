@@ -78,6 +78,23 @@ def test_rbg_generator_same_distribution():
     assert not np.allclose(np.asarray(a["yp"].re), np.asarray(t["yp"].re))
 
 
+def test_split_trig_matches_direct_generator():
+    """trig_impl="split" produces the SAME samples as "direct" to f32 phase
+    rounding — identical keys, identical draws, only the steering/delay ramp
+    evaluation changes (complexops.cexp_i_ramp)."""
+    geom_split = ChannelGeometry.from_config(DataConfig(data_len=256, trig_impl="split"))
+    i = jnp.arange(64)
+    args = (jnp.uint32(CFG.seed), i % 3, (i // 3) % 3, i, jnp.float32(10.0))
+    a = make_network_batch(*args, GEOM)
+    b = make_network_batch(*args, geom_split)
+    # Per-entry phase error <= ~1e-5 rad on unit-power entries -> tight atol.
+    np.testing.assert_allclose(
+        np.asarray(a["h_perf"]), np.asarray(b["h_perf"]), atol=1e-4
+    )
+    np.testing.assert_allclose(np.asarray(a["yp"].re), np.asarray(b["yp"].re), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(a["yp"].im), np.asarray(b["yp"].im), atol=1e-4)
+
+
 def test_rng_impl_rejects_unknown():
     from qdml_tpu.data.channels import make_sample_key
 
