@@ -293,6 +293,61 @@ def _bench_qsc(
     return {"samples_per_sec": round(samples, 1), "model_tflops": round(tflops, 3)}
 
 
+def _bench_qsc_scan(
+    backend: str, k: int, max_steps: int, budget_s: float, n_qubits: int = 6
+) -> dict:
+    """Scan-fused quantum-classifier training (make_sc_scan_steps): K steps
+    per dispatch with on-device batch synthesis — the same dispatch-gap
+    removal the HDCE headline uses, applied to the QSC path whose K=1 step
+    is ~entirely host gap (<1% MFU, docs/ROOFLINE.md)."""
+    import jax.numpy as jnp
+
+    from qdml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        QuantumConfig,
+        TrainConfig,
+    )
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.train.qsc import init_sc_state, make_sc_scan_steps
+
+    cfg = ExperimentConfig(
+        data=DataConfig(rng_impl="rbg", trig_impl="split"),
+        quantum=QuantumConfig(backend=backend, n_qubits=n_qubits),
+        train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
+    )
+    geom = ChannelGeometry.from_config(cfg.data)
+    s, u = _GRID
+    scen, user, idx1 = _grid_coords()
+    idx = jnp.broadcast_to(idx1[None], (k, s, u, _CELL_BS)).astype(jnp.int32)
+    snrs = jnp.full((k,), float(cfg.data.snr_db), jnp.float32)
+    model, state = init_sc_state(cfg, quantum=True, steps_per_epoch=100)
+    run = make_sc_scan_steps(model, geom, needs_rng=False)
+    seed = jnp.uint32(0)
+    # the scan machinery always threads a (K, 2) key stack (QuantumNAT noise
+    # stream); with needs_rng=False the keys are carried but unused
+    import jax as _jax
+
+    from qdml_tpu.train.scan import presplit_keys
+
+    _, rngs = presplit_keys(_jax.random.PRNGKey(0), k)
+
+    def step(state, _):
+        return run(state, seed, scen, user, idx, snrs, rngs)
+
+    sps = _timed_sps(
+        step, state, None, lambda m: float(m["loss"][-1]), max_steps, budget_s
+    )
+    samples = sps * k * s * u * _CELL_BS
+    tflops = samples * 3.0 * qsc_fwd_flops_per_sample(cfg) / 1e12
+    return {
+        "samples_per_sec": round(samples, 1),
+        "model_tflops": round(tflops, 3),
+        "scan_steps": k,
+        "backend": backend,
+    }
+
+
 def run_child(platform: str) -> int:
     """Run every measurement, print one JSON dict to stdout."""
     import jax
@@ -371,6 +426,16 @@ def run_child(platform: str) -> int:
         ("qsc_dense", lambda: _bench_qsc("dense", max_steps, budget / 2)),
         ("qsc_pallas", lambda: _bench_qsc("pallas", max_steps, budget / 2)),
     ]
+    if on_tpu:
+        # The QSC K=1 step is ~entirely host dispatch gap at this model size
+        # (<1% MFU); the scan-fused variant is the training throughput a real
+        # `train-qsc --train.scan_steps=16` run achieves.
+        benches.append(
+            (
+                "qsc_dense_scan",
+                lambda: _bench_qsc_scan("dense", scan_k, max_steps, budget / 2),
+            )
+        )
     for key, fn in benches:
         try:
             out[key] = fn()
