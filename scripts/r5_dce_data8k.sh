@@ -1,0 +1,44 @@
+#!/bin/bash
+# Reduced-protocol decomposition, DATA arm: 30 epochs x 8k samples/cell.
+#
+# results/dce/epochs60/ measured the EPOCHS arm (60 ep x 4k/cell) of the
+# round-4 protocol reduction and found the below-MMSE tail closes and the
+# hierarchy gain widens. This is the complementary arm — same 2x compute
+# budget spent on data instead of epochs (30 ep x 8k/cell; steps/epoch
+# doubles, total steps equal to the epochs arm) — completing the 2x2:
+#   30ep x 4k  (results/dce/)        | 60ep x 4k (results/dce/epochs60/)
+#   30ep x 8k  (results/dce/data8k/) | 100ep x 20k = full protocol (TPU)
+# If doubling DATA also closes the tail, the two axes trade off; if not,
+# the shortfall is specifically training length — sharpening finding 1.
+#
+# Fresh training (no checkpoints at this data volume); resume-capable.
+set -e
+cd /root/repo
+S=${1:-}
+if [ -n "$S" ]; then
+  WD=runs/science_cpu_d8k_s$S
+  SEEDS="--train.seed=$S --data.seed=$((2026 + S))"
+  OUT=results/dce/data8k/seed$S
+else
+  WD=runs/science_cpu_d8k
+  SEEDS=""
+  OUT=results/dce/data8k
+fi
+RED="--data.data_len=8000 --train.n_epochs=30"
+for cmd in train-hdce train-sc train-dce; do
+  echo "=== $cmd (8k/cell, 30 epochs, seed=${S:-default}) ==="
+  python -m qdml_tpu.cli $cmd $RED $SEEDS --train.workdir=$WD --train.resume=true
+done
+python -m qdml_tpu.cli eval --data.data_len=8000 --train.workdir=$WD \
+    --eval.results_dir=$OUT
+cp $WD/Pn_128/*/eval.metrics.jsonl $OUT/ 2>/dev/null || true
+if [ ! -f $OUT/PROTOCOL.md ]; then
+  cat > $OUT/PROTOCOL.md <<'EOF'
+# Protocol: 8k samples/cell (2x the reduced runs), 30 epochs
+
+The DATA arm of the reduced-protocol decomposition
+(`scripts/r5_dce_data8k.sh`): same total training steps as the epochs arm
+(`../epochs60/`, 60 ep x 4k/cell), budget spent on data volume instead.
+EOF
+fi
+echo "DCE DATA8K DONE (seed=${S:-default})"
