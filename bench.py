@@ -4,6 +4,13 @@
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "platform": ...,
      "mfu": ..., "details": {...}}
 
+With ``--out=PATH`` (or ``QDML_BENCH_TELEMETRY_OUT``) the same record is also
+written as a telemetry JSONL — a run-manifest header line (device topology,
+git SHA, knob provenance from the measuring child) followed by the record —
+the artifact shape ``qdml_tpu.cli report`` consumes and regression-gates
+against a committed baseline (docs/TELEMETRY.md). Per-measurement details now
+carry ``compile_s`` and ``dispatch_ms`` p50/p95/max alongside the mean rate.
+
 Headline metric: full fused HDCE training-step throughput over the 3x3
 scenario/user DML grid at the reference batch size (256/cell => 2304
 samples/step; the reference's nine-sequential-backwards loop,
@@ -109,26 +116,44 @@ def qsc_fwd_flops_per_sample(cfg) -> float:
 # ---------------------------------------------------------------------------
 
 
-def _timed_sps(step, state, batch, sync, max_steps: int, budget_s: float) -> float:
-    """Steps/sec of an async-dispatched jitted step.
+def _timed_sps(step, state, batch, sync, max_steps: int, budget_s: float) -> dict:
+    """Timing record for an async-dispatched jitted step:
+    ``{"sps", "compile_s", "dispatch_ms"}``.
 
     Sizes the measured run from one SYNCED step so the budget bounds device
     time, not just dispatch time (async dispatch enqueues at Python speed —
     an un-synced while loop would queue all max_steps regardless of real step
-    cost and blow the child's wall-clock timeout on a slow backend)."""
+    cost and blow the child's wall-clock timeout on a slow backend).
+
+    ``compile_s`` is the warmup (compile + first executions) wall time;
+    ``dispatch_ms`` are p50/p95/max of the per-iteration enqueue intervals of
+    the timed loop — device-backpressured after the pipeline fills, so the
+    tail percentiles surface stalls the mean rate hides. The headline sps
+    math (n / synced wall) is unchanged."""
+    from qdml_tpu.telemetry import Histogram
+
+    t_c0 = time.perf_counter()
     for _ in range(2):  # warmup + compile
         state, m = step(state, batch)
     sync(m)
+    compile_s = time.perf_counter() - t_c0
     t0 = time.perf_counter()
     state, m = step(state, batch)
     sync(m)
     est = max(time.perf_counter() - t0, 1e-4)
     n = max(3, min(max_steps, int(budget_s / est)))
+    hist = Histogram()
     t0 = time.perf_counter()
     for _ in range(n):
+        t1 = time.perf_counter()
         state, m = step(state, batch)
+        hist.add(time.perf_counter() - t1)
     sync(m)
-    return n / (time.perf_counter() - t0)
+    return {
+        "sps": n / (time.perf_counter() - t0),
+        "compile_s": round(compile_s, 3),
+        "dispatch_ms": hist.summary(),
+    }
 
 
 def _grid_coords():
@@ -181,14 +206,16 @@ def _bench_hdce(
     batch = {k: batch[k] for k in ("yp_img", "h_label", "h_perf")}
     model, state = init_hdce_state(cfg, steps_per_epoch=100)
     step = make_hdce_train_step(model, state.tx)
-    sps = _timed_sps(
+    t = _timed_sps(
         step, state, batch, lambda m: float(m["loss"]), max_steps, budget_s
     )
-    samples = sps * _GRID[0] * _GRID[1] * _CELL_BS
+    samples = t["sps"] * _GRID[0] * _GRID[1] * _CELL_BS
     tflops = samples * 3.0 * hdce_fwd_flops_per_sample(cfg) / 1e12
     return {
         "samples_per_sec": round(samples, 1),
         "model_tflops": round(tflops, 3),
+        "compile_s": t["compile_s"],
+        "dispatch_ms": t["dispatch_ms"],
         # the lowering this measurement actually ran (proves "auto" engaged
         # shift_matmul in the fallback path — VERDICT r4 weak #1 asked
         # whether 206-vs-451 sps meant the fix wasn't engaging; it was)
@@ -239,14 +266,16 @@ def _bench_hdce_scan(
     def step(state, _):
         return run(state, seed, scen, user, idx, snrs)
 
-    sps = _timed_sps(
+    t = _timed_sps(
         step, state, None, lambda m: float(m["loss"][-1]), max_steps, budget_s
     )
-    samples = sps * k * s * u * _CELL_BS
+    samples = t["sps"] * k * s * u * _CELL_BS
     tflops = samples * 3.0 * hdce_fwd_flops_per_sample(cfg) / 1e12
     out = {
         "samples_per_sec": round(samples, 1),
         "model_tflops": round(tflops, 3),
+        "compile_s": t["compile_s"],
+        "dispatch_ms": t["dispatch_ms"],
         "scan_steps": k,
     }
     if rng_impl != "threefry":
@@ -285,12 +314,17 @@ def _bench_qsc(
     def step2(state, b):
         return step(state, b, rng)
 
-    sps = _timed_sps(
+    t = _timed_sps(
         step2, state, batch, lambda m: float(m["loss"]), max_steps, budget_s
     )
-    samples = sps * _GRID[0] * _GRID[1] * _CELL_BS
+    samples = t["sps"] * _GRID[0] * _GRID[1] * _CELL_BS
     tflops = samples * 3.0 * qsc_fwd_flops_per_sample(cfg) / 1e12
-    return {"samples_per_sec": round(samples, 1), "model_tflops": round(tflops, 3)}
+    return {
+        "samples_per_sec": round(samples, 1),
+        "model_tflops": round(tflops, 3),
+        "compile_s": t["compile_s"],
+        "dispatch_ms": t["dispatch_ms"],
+    }
 
 
 def _bench_qsc_scan(
@@ -299,7 +333,14 @@ def _bench_qsc_scan(
     """Scan-fused quantum-classifier training (make_sc_scan_steps): K steps
     per dispatch with on-device batch synthesis — the same dispatch-gap
     removal the HDCE headline uses, applied to the QSC path whose K=1 step
-    is ~entirely host gap (<1% MFU, docs/ROOFLINE.md)."""
+    is ~entirely host gap (<1% MFU, docs/ROOFLINE.md).
+
+    Measured with the FAST generator levers (rng_impl='rbg',
+    trig_impl='split'), NOT a default-config `train-qsc` run (ADVICE r5 low:
+    the old docstring claimed "real run" throughput while hardcoding the
+    levers); both knobs are recorded in the returned dict — and in the
+    run-manifest header of any bench telemetry JSONL — so the record can
+    never read as a default-stream measurement."""
     import jax.numpy as jnp
 
     from qdml_tpu.config import (
@@ -335,16 +376,21 @@ def _bench_qsc_scan(
     def step(state, _):
         return run(state, seed, scen, user, idx, snrs, rngs)
 
-    sps = _timed_sps(
+    t = _timed_sps(
         step, state, None, lambda m: float(m["loss"][-1]), max_steps, budget_s
     )
-    samples = sps * k * s * u * _CELL_BS
+    samples = t["sps"] * k * s * u * _CELL_BS
     tflops = samples * 3.0 * qsc_fwd_flops_per_sample(cfg) / 1e12
     return {
         "samples_per_sec": round(samples, 1),
         "model_tflops": round(tflops, 3),
+        "compile_s": t["compile_s"],
+        "dispatch_ms": t["dispatch_ms"],
         "scan_steps": k,
         "backend": backend,
+        # the non-default generator levers this measurement ran with
+        "rng_impl": cfg.data.rng_impl,
+        "trig_impl": cfg.data.trig_impl,
     }
 
 
@@ -352,6 +398,7 @@ def run_child(platform: str) -> int:
     """Run every measurement, print one JSON dict to stdout."""
     import jax
 
+    from qdml_tpu.telemetry import run_manifest
     from qdml_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -359,7 +406,16 @@ def run_child(platform: str) -> int:
     on_tpu = platform != "cpu"
     max_steps = 50 if on_tpu else 6
     budget = 120.0 if on_tpu else 60.0
-    out: dict = {"backend": jax.default_backend(), "devices": len(jax.devices())}
+    out: dict = {
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        # device-topology/git/knob provenance; the parent lifts this into the
+        # telemetry JSONL's header line
+        "manifest": run_manifest(
+            argv=["bench.py", "--child", platform],
+            extra={"grid": list(_GRID), "cell_batch": _CELL_BS},
+        ),
+    }
     # Each sub-bench is independently guarded so one failing measurement
     # (flaky tunnelled backend, pallas unsupported off-TPU, ...) degrades to
     # an error entry instead of discarding the measurements that succeeded.
@@ -441,6 +497,9 @@ def run_child(platform: str) -> int:
             out[key] = fn()
         except Exception as e:
             out[key] = {"error": f"{type(e).__name__}: {e}"}
+    from qdml_tpu.utils.compile_cache import compile_cache_stats
+
+    out["compile_cache"] = compile_cache_stats()
     print(json.dumps(out), flush=True)
     return 0
 
@@ -679,9 +738,35 @@ def _latest_committed_tpu_record() -> dict | None:
         return None
 
 
+def _write_telemetry_jsonl(path: str, manifest: dict | None, record: dict) -> None:
+    """Write the bench artifact as a telemetry JSONL: run-manifest header
+    line (the child's device-topology manifest, or a host-only one when no
+    child produced one) + the record. Never raises — telemetry must not be
+    able to kill a bench run that already has a result to report."""
+    try:
+        if manifest is None:
+            # parent-side fallback; include_jax=False keeps the parent's
+            # never-imports-jax robustness contract intact
+            from qdml_tpu.telemetry import run_manifest
+
+            manifest = run_manifest(argv=["bench.py"], include_jax=False)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(json.dumps(manifest) + "\n")
+            fh.write(json.dumps({"kind": "bench_record", **record}) + "\n")
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] telemetry write failed: {e}", file=sys.stderr, flush=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", default=None)
+    ap.add_argument(
+        "--out",
+        default=os.environ.get("QDML_BENCH_TELEMETRY_OUT") or None,
+        help="telemetry JSONL path (manifest header + record); the one-line "
+        "stdout record is unchanged",
+    )
     args = ap.parse_args()
     if args.child:
         return run_child(args.child)
@@ -769,6 +854,7 @@ def main() -> int:
             # while a fail-fast one returns in seconds (sleep stretches to
             # keep the cadence — and the subprocess churn — bounded)
             time.sleep(max(15.0, 60.0 - (time.monotonic() - t_probe)))
+    child_manifest = details.pop("manifest", None) if details else None
     if details is None:
         rec = {
             "metric": "hdce_train_samples_per_sec_per_chip",
@@ -783,6 +869,8 @@ def main() -> int:
         if committed is not None:
             rec["latest_committed_tpu_record"] = committed
         print(json.dumps(rec))
+        if args.out:
+            _write_telemetry_jsonl(args.out, child_manifest, rec)
         return 1
 
     baseline_live = measure_torch_cpu_reference()
@@ -832,6 +920,8 @@ def main() -> int:
         if committed is not None:
             rec["latest_committed_tpu_record"] = committed
         print(json.dumps(rec))
+        if args.out:
+            _write_telemetry_jsonl(args.out, child_manifest, rec)
         return 1
     dtype = {
         "hdce_bf16": "bfloat16",
@@ -916,6 +1006,8 @@ def main() -> int:
             "on CPU is expected (no bf16 fast path off-TPU)."
         )
     print(json.dumps(record))
+    if args.out:
+        _write_telemetry_jsonl(args.out, child_manifest, record)
     return 0
 
 

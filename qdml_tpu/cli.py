@@ -15,6 +15,13 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli gen-data --out=DIR    # materialise .npy cache
     python -m qdml_tpu.cli import-torch --out=SRCDIR  # reference .pth -> orbax
     python -m qdml_tpu.cli export-torch --out=DSTDIR  # orbax -> reference .pth
+    python -m qdml_tpu.cli report --current=PATH[,..] --baseline=PATH
+                                  [--threshold=PCT] [--out=FILE.md]
+                                  # telemetry delta table; exit 3 on regression
+
+Every command's metrics JSONL starts with a run-manifest header (config hash,
+git SHA, device topology, perf knobs, seeds) and carries span/counter records
+from the telemetry layer (docs/TELEMETRY.md).
 
 Dotted-path overrides map onto :mod:`qdml_tpu.config` dataclasses; presets are
 the five BASELINE.json benchmark configs plus robust_qsc.
@@ -31,7 +38,27 @@ from qdml_tpu.utils.metrics import MetricsLogger
 from qdml_tpu.utils.platform import honor_platform_env
 
 
-_PASSTHROUGH = ("--out=", "--curves=")  # command args, not config overrides
+_COMMANDS = (
+    "train-hdce",
+    "train-dce",
+    "train-sc",
+    "train-qsc",
+    "nat-sweep",
+    "eval",
+    "loss-curves",
+    "profile",
+    "gen-data",
+    "import-torch",
+    "export-torch",
+)  # "report" dispatches before config parsing (no jax, no workdir)
+
+_PASSTHROUGH = (  # command args, not config overrides
+    "--out=",
+    "--curves=",
+    "--current=",
+    "--baseline=",
+    "--threshold=",
+)
 
 
 def _cfg(argv):
@@ -50,6 +77,12 @@ def main(argv: list[str] | None = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0
+    if argv[0] == "report":
+        # Host-side tool over committed/produced artifacts: no jax, no
+        # distributed init, no workdir — exit code is the regression gate.
+        from qdml_tpu.telemetry.report import report_main
+
+        return report_main(argv[1:])
     # Make JAX_PLATFORMS=cpu actually select the CPU backend (the plugin
     # rewrites jax_platforms at interpreter start; qdml_tpu.utils.platform
     # is the single home for the workaround).
@@ -66,181 +99,209 @@ def main(argv: list[str] | None = None) -> int:
     if not init_distributed_from_env() and pod_env_hint():
         init_distributed()
     cmd, rest = argv[0], argv[1:]
-    cfg, extra = _cfg(rest)
-    workdir = _workdir(cfg)
-    logger = MetricsLogger(os.path.join(workdir, f"{cmd}.metrics.jsonl"))
-    t0 = time.time()
-
-    if cmd == "train-hdce":
-        from qdml_tpu.train.hdce import train_hdce
-
-        train_hdce(cfg, logger=logger, workdir=workdir)
-    elif cmd == "train-dce":
-        from qdml_tpu.train.dce import train_dce
-
-        train_dce(cfg, logger=logger, workdir=workdir)
-    elif cmd in ("train-sc", "train-qsc"):
-        from qdml_tpu.train.qsc import train_classifier
-
-        train_classifier(cfg, quantum=(cmd == "train-qsc"), logger=logger, workdir=workdir)
-    elif cmd == "nat-sweep":
-        from qdml_tpu.train.nat_sweep import train_nat_sweep
-
-        train_nat_sweep(
-            cfg, noise_levels=cfg.quantum.noise_sweep, logger=logger, workdir=workdir
-        )
-    elif cmd == "eval":
-        from qdml_tpu.eval.report import create_comparison_plots, save_results_json
-        from qdml_tpu.eval.sweep import run_snr_sweep
-        from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint
-
-        hdce_vars, _ = restore_checkpoint(workdir, "hdce_best")
-        sc_vars, _ = restore_checkpoint(workdir, "sc_best")
-        qsc_vars = None
-        if has_checkpoint(workdir, "qsc_best"):  # graceful fallback (Test.py:81-86)
-            from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
-
-            qsc_vars, qsc_meta = restore_checkpoint(workdir, "qsc_best")
-            cfg = reconcile_quantum_cfg(cfg, qsc_meta)
-        # Optional monolithic-DCE baseline curve (beyond the reference's
-        # shipped eval): included whenever `cli train-dce` has produced a
-        # best checkpoint in this workdir.
-        dce_vars = None
-        if has_checkpoint(workdir, "dce_best"):
-            dce_vars, _ = restore_checkpoint(workdir, "dce_best")
-        # Multi-device eval: same mesh contract as the trainers. A fed axis
-        # == n_scenarios runs the all-hypotheses trunk pass expert-parallel
-        # (each scenario's trunk on its own slice); the data axis shards the
-        # test batch and its on-device generation.
-        from qdml_tpu.parallel.mesh import training_mesh
-
-        mesh = training_mesh(cfg)
-        if mesh is not None:
-            from qdml_tpu.parallel.federated import shard_hdce_vars
-
-            hdce_vars = shard_hdce_vars(
-                hdce_vars, mesh, n_scenarios=cfg.data.n_scenarios
-            )
-        results = run_snr_sweep(
-            cfg, hdce_vars, sc_vars, qsc_vars, logger=logger, dce_vars=dce_vars, mesh=mesh
-        )
-        out_json = save_results_json(results, cfg.eval.results_dir)
-        out_png = create_comparison_plots(results, cfg.eval.results_dir)
-        from qdml_tpu.eval.report import results_markdown_table
-
-        table = results_markdown_table(results)
-        with open(os.path.join(cfg.eval.results_dir, "results_table.md"), "w") as fh:
-            fh.write(table + "\n")
-        print(table)
-        print(f"results: {out_json} plot: {out_png}")
-    elif cmd == "loss-curves":
-        from qdml_tpu.eval.loss_curves import (
-            create_loss_curve_plot,
-            parse_curve_spec,
-            read_loss_history,
-        )
-
-        spec = next(
-            (a.split("=", 1)[1] for a in extra if a.startswith("--curves=")), None
-        )
-        if spec is None:
-            raise SystemExit("loss-curves requires --curves=LABEL:PATH[,LABEL:PATH...]")
-        curves = [
-            (label, read_loss_history(path)) for label, path in parse_curve_spec(spec)
-        ]
-        out = create_loss_curve_plot(curves, cfg.eval.results_dir)
-        print(f"loss curves: {out}")
-    elif cmd == "profile":
-        # Captured-trace evidence for SURVEY.md §5.1: a TensorBoard-loadable
-        # jax.profiler trace of real train steps plus steady-state
-        # samples/sec from StepTimer.
-        import json
-
-        from qdml_tpu.data.datasets import DMLGridLoader
-        from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
-        from qdml_tpu.utils.profiling import StepTimer, trace
-
-        out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "results/tpu_trace")
-        loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
-        batch = next(iter(loader.epoch(0)))
-        model, state = init_hdce_state(cfg, loader.steps_per_epoch)
-        step = make_hdce_train_step(model, state.tx)
-        state, m = step(state, batch)  # compile outside the trace
-        timer = StepTimer(warmup=2)
-        n_steps = 12
-        with trace(out):
-            for _ in range(n_steps):
-                state, m = step(state, batch)
-                timer.tick(m["loss"])
-        import jax as _jax
-
-        grid = cfg.data.n_scenarios * cfg.data.n_users
-        summary = {
-            "backend": _jax.default_backend(),
-            "steps_traced": n_steps,
-            "samples_per_sec": round(
-                timer.samples_per_sec(cfg.train.batch_size * grid), 1
-            ),
-            "trace_dir": out,
-        }
-        with open(os.path.join(out, "summary.json"), "w") as fh:
-            json.dump(summary, fh, indent=2)
-        print(json.dumps(summary))
-    elif cmd == "gen-data":
-        from qdml_tpu.data.datasets import save_npy_cache
-
-        out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "available_data")
-        save_npy_cache(out, cfg.data)
-        print(f"wrote npy cache to {out}")
-    elif cmd == "import-torch":
-        from qdml_tpu.train.checkpoint import save_checkpoint
-        from qdml_tpu.train.torch_interop import import_reference_dir
-
-        src = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), ".")
-        trees = import_reference_dir(
-            src, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db)
-        )
-        for name, tree in trees.items():
-            meta: dict = {"source": src}
-            if name == "qsc":
-                # Architecture facts from the imported params themselves so
-                # eval rebuilds the right model (reference QSCs are raw-pilot:
-                # no input normalization).
-                qw = tree["params"]["qweights"]
-                from qdml_tpu.quantum.circuits import resolve_backend
-
-                meta["quantum"] = {
-                    "n_qubits": int(qw.shape[1]),
-                    "n_layers": int(qw.shape[0]),
-                    "n_classes": int(tree["params"]["Dense_0"]["bias"].shape[0]),
-                    # resolved path, not the "auto" alias (provenance)
-                    "backend": resolve_backend(cfg.quantum.backend, int(qw.shape[1])),
-                    "input_norm": False,
-                }
-            save_checkpoint(workdir, f"{name}_best", tree, meta)
-        print(f"imported {sorted(trees)} from {src} -> {workdir}")
-    elif cmd == "export-torch":
-        from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint
-        from qdml_tpu.train.torch_interop import export_reference_dir
-
-        out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "torch_ckpts")
-        kwargs = {}
-        if has_checkpoint(workdir, "hdce_best"):
-            kwargs["hdce_vars"], _ = restore_checkpoint(workdir, "hdce_best")
-        if has_checkpoint(workdir, "sc_best"):
-            kwargs["sc_params"] = restore_checkpoint(workdir, "sc_best")[0]["params"]
-        if has_checkpoint(workdir, "qsc_best"):
-            kwargs["qsc_params"] = restore_checkpoint(workdir, "qsc_best")[0]["params"]
-        written = export_reference_dir(
-            out, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db), **kwargs
-        )
-        print("wrote:\n  " + "\n  ".join(written))
-    else:
+    if cmd not in _COMMANDS:
         print(f"unknown command {cmd!r}")
         return 2
-    # reference prints total minutes (Runner...py:437-440)
-    print(f"total time: {(time.time() - t0) / 60.0:.2f} min")
-    return 0
+    cfg, extra = _cfg(rest)
+    workdir = _workdir(cfg)
+    # Run manifest + telemetry sink: the metrics stream opens with the
+    # provenance header, and library-level spans/counters (train loops, eval
+    # sweep) land in the same file.
+    from qdml_tpu.telemetry import run_manifest, set_sink
+
+    logger = MetricsLogger(
+        os.path.join(workdir, f"{cmd}.metrics.jsonl"),
+        manifest=run_manifest(cfg, argv=argv),
+    )
+    set_sink(logger.telemetry)
+    t0 = time.time()
+
+    try:
+        if cmd == "train-hdce":
+            from qdml_tpu.train.hdce import train_hdce
+
+            train_hdce(cfg, logger=logger, workdir=workdir)
+        elif cmd == "train-dce":
+            from qdml_tpu.train.dce import train_dce
+
+            train_dce(cfg, logger=logger, workdir=workdir)
+        elif cmd in ("train-sc", "train-qsc"):
+            from qdml_tpu.train.qsc import train_classifier
+
+            train_classifier(cfg, quantum=(cmd == "train-qsc"), logger=logger, workdir=workdir)
+        elif cmd == "nat-sweep":
+            from qdml_tpu.train.nat_sweep import train_nat_sweep
+
+            train_nat_sweep(
+                cfg, noise_levels=cfg.quantum.noise_sweep, logger=logger, workdir=workdir
+            )
+        elif cmd == "eval":
+            from qdml_tpu.eval.report import create_comparison_plots, save_results_json
+            from qdml_tpu.eval.sweep import run_snr_sweep
+            from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint
+
+            hdce_vars, _ = restore_checkpoint(workdir, "hdce_best")
+            sc_vars, _ = restore_checkpoint(workdir, "sc_best")
+            qsc_vars = None
+            if has_checkpoint(workdir, "qsc_best"):  # graceful fallback (Test.py:81-86)
+                from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
+
+                qsc_vars, qsc_meta = restore_checkpoint(workdir, "qsc_best")
+                cfg = reconcile_quantum_cfg(cfg, qsc_meta)
+            # Optional monolithic-DCE baseline curve (beyond the reference's
+            # shipped eval): included whenever `cli train-dce` has produced a
+            # best checkpoint in this workdir.
+            dce_vars = None
+            if has_checkpoint(workdir, "dce_best"):
+                dce_vars, _ = restore_checkpoint(workdir, "dce_best")
+            # Multi-device eval: same mesh contract as the trainers. A fed axis
+            # == n_scenarios runs the all-hypotheses trunk pass expert-parallel
+            # (each scenario's trunk on its own slice); the data axis shards the
+            # test batch and its on-device generation.
+            from qdml_tpu.parallel.mesh import training_mesh
+
+            mesh = training_mesh(cfg)
+            if mesh is not None:
+                from qdml_tpu.parallel.federated import shard_hdce_vars
+
+                hdce_vars = shard_hdce_vars(
+                    hdce_vars, mesh, n_scenarios=cfg.data.n_scenarios
+                )
+            results = run_snr_sweep(
+                cfg, hdce_vars, sc_vars, qsc_vars, logger=logger, dce_vars=dce_vars, mesh=mesh
+            )
+            out_json = save_results_json(results, cfg.eval.results_dir)
+            out_png = create_comparison_plots(results, cfg.eval.results_dir)
+            from qdml_tpu.eval.report import results_markdown_table
+
+            table = results_markdown_table(results)
+            with open(os.path.join(cfg.eval.results_dir, "results_table.md"), "w") as fh:
+                fh.write(table + "\n")
+            print(table)
+            print(f"results: {out_json} plot: {out_png}")
+        elif cmd == "loss-curves":
+            from qdml_tpu.eval.loss_curves import (
+                create_loss_curve_plot,
+                parse_curve_spec,
+                read_loss_history,
+            )
+
+            spec = next(
+                (a.split("=", 1)[1] for a in extra if a.startswith("--curves=")), None
+            )
+            if spec is None:
+                raise SystemExit("loss-curves requires --curves=LABEL:PATH[,LABEL:PATH...]")
+            curves = [
+                (label, read_loss_history(path)) for label, path in parse_curve_spec(spec)
+            ]
+            out = create_loss_curve_plot(curves, cfg.eval.results_dir)
+            print(f"loss curves: {out}")
+        elif cmd == "profile":
+            # Captured-trace evidence for SURVEY.md §5.1: a TensorBoard-loadable
+            # jax.profiler trace of real train steps plus steady-state
+            # samples/sec from StepTimer.
+            import json
+
+            from qdml_tpu.data.datasets import DMLGridLoader
+            from qdml_tpu.train.hdce import init_hdce_state, make_hdce_train_step
+            from qdml_tpu.utils.profiling import StepTimer, trace
+
+            from qdml_tpu.telemetry import device_memory_snapshot, span
+            from qdml_tpu.utils.compile_cache import compile_cache_stats
+            from qdml_tpu.utils.profiling import force
+
+            out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "results/tpu_trace")
+            loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
+            batch = next(iter(loader.epoch(0)))
+            model, state = init_hdce_state(cfg, loader.steps_per_epoch)
+            step = make_hdce_train_step(model, state.tx)
+            with span("compile"):  # compile + first execute, outside the trace
+                state, m = step(state, batch)
+                force(m["loss"])
+            timer = StepTimer(warmup=2)
+            n_steps = 12
+            with trace(out):
+                with span("steady_state", steps=n_steps):
+                    for _ in range(n_steps):
+                        state, m = step(state, batch)
+                        timer.tick(m["loss"])
+            import jax as _jax
+
+            grid = cfg.data.n_scenarios * cfg.data.n_users
+            summary = {
+                "backend": _jax.default_backend(),
+                "steps_traced": n_steps,
+                "samples_per_sec": round(
+                    timer.samples_per_sec(cfg.train.batch_size * grid), 1
+                ),
+                # percentiles, not just the mean rate (dispatch intervals on an
+                # async backend — see StepTimer.histogram)
+                "step_ms": timer.histogram(),
+                "memory": device_memory_snapshot(),
+                "compile_cache": compile_cache_stats(),
+                "trace_dir": out,
+            }
+            with open(os.path.join(out, "summary.json"), "w") as fh:
+                json.dump(summary, fh, indent=2)
+            print(json.dumps(summary))
+        elif cmd == "gen-data":
+            from qdml_tpu.data.datasets import save_npy_cache
+
+            out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "available_data")
+            save_npy_cache(out, cfg.data)
+            print(f"wrote npy cache to {out}")
+        elif cmd == "import-torch":
+            from qdml_tpu.train.checkpoint import save_checkpoint
+            from qdml_tpu.train.torch_interop import import_reference_dir
+
+            src = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), ".")
+            trees = import_reference_dir(
+                src, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db)
+            )
+            for name, tree in trees.items():
+                meta: dict = {"source": src}
+                if name == "qsc":
+                    # Architecture facts from the imported params themselves so
+                    # eval rebuilds the right model (reference QSCs are raw-pilot:
+                    # no input normalization).
+                    qw = tree["params"]["qweights"]
+                    from qdml_tpu.quantum.circuits import resolve_backend
+
+                    meta["quantum"] = {
+                        "n_qubits": int(qw.shape[1]),
+                        "n_layers": int(qw.shape[0]),
+                        "n_classes": int(tree["params"]["Dense_0"]["bias"].shape[0]),
+                        # resolved path, not the "auto" alias (provenance)
+                        "backend": resolve_backend(cfg.quantum.backend, int(qw.shape[1])),
+                        "input_norm": False,
+                    }
+                save_checkpoint(workdir, f"{name}_best", tree, meta)
+            print(f"imported {sorted(trees)} from {src} -> {workdir}")
+        elif cmd == "export-torch":
+            from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint
+            from qdml_tpu.train.torch_interop import export_reference_dir
+
+            out = next((e.split("=", 1)[1] for e in extra if e.startswith("--out=")), "torch_ckpts")
+            kwargs = {}
+            if has_checkpoint(workdir, "hdce_best"):
+                kwargs["hdce_vars"], _ = restore_checkpoint(workdir, "hdce_best")
+            if has_checkpoint(workdir, "sc_best"):
+                kwargs["sc_params"] = restore_checkpoint(workdir, "sc_best")[0]["params"]
+            if has_checkpoint(workdir, "qsc_best"):
+                kwargs["qsc_params"] = restore_checkpoint(workdir, "qsc_best")[0]["params"]
+            written = export_reference_dir(
+                out, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db), **kwargs
+            )
+            print("wrote:\n  " + "\n  ".join(written))
+        # reference prints total minutes (Runner...py:437-440)
+        print(f"total time: {(time.time() - t0) / 60.0:.2f} min")
+        return 0
+    finally:
+        # always detach the global sink and close the stream — an exception
+        # mid-command (or an in-process caller) must not leave later spans
+        # appending to a dead run's file
+        set_sink(None)
+        logger.close()
 
 
 if __name__ == "__main__":

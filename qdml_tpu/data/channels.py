@@ -39,6 +39,11 @@ import numpy as np
 
 from qdml_tpu.config import DataConfig
 from qdml_tpu.utils.complexops import CArr, ceinsum, cexp_i, cexp_i_ramp
+from qdml_tpu.utils.platform import ensure_jax_compat
+
+# The generator's anti-fusion barrier (sample_channel) must vmap/grad on jax
+# versions that ship optimization_barrier without those rules.
+ensure_jax_compat()
 
 # Maximum paths across scenarios; per-scenario counts are masked (static shapes
 # for jit — no data-dependent Python control flow).
@@ -83,6 +88,18 @@ class ChannelGeometry:
     # or "split" (angle-addition factorization, ~4x fewer transcendentals,
     # same values to f32 rounding; see complexops.cexp_i_ramp). Static.
     trig_impl: str = "direct"
+
+    def __post_init__(self):
+        # Same rejection contract as make_sample_key's rng_impl check (ADVICE
+        # r5 low): an unknown trig_impl must not silently select "direct".
+        if self.rng_impl not in ("threefry", "rbg"):
+            raise ValueError(
+                f"rng_impl must be 'threefry' or 'rbg', got {self.rng_impl!r}"
+            )
+        if self.trig_impl not in ("direct", "split"):
+            raise ValueError(
+                f"trig_impl must be 'direct' or 'split', got {self.trig_impl!r}"
+            )
 
     @classmethod
     def from_config(cls, cfg: DataConfig) -> "ChannelGeometry":

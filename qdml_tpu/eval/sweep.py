@@ -39,6 +39,7 @@ from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.models.cnn import DCEP128, SCP128
 from qdml_tpu.models.qsc import QSCP128
 from qdml_tpu.ops.routing import select_expert
+from qdml_tpu.telemetry import span
 from qdml_tpu.train.hdce import HDCE
 from qdml_tpu.utils.metrics import nmse_db
 
@@ -208,7 +209,10 @@ def run_snr_sweep(
     curves: dict[str, list] = {}
     accs: dict[str, list] = {}
     for snr in cfg.eval.snr_grid:
-        sums = sweep_one_snr(jnp.asarray(start), jnp.float32(snr))
+        # span to the global telemetry sink (set by the CLI); the first SNR
+        # point carries the sweep's jit compile
+        with span("snr_point", snr_db=float(snr)):
+            sums = sweep_one_snr(jnp.asarray(start), jnp.float32(snr))
         pow_ = max(sums["pow"], 1e-30)
         row: dict[str, float] = {}
         for key in sums:

@@ -37,6 +37,30 @@ def _runtime_initialized() -> bool:
         return False
 
 
+def _ensure_cpu_collectives() -> bool:
+    """Select the Gloo CPU-collectives implementation for a multi-process
+    cluster on the CPU backend; returns whether the config was changed.
+
+    jax 0.4.x defaults the option to "none", under which any cross-process
+    computation fails with "Multiprocess computations aren't implemented on
+    the CPU backend"; newer jax defaults to gloo and may drop the option —
+    both the lookup and the update are therefore best-effort. Only callers
+    that KNOW a multi-process init is happening may flip it: with gloo
+    selected but no distributed client, plain single-process CPU backend
+    init itself fails (make_gloo_tcp_collectives rejects a None client)."""
+    if (os.environ.get("JAX_PLATFORMS") or "").split(",")[0] != "cpu":
+        return False
+    try:
+        from jax._src import config as _config
+
+        if _config.config.values.get("jax_cpu_collectives_implementation") == "none":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            return True
+    except Exception:
+        pass
+    return False
+
+
 def ensure_initialized(**kwargs) -> None:
     """Idempotent ``jax.distributed.initialize``: a no-op when the runtime is
     already live (probed, with a message-matched RuntimeError fallback in case
@@ -44,15 +68,32 @@ def ensure_initialized(**kwargs) -> None:
     coordinator, barrier timeout — still propagate."""
     if _runtime_initialized():
         return
+    # Explicitly multi-process on the CPU backend: select Gloo collectives
+    # (jax 0.4.x default "none" cannot run cross-process computations). The
+    # no-kwargs autodetection path must NOT flip it — autodetection failing
+    # benignly (single process) would leave a poisoned config that breaks
+    # plain CPU backend init.
+    nproc = kwargs.get("num_processes")
+    flipped = (
+        _ensure_cpu_collectives()
+        if isinstance(nproc, int) and nproc > 1
+        else False
+    )
     try:
         jax.distributed.initialize(**kwargs)
-    except RuntimeError as e:
+    except Exception as e:
         # Benign repeat call. jax's message is "distributed.initialize should
         # only be called once." (jax/_src/distributed.py); "already" covers
         # older/newer phrasings.
-        msg = str(e).lower()
-        if "already" in msg or "only be called once" in msg:
-            return
+        if isinstance(e, RuntimeError):
+            msg = str(e).lower()
+            if "already" in msg or "only be called once" in msg:
+                return
+        if flipped:  # don't leave gloo configured without a client
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "none")
+            except Exception:
+                pass
         raise
 
 

@@ -40,7 +40,12 @@ from qdml_tpu.utils.complexops import CArr
 
 
 def _axis_size(axis_name: str) -> int:
-    return jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size is newer-jax only; psum(1, axis) is the portable
+    # idiom (constant-folds to the mesh axis size, no runtime collective).
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
 
 
 def _my_bit(axis_name: str, k: int, q: int) -> jnp.ndarray:
@@ -220,7 +225,14 @@ def run_circuit_sharded(
 
         return run_circuit(angles, weights, n_qubits, n_layers, "tensor")
 
-    fn = jax.shard_map(
+    # jax.shard_map is top-level only on newer jax; 0.4.x keeps it in
+    # jax.experimental.shard_map.
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(
         partial(
             _circuit_local,
             n=n_qubits,

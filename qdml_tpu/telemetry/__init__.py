@@ -1,0 +1,43 @@
+"""Unified telemetry layer: run manifests, spans, device counters, reports.
+
+Every entrypoint (CLI commands, ``bench.py``, the train loops, the eval
+sweeps) routes its observability through this package so no benchmark or
+metrics artifact is ever orphaned from its provenance again:
+
+- :func:`run_manifest` — one self-describing header record per run (config +
+  hash, git SHA, JAX/device topology, effective perf knobs, seeds), written
+  as the first line of every telemetry/metrics JSONL;
+- :func:`span` — nested wall-clock timing spans (``with span("compile"):``),
+  multihost-aware (only the primary process writes; events carry the process
+  index) with an automatic bridge into an active ``jax.profiler`` trace;
+- :class:`StepClock` / :class:`Histogram` / :func:`device_memory_snapshot` —
+  per-interval device counters: step-time percentiles (p50/p95/max, not just
+  means), host-transfer time, live-buffer/memory stats where the backend
+  exposes them, and the persistent-compile-cache hit/miss counters
+  (``qdml_tpu.utils.compile_cache``);
+- :mod:`qdml_tpu.telemetry.report` — the ``qdml-tpu report`` regression gate
+  over one or more telemetry artifacts vs a committed baseline.
+
+The long-standing ``MetricsLogger`` (``qdml_tpu.utils.metrics``), ``StepTimer``
+and ``trace()`` (``qdml_tpu.utils.profiling``) are thin facades over this
+layer — their call sites and test pins are unchanged. File formats and span
+conventions: ``docs/TELEMETRY.md``.
+"""
+
+from qdml_tpu.telemetry.core import Telemetry, is_primary  # noqa: F401
+from qdml_tpu.telemetry.counters import (  # noqa: F401
+    Histogram,
+    StepClock,
+    device_memory_snapshot,
+)
+from qdml_tpu.telemetry.manifest import (  # noqa: F401
+    config_hash,
+    effective_knobs,
+    run_manifest,
+)
+from qdml_tpu.telemetry.spans import (  # noqa: F401
+    get_sink,
+    profiler_trace,
+    set_sink,
+    span,
+)

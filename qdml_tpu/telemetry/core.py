@@ -1,0 +1,85 @@
+"""Telemetry sink: one append-only JSONL stream per run, primary-writer aware.
+
+A :class:`Telemetry` owns the run's JSONL file. All record kinds share the one
+stream — a ``manifest`` header line first, then interleaved ``metrics`` (the
+legacy bare-record shape, for reader compatibility), ``span`` and ``counters``
+lines — so a single artifact carries both the numbers and their provenance.
+
+Multi-host: every process measures, only the primary (process 0) writes.
+Concurrent appends from N hosts to one shared file would interleave, and the
+metrics are replicated/psum-aggregated anyway; non-primary sinks are inert
+(``active`` False, all writes no-ops).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, IO
+
+
+def is_primary() -> bool:
+    """True on the single process that should write shared files.
+
+    Probes ``jax.process_index()`` only when jax is already imported: a
+    host-side tool that never touched jax (the bench parent, ``report``) is
+    single-process by construction and must not pay — or trigger — a backend
+    import just to log.
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return True
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class Telemetry:
+    """Append-only JSONL telemetry stream.
+
+    ``manifest`` (a :func:`qdml_tpu.telemetry.manifest.run_manifest` dict) is
+    written as the stream's first record at open — every run appends its own
+    manifest, so even a resumed/appended file carries one header per process
+    invocation and no record in it is ever orphaned from its provenance.
+    """
+
+    def __init__(
+        self,
+        path: str | None = None,
+        manifest: dict | None = None,
+        echo: bool = False,
+    ):
+        self.path = path
+        self.echo = echo
+        self._fh: IO[str] | None = None
+        if path is not None and is_primary():
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+            if manifest is not None:
+                self.write_raw(dict(manifest))
+
+    @property
+    def active(self) -> bool:
+        """Whether writes reach a file (primary process with a path)."""
+        return self._fh is not None
+
+    def write_raw(self, rec: dict) -> None:
+        """Append one record exactly as given (no kind/ts decoration)."""
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+        if self.echo:
+            print(json.dumps(rec), flush=True)
+
+    def emit(self, kind: str, **payload: Any) -> dict:
+        """Append one typed record: ``{"kind": kind, "ts": ..., **payload}``."""
+        rec = {"kind": kind, "ts": round(time.time(), 3), **payload}
+        self.write_raw(rec)
+        return rec
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,170 @@
+"""Device/step counters: percentile histograms, memory stats, loop clocks.
+
+The pre-telemetry loggers reported only means (``StepTimer.steps_per_sec``);
+a flapping tunnelled backend hides multi-second stalls inside a good-looking
+mean, so everything here reports p50/p95/max as well. :class:`StepClock` is
+the shared train-loop instrumentation: the first dispatch of a run is the
+compile+first-execute step and is recorded separately; subsequent steps
+accumulate into steady-state (and host-transfer) histograms flushed as one
+``counters`` record per epoch, alongside a device-memory snapshot and the
+persistent-compile-cache hit/miss counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+from typing import Iterator
+
+from qdml_tpu.telemetry import spans as _spans
+
+
+class Histogram:
+    """Streaming duration collector; summarizes as p50/p95/max (ms)."""
+
+    __slots__ = ("_vals",)
+
+    def __init__(self):
+        self._vals: list[float] = []
+
+    def add(self, seconds: float) -> None:
+        self._vals.append(seconds)
+
+    def __len__(self) -> int:
+        return len(self._vals)
+
+    def reset(self) -> None:
+        self._vals = []
+
+    def summary(self) -> dict | None:
+        """``{"n", "mean_ms", "p50_ms", "p95_ms", "max_ms"}`` or None if empty."""
+        if not self._vals:
+            return None
+        v = sorted(self._vals)
+
+        def pct(p: float) -> float:
+            return v[min(len(v) - 1, int(round(p / 100.0 * (len(v) - 1))))]
+
+        ms = lambda s: round(s * 1e3, 3)  # noqa: E731
+        return {
+            "n": len(v),
+            "mean_ms": ms(sum(v) / len(v)),
+            "p50_ms": ms(pct(50)),
+            "p95_ms": ms(pct(95)),
+            "max_ms": ms(v[-1]),
+        }
+
+
+def device_memory_snapshot() -> dict | None:
+    """Live-buffer count + per-device memory stats where the backend exposes
+    them (``memory_stats()`` is None on CPU; fields degrade to absent, the
+    snapshot itself never raises). None when jax was never imported."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    out: dict = {"devices": []}
+    try:
+        out["live_arrays"] = len(jax.live_arrays())
+    except Exception:
+        pass
+    try:
+        devs = jax.local_devices()
+    except Exception:
+        return out
+    for d in devs:
+        ent: dict = {"id": d.id, "kind": getattr(d, "device_kind", "?")}
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if k in stats:
+                    ent[k] = stats[k]
+        out["devices"].append(ent)
+    return out
+
+
+class _StepCtx:
+    """Handle yielded by :meth:`StepClock.step`; ``transfer()`` marks where
+    dispatch ends and the host transfer/sync begins."""
+
+    __slots__ = ("t_transfer",)
+
+    def __init__(self):
+        self.t_transfer: float | None = None
+
+    def transfer(self) -> None:
+        self.t_transfer = time.perf_counter()
+
+
+class StepClock:
+    """Per-loop step timing: compile vs steady state vs host transfer.
+
+    >>> clock = StepClock("hdce_train")
+    >>> with clock.step() as st:
+    ...     state, m = train_step(state, batch)   # dispatch
+    ...     st.transfer()                         # host transfer starts here
+    ...     loss = float(m["loss"])
+    >>> clock.epoch_end(epoch=0)                  # one counters record
+
+    The first ``step()`` of the clock's life is the compile+first-execute
+    dispatch: recorded as ``compile_s`` (and a ``compile_first_step`` span),
+    excluded from the steady-state histogram. With async dispatch the
+    pre-``transfer()`` segment is enqueue time and the transfer segment
+    carries the device execution being waited on — exactly the host-side
+    stall structure the tunnelled backend needs watched.
+    """
+
+    def __init__(self, name: str, sink=None):
+        self.name = name
+        self._sink = sink
+        self.compile_s: float | None = None
+        self.steps = Histogram()
+        self.transfers = Histogram()
+
+    def _target(self):
+        return self._sink if self._sink is not None else _spans.get_sink()
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator[_StepCtx]:
+        ctx = _StepCtx()
+        t0 = time.perf_counter()
+        yield ctx
+        t1 = time.perf_counter()
+        if self.compile_s is None:
+            self.compile_s = t1 - t0
+            target = self._target()
+            if target is not None and getattr(target, "active", False):
+                target.emit(
+                    "span",
+                    name="compile_first_step",
+                    path=f"{self.name}/compile_first_step",
+                    depth=0,
+                    dur_s=round(self.compile_s, 6),
+                )
+        else:
+            self.steps.add(t1 - t0)
+            if ctx.t_transfer is not None:
+                self.transfers.add(t1 - ctx.t_transfer)
+
+    def epoch_end(self, **tags) -> None:
+        """Flush one ``counters`` record (step/transfer percentiles, memory
+        snapshot, compile-cache hits/misses) and reset the histograms."""
+        target = self._target()
+        if target is not None and getattr(target, "active", False):
+            from qdml_tpu.utils.compile_cache import compile_cache_stats
+
+            target.emit(
+                "counters",
+                name=self.name,
+                compile_s=round(self.compile_s, 6) if self.compile_s else None,
+                step=self.steps.summary(),
+                host_transfer=self.transfers.summary(),
+                memory=device_memory_snapshot(),
+                compile_cache=compile_cache_stats(),
+                **tags,
+            )
+        self.steps.reset()
+        self.transfers.reset()

@@ -1,0 +1,134 @@
+"""Run manifests: the provenance header every telemetry artifact starts with.
+
+Ad-hoc result JSONs have repeatedly lost the knobs that produced them (the
+r5 scan A/B records carried no ``rng_impl``/``trig_impl``; the pre-round-3
+bench artifacts conflated two baseline scales). The manifest makes that class
+of omission structural: config + content hash, git SHA, JAX/device topology,
+the effective perf knobs, and the seeds, captured once at startup and written
+as the first line of the run's JSONL.
+
+jax is only touched if ``include_jax`` (and then lazily), so the bench
+parent — which must never import jax (see ``bench.py``'s probe design) — can
+still stamp host-side manifests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Sequence
+
+SCHEMA_VERSION = 1
+
+
+def config_hash(cfg: Any) -> str:
+    """Stable 16-hex content hash of a (nested) config dataclass or dict."""
+    d = dataclasses.asdict(cfg) if dataclasses.is_dataclass(cfg) else cfg
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def effective_knobs(cfg: Any) -> dict:
+    """The performance-relevant knobs whose omission has bitten before."""
+    return {
+        "rng_impl": cfg.data.rng_impl,
+        "trig_impl": cfg.data.trig_impl,
+        "moments_dtype": cfg.train.moments_dtype,
+        "scan_steps": cfg.train.scan_steps,
+        "optimizer": cfg.train.optimizer,
+        "model_dtype": cfg.model.dtype,
+        "conv_impl": cfg.model.conv_impl,
+        "quantum_backend": cfg.quantum.backend,
+        "mesh": {
+            "data_axis": cfg.mesh.data_axis,
+            "model_axis": cfg.mesh.model_axis,
+            "fed_axis": cfg.mesh.fed_axis,
+        },
+    }
+
+
+def _git_info() -> dict | None:
+    """Best-effort repo SHA + dirty flag; None outside a usable git checkout."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=5,
+            cwd=root,
+        )
+        if sha.returncode != 0:
+            return None
+        status = subprocess.run(
+            ["git", "status", "--porcelain"],
+            capture_output=True,
+            text=True,
+            timeout=5,
+            cwd=root,
+        )
+        return {
+            "sha": sha.stdout.strip(),
+            "dirty": bool(status.stdout.strip()) if status.returncode == 0 else None,
+        }
+    except Exception:
+        return None
+
+
+def _jax_info() -> dict:
+    """JAX/device topology; errors degrade to a structured record, never raise."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        return {
+            "version": jax.__version__,
+            "backend": jax.default_backend(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "device_count": len(devs),
+            "local_device_count": jax.local_device_count(),
+            "device_kinds": sorted({d.device_kind for d in devs}),
+        }
+    except Exception as e:  # noqa: BLE001 — a manifest must never kill a run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def run_manifest(
+    cfg: Any = None,
+    argv: Sequence[str] | None = None,
+    include_jax: bool = True,
+    extra: dict | None = None,
+) -> dict:
+    """Build the run-manifest record (``kind: "manifest"``).
+
+    ``cfg`` (an :class:`qdml_tpu.config.ExperimentConfig`) adds the config
+    hash, effective knobs, seeds and the full config dump. ``include_jax=False``
+    keeps the manifest jax-free for host-side tools.
+    """
+    man: dict = {
+        "kind": "manifest",
+        "schema": SCHEMA_VERSION,
+        "ts": round(time.time(), 3),
+        "argv": list(argv) if argv is not None else None,
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "python": sys.version.split()[0],
+        "git": _git_info(),
+        "jax": _jax_info() if include_jax else None,
+    }
+    if cfg is not None:
+        man["name"] = getattr(cfg, "name", None)
+        man["config_hash"] = config_hash(cfg)
+        man["knobs"] = effective_knobs(cfg)
+        man["seeds"] = {"data": cfg.data.seed, "train": cfg.train.seed}
+        man["config"] = dataclasses.asdict(cfg)
+    if extra:
+        man.update(extra)
+    return man

@@ -1,0 +1,290 @@
+"""``qdml-tpu report``: regression-aware markdown summary over telemetry files.
+
+Loads one or more *current* artifacts (telemetry/metrics JSONL with a manifest
+header, a bench one-line record, a committed ``results/bench_tpu_*.json``, or
+a driver ``BENCH_rNN.json`` wrapper) plus one *baseline* artifact, extracts
+every throughput metric both sides share, and emits a markdown delta table.
+Exits nonzero (:data:`EXIT_REGRESSION`) when any shared metric regressed by
+more than the threshold — the CI gate future TPU sessions run before
+promoting a headline.
+
+Platform honesty: a cpu_fallback artifact is not comparable to a tpu-* one
+(the r4 "206-vs-451 sps regression" was host contention, not code); when the
+two sides ran on different platforms the deltas are still reported but the
+gate is disarmed, with a note saying so.
+
+Usage (via the CLI):
+
+    python -m qdml_tpu.cli report --current=PATH[,PATH...] --baseline=PATH \
+        [--threshold=10] [--out=report.md]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+EXIT_OK = 0
+EXIT_USAGE = 2
+EXIT_REGRESSION = 3
+
+DEFAULT_THRESHOLD_PCT = 10.0
+
+
+def _iter_objs(path: str) -> list[Any]:
+    """Parse a file as one JSON value or as JSONL; skip unparseable lines."""
+    with open(path) as fh:
+        text = fh.read().strip()
+    if not text:
+        return []
+    try:
+        return [json.loads(text)]
+    except json.JSONDecodeError:
+        pass
+    objs = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            objs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return objs
+
+
+def _record_from(obj: dict) -> dict | None:
+    """A bench-style record from a raw object, unwrapping driver wrappers."""
+    if "metric" in obj and "value" in obj:
+        return obj
+    parsed = obj.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    tail = obj.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                return cand
+    return None
+
+
+def extract(path: str) -> dict:
+    """Pull ``{manifest, record, throughput, platform}`` out of one artifact."""
+    src: dict = {
+        "path": path,
+        "manifest": None,
+        "record": None,
+        "throughput": {},
+        "platform": None,
+    }
+    for obj in _iter_objs(path):
+        if not isinstance(obj, dict):
+            continue
+        if obj.get("kind") == "manifest":
+            # last wins: an appended/resumed stream carries one manifest per
+            # invocation, and the last record belongs to the last invocation
+            src["manifest"] = obj
+            continue
+        rec = _record_from(obj)
+        if rec is not None:
+            src["record"] = rec  # last record in the stream wins
+    rec = src["record"]
+    if rec is not None:
+        src["platform"] = rec.get("platform")
+        if isinstance(rec.get("value"), (int, float)):
+            src["throughput"][rec.get("metric") or "value"] = float(rec["value"])
+        for key, d in (rec.get("details") or {}).items():
+            if isinstance(d, dict) and isinstance(d.get("samples_per_sec"), (int, float)):
+                src["throughput"][f"{key}.samples_per_sec"] = float(d["samples_per_sec"])
+    return src
+
+
+def _manifest_line(src: dict) -> str | None:
+    man = src.get("manifest")
+    if not man:
+        return None
+    jx = man.get("jax") or {}
+    bits = []
+    if man.get("config_hash"):
+        bits.append(f"config `{man['config_hash']}`")
+    if man.get("git"):
+        sha = man["git"].get("sha", "")[:12]
+        bits.append(f"git `{sha}`" + ("*" if man["git"].get("dirty") else ""))
+    if jx.get("backend"):
+        bits.append(
+            f"{jx.get('device_count', '?')}x {jx.get('backend')} "
+            f"({jx.get('process_count', 1)} proc)"
+        )
+    knobs = man.get("knobs")
+    if knobs:
+        bits.append(
+            "knobs rng={rng_impl}/trig={trig_impl}/moments={moments_dtype}".format(**knobs)
+        )
+    if not bits:
+        return None
+    return f"  - manifest `{os.path.basename(src['path'])}`: " + ", ".join(bits)
+
+
+def build_report(
+    current_paths: list[str],
+    baseline_path: str,
+    threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+) -> tuple[str, list[dict], bool]:
+    """Returns ``(markdown, regressions, gate_armed)``.
+
+    ``regressions`` lists every shared metric whose current value sits more
+    than ``threshold_pct`` percent below the baseline; ``gate_armed`` is False
+    when the two sides ran on different platforms (deltas reported, exit code
+    not gated)."""
+    base = extract(baseline_path)
+    curs = [extract(p) for p in current_paths]
+    cur_tp: dict[str, float] = {}
+    for c in curs:
+        cur_tp.update(c["throughput"])
+    # Platform resolution must match the value resolution (later files win a
+    # shared metric, so the later file's platform labels the merged set);
+    # heterogeneous current platforms disarm the gate below.
+    cur_platforms = [c["platform"] for c in curs if c["platform"]]
+    cur_platform = cur_platforms[-1] if cur_platforms else None
+
+    lines = [
+        "# qdml-tpu telemetry report",
+        "",
+        f"- baseline: `{baseline_path}`"
+        + (f" (platform {base['platform']})" if base["platform"] else ""),
+        "- current: " + ", ".join(f"`{p}`" for p in current_paths)
+        + (f" (platform {cur_platform})" if cur_platform else ""),
+        f"- regression threshold: {threshold_pct:g}%",
+    ]
+    for src in [base] + curs:
+        man_line = _manifest_line(src)
+        if man_line:
+            lines.append(man_line)
+    lines.append("")
+
+    regressions: list[dict] = []
+    gate_armed = True
+    if len(set(cur_platforms)) > 1:
+        gate_armed = False
+        lines.append(
+            f"> **note**: current artifacts span platforms {sorted(set(cur_platforms))} "
+            "— merged deltas are not attributable to one platform, regression "
+            "gate disarmed."
+        )
+        lines.append("")
+    elif base["platform"] and cur_platform and base["platform"] != cur_platform:
+        gate_armed = False
+        lines.append(
+            f"> **note**: platform mismatch (baseline {base['platform']} vs "
+            f"current {cur_platform}) — deltas shown, regression gate disarmed "
+            "(cross-platform throughput ratios compare hardware/contention, "
+            "not code)."
+        )
+        lines.append("")
+
+    if not base["throughput"]:
+        lines.append(
+            "_baseline carries no throughput metrics (nothing to gate; "
+            "e.g. a targets-only BASELINE.json)._"
+        )
+        return "\n".join(lines), regressions, gate_armed
+    if not cur_tp:
+        # A baseline with numbers and a current run that measured NOTHING is
+        # a gate failure, not a pass: the fully-errored bench path still
+        # writes a record (value null, error-only details), and CI must not
+        # promote it. Armed regardless of platform tags — "nothing measured"
+        # is a failure on any hardware.
+        lines.append(
+            "_current artifacts carry no throughput metrics — **gate fails**: "
+            "an all-errored run cannot demonstrate the absence of a "
+            "regression._"
+        )
+        regressions.append(
+            {"metric": "(no throughput measured)", "baseline": None,
+             "current": None, "delta_pct": None}
+        )
+        return "\n".join(lines), regressions, True
+
+    lines += [
+        "| metric | baseline | current | delta | status |",
+        "|---|---|---|---|---|",
+    ]
+    for key in sorted(set(base["throughput"]) | set(cur_tp)):
+        b = base["throughput"].get(key)
+        c = cur_tp.get(key)
+        if b is None or c is None:
+            only = "current-only" if b is None else "baseline-only"
+            lines.append(
+                f"| {key} | {'—' if b is None else f'{b:g}'} | "
+                f"{'—' if c is None else f'{c:g}'} | — | {only} |"
+            )
+            continue
+        delta_pct = (c - b) / b * 100.0 if b else float("inf")
+        if delta_pct < -threshold_pct:
+            status = "**REGRESSION**"
+            regressions.append(
+                {"metric": key, "baseline": b, "current": c, "delta_pct": round(delta_pct, 2)}
+            )
+        elif delta_pct > threshold_pct:
+            status = "improved"
+        else:
+            status = "ok"
+        lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status} |")
+
+    lines.append("")
+    if regressions:
+        lines.append(
+            f"**{len(regressions)} metric(s) regressed beyond {threshold_pct:g}%**"
+            + ("" if gate_armed else " (gate disarmed: platform mismatch)")
+        )
+    else:
+        lines.append("No regressions beyond threshold.")
+    return "\n".join(lines), regressions, gate_armed
+
+
+def report_main(argv: list[str]) -> int:
+    """CLI entry: parse ``--current/--baseline/--threshold/--out``, print the
+    markdown, return the gate's exit code."""
+    currents: list[str] = []
+    baseline: str | None = None
+    threshold = DEFAULT_THRESHOLD_PCT
+    out: str | None = None
+    for arg in argv:
+        if arg.startswith("--current="):
+            currents += [p for p in arg.split("=", 1)[1].split(",") if p]
+        elif arg.startswith("--baseline="):
+            baseline = arg.split("=", 1)[1]
+        elif arg.startswith("--threshold="):
+            raw = arg.split("=", 1)[1]
+            try:
+                threshold = float(raw)
+            except ValueError:
+                print(f"report: --threshold must be a number, got {raw!r}")
+                return EXIT_USAGE
+        elif arg.startswith("--out="):
+            out = arg.split("=", 1)[1]
+        else:
+            print(f"report: unrecognised argument {arg!r}")
+            return EXIT_USAGE
+    if not currents or baseline is None:
+        print(
+            "usage: qdml-tpu report --current=PATH[,PATH...] --baseline=PATH "
+            "[--threshold=PCT] [--out=FILE.md]"
+        )
+        return EXIT_USAGE
+    for p in currents + [baseline]:
+        if not os.path.exists(p):
+            print(f"report: no such file {p!r}")
+            return EXIT_USAGE
+    md, regressions, gate_armed = build_report(currents, baseline, threshold)
+    print(md)
+    if out:
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as fh:
+            fh.write(md + "\n")
+    return EXIT_REGRESSION if (regressions and gate_armed) else EXIT_OK

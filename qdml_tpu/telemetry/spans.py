@@ -1,0 +1,108 @@
+"""Span tracer: nested wall-clock timing with a jax.profiler bridge.
+
+``with span("compile"): ...`` times the enclosed block and appends one
+``span`` record at exit (children close before parents, so a reader can
+reconstruct the tree from ``path``/``depth``). Records go to the explicit
+``sink`` if given, else to the process-global sink (:func:`set_sink`, wired
+by the CLI to the run's telemetry file); with neither, spans cost two
+``perf_counter`` calls and write nothing — library callers stay clean.
+
+Multihost: every process measures, only the primary's sink writes
+(``core.Telemetry``); records carry the writing process's index.
+
+Bridge: when jax is already imported, each span also opens a
+``jax.profiler.TraceAnnotation``, so spans show up as named regions inside
+any active profiler trace (``profiler_trace`` below / ``cli profile``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from typing import Iterator
+
+_local = threading.local()
+_sink = None
+
+
+def set_sink(sink) -> None:
+    """Install the process-global span/counter sink (a ``Telemetry``), or
+    ``None`` to detach."""
+    global _sink
+    _sink = sink
+
+
+def get_sink():
+    return _sink
+
+
+def _stack() -> list[str]:
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+def _process_index() -> int | None:
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.process_index()
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def span(name: str, sink=None, **tags) -> Iterator[None]:
+    """Time a block; emit one nested ``span`` record at exit."""
+    st = _stack()
+    st.append(name)
+    path = "/".join(st)
+    bridge = contextlib.nullcontext()
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            bridge = jax.profiler.TraceAnnotation(name)
+        except Exception:
+            pass
+    t_wall = time.time()
+    t0 = time.perf_counter()
+    try:
+        with bridge:
+            yield
+    finally:
+        dur = time.perf_counter() - t0
+        st.pop()
+        target = sink if sink is not None else _sink
+        if target is not None and getattr(target, "active", False):
+            rec = {
+                "kind": "span",
+                "ts": round(t_wall, 3),
+                "name": name,
+                "path": path,
+                "depth": len(st),
+                "dur_s": round(dur, 6),
+                **tags,
+            }
+            proc = _process_index()
+            if proc is not None:
+                rec["process"] = proc
+            target.write_raw(rec)
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: str, sink=None) -> Iterator[None]:
+    """``jax.profiler`` trace of the enclosed device work, wrapped in a span
+    (so the telemetry stream records that — and how long — a trace ran, and
+    inner spans annotate the trace's timeline)."""
+    import jax
+
+    with span("jax_profiler_trace", sink=sink, logdir=logdir):
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()

@@ -97,7 +97,11 @@ def restore_checkpoint(workdir: str, tag: str, target: Any | None = None) -> tup
     if target is not None:
         restored = ckptr.restore(path, target)
     else:
-        meta_tree = ckptr.metadata(path).item_metadata.tree
+        # orbax >=0.9 wraps the per-array metadata (.item_metadata.tree);
+        # 0.7.x returns the metadata tree directly. Both leaves carry
+        # shape/dtype, which is all the zeros-target needs.
+        md = ckptr.metadata(path)
+        meta_tree = md.item_metadata.tree if hasattr(md, "item_metadata") else md
         restored = ckptr.restore(
             path, jax.tree.map(lambda m: np.zeros(m.shape, m.dtype), meta_tree)
         )

@@ -25,6 +25,7 @@ from qdml_tpu.models.cnn import DCEP128, activation_dtype
 from qdml_tpu.models.losses import nmse_loss
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
+from qdml_tpu.telemetry import StepClock, span
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
 
@@ -133,27 +134,36 @@ def train_dce(
     if scan_eligible(cfg, None, train_loader, logger):
         scan_run = make_dce_scan_steps(model, geom)
 
+    clock = StepClock("dce_train")
     history: dict[str, list] = {"train_loss": [], "val_nmse": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
-        if scan_run is not None:
-            seed = jnp.uint32(cfg.data.seed)
-            scen, user = train_loader.grid_coords
-            for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
-                state, ms = scan_run(state, seed, scen, user, idx, snrs)
-                tot = tot + float(jnp.sum(ms["loss"]))
-                n += idx.shape[0]
-        else:
-            for batch in train_loader.epoch(epoch):
-                state, m = train_step(state, batch)
-                tot, n = tot + float(m["loss"]), n + 1
+        with span("train_epoch", epoch=epoch):
+            if scan_run is not None:
+                seed = jnp.uint32(cfg.data.seed)
+                scen, user = train_loader.grid_coords
+                for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
+                    with clock.step() as st:
+                        state, ms = scan_run(state, seed, scen, user, idx, snrs)
+                        st.transfer()
+                        tot = tot + float(jnp.sum(ms["loss"]))
+                    n += idx.shape[0]
+            else:
+                for batch in train_loader.epoch(epoch):
+                    with clock.step() as st:
+                        state, m = train_step(state, batch)
+                        st.transfer()
+                        tot = tot + float(m["loss"])
+                    n += 1
+        clock.epoch_end(epoch=epoch)
         train_loss = tot / max(n, 1)
 
         sums = {"err": 0.0, "pow": 0.0}
-        for batch in val_loader.epoch(epoch, shuffle=False):
-            out = eval_step(state, batch)
-            for k in sums:
-                sums[k] += float(out[k])
+        with span("val_epoch", epoch=epoch):
+            for batch in val_loader.epoch(epoch, shuffle=False):
+                out = eval_step(state, batch)
+                for k in sums:
+                    sums[k] += float(out[k])
         val_nmse = sums["err"] / max(sums["pow"], 1e-30)
         history["train_loss"].append(train_loss)
         history["val_nmse"].append(val_nmse)

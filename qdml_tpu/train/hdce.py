@@ -46,6 +46,7 @@ from qdml_tpu.models.cnn import FCP128, StackedConvP128, activation_dtype
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
 from qdml_tpu.train.scan import make_scan_steps, scan_eligible
+from qdml_tpu.telemetry import StepClock, span
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
 
@@ -235,34 +236,47 @@ def train_hdce(
     if scan_eligible(cfg, mesh, train_loader, logger):
         scan_run = make_hdce_scan_steps(model, geom, mesh=mesh, fed=fed)
 
+    # Telemetry (events reach the CLI-installed global sink, or the logger's
+    # own stream when bound): per-epoch train/val spans plus a StepClock
+    # separating compile vs steady-state vs host-transfer time per dispatch.
+    clock = StepClock("hdce_train")
     history: dict[str, list] = {"train_loss": [], "val_nmse": [], "val_nmse_perf": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
-        if scan_run is not None:
-            seed = jnp.uint32(cfg.data.seed)
-            scen, user = train_loader.grid_coords
-            for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
-                state, ms = scan_run(state, seed, scen, user, idx, snrs)
-                # one bulk transfer for the (K,) loss vector — K separate
-                # float() calls would reintroduce the per-step host round
-                # trips the scan dispatch just removed
-                losses = np.asarray(jax.device_get(ms["loss"]))
-                tot, n = tot + float(losses.sum()), n + losses.size
-                if (n // scan_k) % max(cfg.train.print_freq // scan_k, 1) == 0:
-                    logger.log(step=int(state.step), epoch=epoch, loss=float(losses[-1]))
-        else:
-            for batch in train_loader.epoch(epoch):
-                state, m = train_step(state, place_train(batch))
-                tot, n = tot + float(m["loss"]), n + 1
-                if n % cfg.train.print_freq == 0:
-                    logger.log(step=int(state.step), epoch=epoch, loss=float(m["loss"]))
+        with span("train_epoch", epoch=epoch):
+            if scan_run is not None:
+                seed = jnp.uint32(cfg.data.seed)
+                scen, user = train_loader.grid_coords
+                for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
+                    with clock.step() as st:
+                        state, ms = scan_run(state, seed, scen, user, idx, snrs)
+                        # one bulk transfer for the (K,) loss vector — K
+                        # separate float() calls would reintroduce the
+                        # per-step host round trips the scan dispatch just
+                        # removed
+                        st.transfer()
+                        losses = np.asarray(jax.device_get(ms["loss"]))
+                    tot, n = tot + float(losses.sum()), n + losses.size
+                    if (n // scan_k) % max(cfg.train.print_freq // scan_k, 1) == 0:
+                        logger.log(step=int(state.step), epoch=epoch, loss=float(losses[-1]))
+            else:
+                for batch in train_loader.epoch(epoch):
+                    with clock.step() as st:
+                        state, m = train_step(state, place_train(batch))
+                        st.transfer()
+                        loss = float(m["loss"])
+                    tot, n = tot + loss, n + 1
+                    if n % cfg.train.print_freq == 0:
+                        logger.log(step=int(state.step), epoch=epoch, loss=loss)
+        clock.epoch_end(epoch=epoch)
         train_loss = tot / max(n, 1)
 
         sums = {"err": 0.0, "pow": 0.0, "err_perf": 0.0, "pow_perf": 0.0}
-        for batch in val_loader.epoch(epoch, shuffle=False):
-            out = eval_step(state, place_val(batch))
-            for k in sums:
-                sums[k] += float(out[k])
+        with span("val_epoch", epoch=epoch):
+            for batch in val_loader.epoch(epoch, shuffle=False):
+                out = eval_step(state, place_val(batch))
+                for k in sums:
+                    sums[k] += float(out[k])
         val_nmse = sums["err"] / max(sums["pow"], 1e-30)
         val_perf = sums["err_perf"] / max(sums["pow_perf"], 1e-30)
         history["train_loss"].append(train_loss)

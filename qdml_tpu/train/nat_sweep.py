@@ -37,6 +37,7 @@ from qdml_tpu.train.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
+from qdml_tpu.telemetry import StepClock, span
 from qdml_tpu.train.optim import get_optimizer
 from qdml_tpu.utils.metrics import MetricsLogger
 
@@ -284,39 +285,49 @@ def train_nat_sweep(
     if scan_eligible(cfg, mesh, train_loader, logger):
         scan_run = make_sweep_scan_steps(model, tx, sigmas, geom, mesh=mesh)
 
+    clock = StepClock("nat_sweep_train")
     history = {"train_loss": [], "val_loss": [], "val_acc": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         rng = jax.random.fold_in(base_rng, epoch)
         tot = np.zeros(n_members)
         n = 0
-        if scan_run is not None:
-            seed = jnp.uint32(cfg.data.seed)
-            scen, user = train_loader.grid_coords
-            for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
-                rng, subs = presplit_keys(rng, idx.shape[0])
-                member_keys = jax.vmap(lambda s: jax.random.split(s, n_members))(subs)
-                (params, opt_state), ms = scan_run(
-                    (params, opt_state), seed, scen, user, idx, snrs, member_keys
-                )
-                tot += np.asarray(ms["loss"]).sum(0)
-                n += idx.shape[0]
-        else:
-            for batch in train_loader.epoch(epoch):
-                rng, sub = jax.random.split(rng)
-                rngs = jax.random.split(sub, n_members)
-                params, opt_state, losses = train_step(params, opt_state, rngs, sigmas, place_train(batch))
-                tot += np.asarray(losses)
-                n += 1
+        with span("train_epoch", epoch=epoch):
+            if scan_run is not None:
+                seed = jnp.uint32(cfg.data.seed)
+                scen, user = train_loader.grid_coords
+                for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
+                    rng, subs = presplit_keys(rng, idx.shape[0])
+                    member_keys = jax.vmap(lambda s: jax.random.split(s, n_members))(subs)
+                    with clock.step() as st:
+                        (params, opt_state), ms = scan_run(
+                            (params, opt_state), seed, scen, user, idx, snrs, member_keys
+                        )
+                        st.transfer()
+                        tot += np.asarray(ms["loss"]).sum(0)
+                    n += idx.shape[0]
+            else:
+                for batch in train_loader.epoch(epoch):
+                    rng, sub = jax.random.split(rng)
+                    rngs = jax.random.split(sub, n_members)
+                    with clock.step() as st:
+                        params, opt_state, losses = train_step(
+                            params, opt_state, rngs, sigmas, place_train(batch)
+                        )
+                        st.transfer()
+                        tot += np.asarray(losses)
+                    n += 1
+        clock.epoch_end(epoch=epoch)
         train_loss = tot / max(n, 1)
 
         vloss = np.zeros(n_members)
         vacc = np.zeros(n_members)
         vn = 0
-        for batch in val_loader.epoch(epoch, shuffle=False):
-            losses, accs = eval_step(params, place_val(batch))
-            vloss += np.asarray(losses)
-            vacc += np.asarray(accs)
-            vn += 1
+        with span("val_epoch", epoch=epoch):
+            for batch in val_loader.epoch(epoch, shuffle=False):
+                losses, accs = eval_step(params, place_val(batch))
+                vloss += np.asarray(losses)
+                vacc += np.asarray(accs)
+                vn += 1
         vloss /= max(vn, 1)
         vacc /= max(vn, 1)
         history["train_loss"].append(train_loss)

@@ -26,25 +26,37 @@ def scale_by_adam_lowp(
     b2: float = 0.999,
     eps: float = 1e-8,
     moments_dtype=jnp.bfloat16,
+    nu_dtype=jnp.float32,
 ) -> optax.GradientTransformation:
-    """Adam moment estimation with the (m, v) trees STORED in a low dtype.
+    """Adam moment estimation with the first-moment tree STORED in a low dtype.
 
     The Adam update of a large weight is HBM-bandwidth-bound, and two of the
     four trees it streams are the moments (measured on v5e: the fused
     head-weight grad+update runs at ~730 GB/s ~ HBM peak,
-    results/perf_r5/scan_rbg.trace.json.gz). Storing m and v in bfloat16
-    halves that traffic. All arithmetic — decay, square, bias correction,
-    rsqrt — runs in f32; only the carried state is rounded, so the update
-    direction matches f32 Adam to ~bf16 rounding of the moments (test:
-    tests/test_train.py::test_adam_lowp_matches_f32).
+    results/perf_r5/scan_rbg.trace.json.gz). Storing mu in bfloat16 cuts a
+    quarter of that traffic. All arithmetic — decay, square, bias correction,
+    rsqrt — runs in f32; only the carried state is rounded.
+
+    The second moment nu stays in ``nu_dtype`` (f32 by default, ADVICE r5
+    medium): nu's per-step relative change is (1-b2) = 1e-3, below the bf16
+    half-ulp (~4e-3), so a bf16-stored nu EMA cannot decay — ``b2*v +
+    (1-b2)*g^2`` rounds back to ``v`` whenever ``g^2`` is within ~5x of
+    ``v``, and nu only ratchets up on spikes, suppressing the effective step
+    size long after gradients shrink. mu's (1-b1) = 0.1 per-step change is
+    well above bf16 ulp, so its EMA tracks fine. Long-horizon observation:
+    ``tests/test_train.py::test_adam_lowp_nu_tracks_decaying_gradients``;
+    per-step agreement: ``test_adam_lowp_matches_f32``.
     """
 
     def init(params):
-        zeros = lambda p: jnp.zeros_like(p, dtype=moments_dtype)
         return optax.ScaleByAdamState(
             count=jnp.zeros([], jnp.int32),
-            mu=jax.tree_util.tree_map(zeros, params),
-            nu=jax.tree_util.tree_map(zeros, params),
+            mu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=moments_dtype), params
+            ),
+            nu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=nu_dtype), params
+            ),
         )
 
     def update(grads, state, params=None):
@@ -56,7 +68,7 @@ def scale_by_adam_lowp(
             grads,
         )
         nu = jax.tree_util.tree_map(
-            lambda v, g: (b2 * f32(v) + (1.0 - b2) * g * g).astype(moments_dtype),
+            lambda v, g: (b2 * f32(v) + (1.0 - b2) * g * g).astype(nu_dtype),
             state.nu,
             grads,
         )
@@ -82,13 +94,31 @@ def lr_schedule(cfg: TrainConfig, steps_per_epoch: int) -> optax.Schedule:
     return sched
 
 
+_MOMENTS_DTYPES = ("float32", "bfloat16")
+
+
 def get_optimizer(
     cfg: TrainConfig,
     steps_per_epoch: int,
     quantum: QuantumConfig | None = None,
 ) -> optax.GradientTransformation:
     sched = lr_schedule(cfg, steps_per_epoch)
-    lowp = getattr(cfg, "moments_dtype", "float32") == "bfloat16"
+    moments = getattr(cfg, "moments_dtype", "float32")
+    # Same rejection contract as data.rng_impl (ADVICE r5 low): a typo like
+    # 'bf16' must not silently select the f32 path.
+    if moments not in _MOMENTS_DTYPES:
+        raise ValueError(
+            f"moments_dtype must be one of {_MOMENTS_DTYPES}, got {moments!r}"
+        )
+    lowp = moments == "bfloat16"
+    if lowp and cfg.optimizer != "adam":
+        import warnings
+
+        warnings.warn(
+            f"moments_dtype='bfloat16' applies only to optimizer='adam'; "
+            f"optimizer {cfg.optimizer!r} keeps float32 moments",
+            stacklevel=2,
+        )
     if cfg.optimizer == "adam":
         base = (
             optax.chain(scale_by_adam_lowp(), optax.scale_by_learning_rate(sched))

@@ -31,6 +31,7 @@ from qdml_tpu.models.qsc import QSCP128
 from qdml_tpu.quantum.circuits import resolve_backend
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
+from qdml_tpu.telemetry import StepClock, span
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger
 
@@ -179,29 +180,38 @@ def train_classifier(
     # Fold the start epoch into the QuantumNAT noise stream so resumed epochs
     # draw FRESH noise instead of replaying epochs 0..start_epoch-1's draws.
     rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), start_epoch)
+    clock = StepClock(f"{tag}_train")
     history: dict[str, list] = {"train_loss": [], "val_loss": [], "val_acc": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
-        if scan_run is not None:
-            seed = jnp.uint32(cfg.data.seed)
-            scen, user = train_loader.grid_coords
-            for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
-                rng, subs = presplit_keys(rng, idx.shape[0])
-                state, ms = scan_run(state, seed, scen, user, idx, snrs, subs)
-                tot = tot + float(jnp.sum(ms["loss"]))
-                n += idx.shape[0]
-        else:
-            for batch in train_loader.epoch(epoch):
-                rng, sub = jax.random.split(rng)
-                state, m = train_step(state, place_train(batch), sub)
-                tot, n = tot + float(m["loss"]), n + 1
+        with span("train_epoch", epoch=epoch):
+            if scan_run is not None:
+                seed = jnp.uint32(cfg.data.seed)
+                scen, user = train_loader.grid_coords
+                for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
+                    rng, subs = presplit_keys(rng, idx.shape[0])
+                    with clock.step() as st:
+                        state, ms = scan_run(state, seed, scen, user, idx, snrs, subs)
+                        st.transfer()
+                        tot = tot + float(jnp.sum(ms["loss"]))
+                    n += idx.shape[0]
+            else:
+                for batch in train_loader.epoch(epoch):
+                    rng, sub = jax.random.split(rng)
+                    with clock.step() as st:
+                        state, m = train_step(state, place_train(batch), sub)
+                        st.transfer()
+                        tot = tot + float(m["loss"])
+                    n += 1
+        clock.epoch_end(epoch=epoch)
         train_loss = tot / max(n, 1)
 
         sums = {"nll_sum": 0.0, "correct": 0.0, "count": 0.0}
-        for batch in val_loader.epoch(epoch, shuffle=False):
-            out = eval_step(state, place_val(batch))
-            for k in sums:
-                sums[k] += float(out[k])
+        with span("val_epoch", epoch=epoch):
+            for batch in val_loader.epoch(epoch, shuffle=False):
+                out = eval_step(state, place_val(batch))
+                for k in sums:
+                    sums[k] += float(out[k])
         val_loss = sums["nll_sum"] / max(sums["count"], 1)
         val_acc = sums["correct"] / max(sums["count"], 1)
         history["train_loss"].append(train_loss)

@@ -3,11 +3,50 @@
 One definition of the cache location/thresholds so bench.py,
 ``__graft_entry__`` and the test suite can never desynchronize (compile time
 dominates every cold run on both the 1-CPU driver host and the tunnelled TPU).
+
+Telemetry: enabling the cache also installs a ``jax.monitoring`` listener
+counting cache hits/misses/requests; :func:`compile_cache_stats` is the
+process-wide counter snapshot the telemetry layer folds into its per-epoch
+``counters`` records (a cold-cache run is a different measurement than a
+warm one — now the artifact says which).
 """
 
 from __future__ import annotations
 
 CACHE_DIR = "/tmp/qdml_jax_cache"
+
+_COUNTS = {"hits": 0, "misses": 0, "requests": 0}
+_LISTENING = False
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        _COUNTS["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        _COUNTS["misses"] += 1
+    elif event == "/jax/compilation_cache/compile_requests_use_cache":
+        _COUNTS["requests"] += 1
+
+
+def compile_cache_stats() -> dict:
+    """Snapshot of this process's compile-cache hit/miss/request counters
+    (all zero until :func:`enable_compile_cache` has installed the listener
+    and a jit compile has gone through the cache)."""
+    return dict(_COUNTS)
+
+
+def _install_listener() -> None:
+    global _LISTENING
+    if _LISTENING:
+        return
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        _LISTENING = True
+    except Exception:
+        # jax.monitoring moved/absent: the cache still works, counters stay 0.
+        pass
 
 
 def enable_compile_cache() -> None:
@@ -16,3 +55,4 @@ def enable_compile_cache() -> None:
     jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _install_listener()

@@ -9,23 +9,13 @@ whole-batch ratio ``sum((x_hat-x)**2)/sum(x**2)``
 
 from __future__ import annotations
 
-import json
 import math
-import os
 import time
-from typing import Any, IO
+from typing import Any
 
 import jax.numpy as jnp
 
-
-def _is_primary() -> bool:
-    """True on the single process that should write shared files."""
-    import jax
-
-    try:
-        return jax.process_index() == 0
-    except Exception:
-        return True
+from qdml_tpu.telemetry.core import is_primary as _is_primary  # noqa: F401 (compat)
 
 
 def nmse(x_hat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
@@ -43,30 +33,48 @@ def nmse_db(value: float) -> float:
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics stream + optional console echo."""
+    """Append-only JSONL metrics stream + optional console echo.
 
-    def __init__(self, path: str | None = None, echo: bool = True):
-        self._fh: IO[str] | None = None
+    Thin facade over :class:`qdml_tpu.telemetry.core.Telemetry` (multi-host:
+    only process 0 writes; every host runs the same loop, and concurrent
+    appends to a shared file would interleave). Metric records keep the
+    legacy bare shape (no ``kind`` field) so existing readers are untouched;
+    passing ``manifest`` (a :func:`qdml_tpu.telemetry.run_manifest` dict)
+    writes it as the stream's provenance header line.
+    """
+
+    def __init__(
+        self,
+        path: str | None = None,
+        echo: bool = True,
+        manifest: dict | None = None,
+    ):
+        from qdml_tpu.telemetry.core import Telemetry
+
+        self._tele = Telemetry(path, manifest=manifest)
         self.echo = echo
-        if path is not None and _is_primary():
-            # Multi-host: only process 0 writes (every host runs the same
-            # loop; concurrent appends to a shared file would interleave).
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            self._fh = open(path, "a", buffering=1)
+
+    @property
+    def telemetry(self):
+        """The underlying sink — spans/counters route through it too."""
+        return self._tele
+
+    def span(self, name: str, **tags):
+        """A :func:`qdml_tpu.telemetry.span` bound to this logger's stream."""
+        from qdml_tpu.telemetry.spans import span
+
+        return span(name, sink=self._tele, **tags)
 
     def log(self, step: int | None = None, **values: Any) -> None:
-        rec = {"ts": round(time.time(), 3)}
+        rec: dict[str, Any] = {"ts": round(time.time(), 3)}
         if step is not None:
             rec["step"] = step
         for k, v in values.items():
             rec[k] = float(v) if hasattr(v, "item") else v
-        if self._fh is not None:
-            self._fh.write(json.dumps(rec) + "\n")
+        self._tele.write_raw(rec)
         if self.echo:
             shown = {k: (round(v, 6) if isinstance(v, float) else v) for k, v in rec.items() if k != "ts"}
             print(" ".join(f"{k}={v}" for k, v in shown.items()), flush=True)
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        self._tele.close()

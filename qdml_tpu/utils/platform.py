@@ -53,6 +53,55 @@ def donation_argnums(*argnums: int) -> tuple[int, ...]:
     return () if jax.default_backend() == "cpu" else argnums
 
 
+_COMPAT_DONE = False
+
+
+def ensure_jax_compat() -> None:
+    """Backfill jax transformation rules this container's jax version lacks.
+
+    jax 0.4.37 ships ``lax.optimization_barrier`` without batching/JVP/
+    transpose rules (added upstream later), so any ``vmap``/``grad`` over
+    code using the barrier — the channel generator's anti-fusion barrier,
+    ``data/channels.py`` — raises NotImplementedError. The rules below are
+    the upstream ones (barrier each operand; identity-shaped through vmap,
+    barrier primals and tangents through jvp, barrier cotangents through
+    transpose); registration is a no-op on jax versions that already have
+    them. Idempotent and exception-safe: a moved private API degrades to
+    leaving jax exactly as it was.
+    """
+    global _COMPAT_DONE
+    if _COMPAT_DONE:
+        return
+    _COMPAT_DONE = True
+    try:
+        from jax._src.interpreters import ad, batching
+        from jax._src.lax.lax import optimization_barrier_p as p
+    except Exception:
+        return
+    try:
+        if p not in batching.primitive_batchers:
+
+            def _batch_rule(args, dims):
+                return p.bind(*args), dims
+
+            batching.primitive_batchers[p] = _batch_rule
+        if p not in ad.primitive_jvps:
+
+            def _jvp_rule(primals, tangents):
+                tangents = [ad.instantiate_zeros(t) for t in tangents]
+                return p.bind(*primals), p.bind(*tangents)
+
+            ad.primitive_jvps[p] = _jvp_rule
+        if p not in ad.primitive_transposes:
+
+            def _transpose_rule(cts, *primals):
+                return p.bind(*[ad.instantiate_zeros(ct) for ct in cts])
+
+            ad.primitive_transposes[p] = _transpose_rule
+    except Exception:
+        pass
+
+
 def force_cpu(n_virtual_devices: int | None = None) -> bool:
     """Pin the CPU platform (optionally with N virtual devices) if the
     backend choice is still open. Returns True when the pin was applied.

@@ -1,14 +1,17 @@
 """Tracing / profiling hooks (reference has none — SURVEY.md §5.1).
 
 The reference's only instrumentation is coarse wall-clock prints
-(``Runner_P128_QuantumNAT_onchipQNN.py:171-173, 437-440``). Here:
+(``Runner_P128_QuantumNAT_onchipQNN.py:171-173, 437-440``). Here — both now
+thin facades over :mod:`qdml_tpu.telemetry`:
 
 - :func:`trace` — context manager around ``jax.profiler`` producing a
-  TensorBoard-loadable trace of device execution (XLA ops, fusion, HBM),
+  TensorBoard-loadable trace of device execution (XLA ops, fusion, HBM);
+  telemetry spans opened inside it annotate the trace timeline,
 - :class:`StepTimer` — steady-state step timing with correct semantics for
   tunnelled backends (forces a host transfer; ``block_until_ready`` alone
   does not flush execution through the axon tunnel), reporting
-  samples/sec/chip — the BASELINE.json north-star metric.
+  samples/sec/chip — the BASELINE.json north-star metric — plus per-tick
+  interval percentiles (:meth:`StepTimer.histogram`).
 """
 
 from __future__ import annotations
@@ -19,15 +22,15 @@ from typing import Iterator
 
 import jax
 
+from qdml_tpu.telemetry.counters import Histogram
+from qdml_tpu.telemetry.spans import profiler_trace
+
 
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
     """``with trace('/tmp/trace'):`` — profile the enclosed device work."""
-    jax.profiler.start_trace(logdir)
-    try:
+    with profiler_trace(logdir):
         yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 def force(x) -> float:
@@ -45,6 +48,11 @@ class StepTimer:
     ...     out = step(...)
     ...     timer.tick(out)
     >>> timer.samples_per_sec(batch_size)
+
+    ``histogram()`` summarizes the timed tick-to-tick intervals as
+    p50/p95/max. With async dispatch these are dispatch intervals (enqueue
+    gaps backpressured by the device), not synced per-step device times —
+    the mean-rate denominator stays the single final sync, unchanged.
     """
 
     def __init__(self, warmup: int = 3):
@@ -54,6 +62,8 @@ class StepTimer:
         self._steps = 0
         self._last = None
         self._frozen: float | None = None
+        self._t_prev: float | None = self._t0
+        self._hist = Histogram()
 
     def tick(self, out=None) -> None:
         self._seen += 1
@@ -63,8 +73,13 @@ class StepTimer:
             if out is not None:
                 force(out)  # drain the pipeline before starting the clock
             self._t0 = time.perf_counter()
+            self._t_prev = self._t0
         elif self._seen > self.warmup:
             self._steps += 1
+            now = time.perf_counter()
+            if self._t_prev is not None:
+                self._hist.add(now - self._t_prev)
+            self._t_prev = now
 
     def elapsed(self) -> float:
         """Seconds over the timed steps; frozen at the first call after the
@@ -84,3 +99,7 @@ class StepTimer:
 
     def samples_per_sec(self, batch_size: int) -> float:
         return self.steps_per_sec() * batch_size
+
+    def histogram(self) -> dict | None:
+        """p50/p95/max (ms) of the timed tick intervals; None before any."""
+        return self._hist.summary()

@@ -40,8 +40,12 @@ enable_compile_cache()
 import jax  # noqa: E402
 
 if rank >= 0:
-    jax.distributed.initialize(
-        f"localhost:{port}",
+    # the production init path (selects Gloo CPU collectives on jax versions
+    # that default the option to "none")
+    from qdml_tpu.parallel.multihost import ensure_initialized
+
+    ensure_initialized(
+        coordinator_address=f"localhost:{port}",
         num_processes=NPROC,
         process_id=rank,
         local_device_ids=list(range(n_local)),
@@ -54,7 +58,9 @@ from qdml_tpu.config import (  # noqa: E402
     ModelConfig,
     TrainConfig,
 )
+from qdml_tpu.telemetry import run_manifest, set_sink  # noqa: E402
 from qdml_tpu.train.hdce import train_hdce  # noqa: E402
+from qdml_tpu.utils.metrics import MetricsLogger  # noqa: E402
 
 cfg = ExperimentConfig(
     data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=40, train_split=0.8),
@@ -62,7 +68,18 @@ cfg = ExperimentConfig(
     train=TrainConfig(batch_size=8, n_epochs=1, print_freq=1000),
     mesh=MeshConfig(fed_axis=3) if mode == "fed" else MeshConfig(),
 )
-_, history = train_hdce(cfg)
+# Telemetry through the production multi-host path: every rank constructs
+# the manifest-headed logger and routes spans/counters into it, but only the
+# primary (process 0) may create/write the file — the parent test asserts
+# exactly that.
+logger = MetricsLogger(
+    out_path + ".metrics.jsonl",
+    echo=False,
+    manifest=run_manifest(cfg, argv=["multihost_worker", mode, str(rank)]),
+)
+set_sink(logger.telemetry)
+_, history = train_hdce(cfg, logger=logger)
+logger.close()
 with open(out_path, "w") as fh:
     json.dump(
         {

@@ -123,6 +123,69 @@ def test_late_recovery_upgrades_to_tpu_with_floored_child_timeout(
     assert tpu_children and tpu_children[0][1] >= 1500
 
 
+def test_bench_writes_telemetry_jsonl_with_manifest_header(
+    benchmod, monkeypatch, tmp_path
+):
+    """--out writes the bench artifact as a telemetry JSONL: run-manifest
+    header line first (host-only fallback here — the stubbed child returns no
+    manifest), then the record — the shape `qdml-tpu report` consumes."""
+    out = tmp_path / "bench.jsonl"
+
+    def fake_child(env, platform, timeout_s):
+        return {
+            "backend": "cpu",
+            "devices": 1,
+            "hdce_f32": {"samples_per_sec": 100.0, "model_tflops": 1.0},
+        }
+
+    monkeypatch.setattr(benchmod, "probe_tpu", lambda **kw: "down")
+    monkeypatch.setattr(benchmod, "_run_bench_child", fake_child)
+    monkeypatch.setenv("QDML_BENCH_WALL_BUDGET_S", "1")
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--out", str(out)])
+    rc, rec = _run_main(benchmod)
+    assert rc == 0
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert lines[0]["kind"] == "manifest"
+    assert lines[1]["kind"] == "bench_record"
+    assert lines[1]["value"] == rec["value"]
+    # the JSONL round-trips through the report extractor
+    from qdml_tpu.telemetry.report import extract
+
+    src = extract(str(out))
+    assert src["manifest"] is not None
+    assert src["throughput"]["hdce_train_samples_per_sec_per_chip"] == rec["value"]
+
+
+def test_child_manifest_is_lifted_out_of_details(benchmod, monkeypatch, tmp_path):
+    """A child-provided manifest becomes the telemetry header and is removed
+    from the record's details."""
+    out = tmp_path / "bench.jsonl"
+
+    def fake_probe(attempts=None, timeout_s=None):
+        return None
+
+    def fake_child(env, platform, timeout_s):
+        return {
+            "backend": "tpu",
+            "devices": 1,
+            "manifest": {"kind": "manifest", "host": "tpu-vm"},
+            "hdce_bf16_scan": {
+                "samples_per_sec": 9e5,
+                "model_tflops": 60.0,
+                "scan_steps": 16,
+            },
+        }
+
+    monkeypatch.setattr(benchmod, "probe_tpu", fake_probe)
+    monkeypatch.setattr(benchmod, "_run_bench_child", fake_child)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--out", str(out)])
+    rc, rec = _run_main(benchmod)
+    assert rc == 0
+    assert "manifest" not in rec["details"]
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert lines[0] == {"kind": "manifest", "host": "tpu-vm"}
+
+
 def test_all_children_fail_yields_structured_error(benchmod, monkeypatch):
     monkeypatch.setattr(benchmod, "probe_tpu", lambda **kw: "down")
     monkeypatch.setattr(benchmod, "_run_bench_child", lambda *a, **kw: None)

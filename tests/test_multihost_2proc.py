@@ -91,7 +91,25 @@ def _run_cluster(mode: str, nproc: int, tmp_path):
         )
     log = open(ref_log).read()
     assert single.returncode == 0, f"{mode} single-process reference failed:\n{log[-3000:]}"
+    _assert_primary_writer_telemetry(outs)
     return [json.load(open(o)) for o in outs], json.load(open(ref_out))
+
+
+def _assert_primary_writer_telemetry(outs):
+    """Only rank 0 writes the telemetry stream, and its first line is a
+    manifest recording the real cluster topology."""
+    metrics = [o + ".metrics.jsonl" for o in outs]
+    assert os.path.exists(metrics[0]), "primary rank wrote no telemetry file"
+    with open(metrics[0]) as fh:
+        first = json.loads(fh.readline())
+        rest = [json.loads(ln) for ln in fh if ln.strip()]
+    assert first["kind"] == "manifest"
+    assert first["jax"]["process_count"] == len(outs)
+    assert first["jax"]["process_index"] == 0
+    kinds = {r.get("kind") for r in rest}
+    assert "span" in kinds and "counters" in kinds
+    for path in metrics[1:]:
+        assert not os.path.exists(path), f"non-primary rank wrote {path}"
 
 
 @pytest.mark.slow
