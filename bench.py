@@ -394,6 +394,58 @@ def _bench_qsc_scan(
     }
 
 
+def _bench_serve_infer(max_steps: int, budget_s: float, bucket: int = 64) -> dict:
+    """Request-path throughput of the online serving engine
+    (:mod:`qdml_tpu.serve`): one warmed full-bucket ``infer`` per iteration —
+    classify -> all-trunks -> top-1 route through a pre-compiled executable —
+    i.e. the saturated-batcher steady state. Random-init params: serving cost
+    is architecture-dependent, not weight-dependent. The record carries the
+    zero-request-path-compile gate alongside the rate."""
+    import numpy as np
+
+    from qdml_tpu.config import ExperimentConfig, ServeConfig, TrainConfig
+    from qdml_tpu.serve import ServeEngine
+    from qdml_tpu.telemetry import Histogram
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = ExperimentConfig(
+        train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
+        serve=ServeConfig(max_batch=bucket, buckets=(bucket,)),
+    )
+    _, hdce_state = init_hdce_state(cfg, steps_per_epoch=100)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=100)
+    engine = ServeEngine(cfg, hdce_vars, {"params": sc_state.params})
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    x = (
+        np.random.default_rng(0)
+        .standard_normal((bucket, *cfg.image_hw, 2))
+        .astype(np.float32)
+    )
+    # one probe sizes the loop (infer is synchronous: it device_gets results)
+    t0 = time.perf_counter()
+    engine.infer(x)
+    est = max(time.perf_counter() - t0, 1e-4)
+    n = max(3, min(max_steps, int(budget_s / est)))
+    hist = Histogram()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        t1 = time.perf_counter()
+        engine.infer(x)
+        hist.add(time.perf_counter() - t1)
+    wall = time.perf_counter() - t0
+    return {
+        "samples_per_sec": round(n * bucket / wall, 1),
+        "bucket": bucket,
+        "warmup_s": round(warmup_s, 3),
+        "batch_ms": hist.summary(),
+        "compile_cache_after_warmup": engine.request_path_compiles(),
+    }
+
+
 def run_child(platform: str) -> int:
     """Run every measurement, print one JSON dict to stdout."""
     import jax
@@ -481,6 +533,10 @@ def run_child(platform: str) -> int:
     benches += [
         ("qsc_dense", lambda: _bench_qsc("dense", max_steps, budget / 2)),
         ("qsc_pallas", lambda: _bench_qsc("pallas", max_steps, budget / 2)),
+        # online-serving request path (inference only: cheap on both
+        # platforms) — the steady-state rate `qdml-tpu serve` sustains with
+        # a saturated batcher, plus its zero-compile gate
+        ("serve_infer", lambda: _bench_serve_infer(max_steps, budget / 4)),
     ]
     if on_tpu:
         # The QSC K=1 step is ~entirely host dispatch gap at this model size

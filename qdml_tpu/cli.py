@@ -18,6 +18,10 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli report --current=PATH[,..] --baseline=PATH
                                   [--threshold=PCT] [--out=FILE.md]
                                   # telemetry delta table; exit 3 on regression
+    python -m qdml_tpu.cli serve  [--serve.port=8377 ...]  # online inference:
+                                  # restore ckpt, AOT-warm buckets, JSON/TCP loop
+    python -m qdml_tpu.cli loadgen [--rate=RPS] [--n=N]    # open-loop Poisson
+                                  # traffic vs an in-process warmed engine
 
 Every command's metrics JSONL starts with a run-manifest header (config hash,
 git SHA, device topology, perf knobs, seeds) and carries span/counter records
@@ -50,6 +54,8 @@ _COMMANDS = (
     "gen-data",
     "import-torch",
     "export-torch",
+    "serve",
+    "loadgen",
 )  # "report" dispatches before config parsing (no jax, no workdir)
 
 _PASSTHROUGH = (  # command args, not config overrides
@@ -58,6 +64,8 @@ _PASSTHROUGH = (  # command args, not config overrides
     "--current=",
     "--baseline=",
     "--threshold=",
+    "--rate=",
+    "--n=",
 )
 
 
@@ -138,22 +146,26 @@ def main(argv: list[str] | None = None) -> int:
         elif cmd == "eval":
             from qdml_tpu.eval.report import create_comparison_plots, save_results_json
             from qdml_tpu.eval.sweep import run_snr_sweep
-            from qdml_tpu.train.checkpoint import has_checkpoint, restore_checkpoint
+            from qdml_tpu.train.checkpoint import latest_tag, restore_params
 
-            hdce_vars, _ = restore_checkpoint(workdir, "hdce_best")
-            sc_vars, _ = restore_checkpoint(workdir, "sc_best")
+            # Tag discovery (best > last > resume) is latest_tag's job — one
+            # policy shared with the serving engine, no duplicated fallbacks.
+            hdce_vars, _ = restore_params(workdir, latest_tag(workdir, "hdce") or "hdce_best")
+            sc_vars, _ = restore_params(workdir, latest_tag(workdir, "sc") or "sc_best")
             qsc_vars = None
-            if has_checkpoint(workdir, "qsc_best"):  # graceful fallback (Test.py:81-86)
+            qsc_tag = latest_tag(workdir, "qsc")
+            if qsc_tag is not None:  # graceful fallback (Test.py:81-86)
                 from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
 
-                qsc_vars, qsc_meta = restore_checkpoint(workdir, "qsc_best")
+                qsc_vars, qsc_meta = restore_params(workdir, qsc_tag)
                 cfg = reconcile_quantum_cfg(cfg, qsc_meta)
             # Optional monolithic-DCE baseline curve (beyond the reference's
             # shipped eval): included whenever `cli train-dce` has produced a
-            # best checkpoint in this workdir.
+            # checkpoint in this workdir.
             dce_vars = None
-            if has_checkpoint(workdir, "dce_best"):
-                dce_vars, _ = restore_checkpoint(workdir, "dce_best")
+            dce_tag = latest_tag(workdir, "dce")
+            if dce_tag is not None:
+                dce_vars, _ = restore_params(workdir, dce_tag)
             # Multi-device eval: same mesh contract as the trainers. A fed axis
             # == n_scenarios runs the all-hypotheses trunk pass expert-parallel
             # (each scenario's trunk on its own slice); the data axis shards the
@@ -293,6 +305,33 @@ def main(argv: list[str] | None = None) -> int:
                 out, batch_size=cfg.train.batch_size, snr_db=int(cfg.data.snr_db), **kwargs
             )
             print("wrote:\n  " + "\n  ".join(written))
+        elif cmd == "serve":
+            from qdml_tpu.serve import ServeEngine
+            from qdml_tpu.serve.server import run_server
+            from qdml_tpu.telemetry import span as _span
+
+            engine = ServeEngine.from_workdir(cfg, workdir)
+            with _span("serve_warmup", buckets=list(engine.buckets)):
+                engine.warmup()
+            run_server(cfg, engine, logger=logger)
+        elif cmd == "loadgen":
+            import json
+
+            from qdml_tpu.serve import ServeEngine
+            from qdml_tpu.serve.loadgen import run_loadgen
+
+            rate = float(next(
+                (e.split("=", 1)[1] for e in extra if e.startswith("--rate=")), 200.0
+            ))
+            n = int(next(
+                (e.split("=", 1)[1] for e in extra if e.startswith("--n=")), 512
+            ))
+            engine = ServeEngine.from_workdir(cfg, workdir)
+            deadline = cfg.serve.deadline_ms if cfg.serve.deadline_ms > 0 else None
+            summary = run_loadgen(
+                cfg, engine, rate=rate, n=n, deadline_ms=deadline, logger=logger
+            )
+            print(json.dumps(summary))
         # reference prints total minutes (Runner...py:437-440)
         print(f"total time: {(time.time() - t0) / 60.0:.2f} min")
         return 0

@@ -176,6 +176,32 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Online inference serving engine (:mod:`qdml_tpu.serve`).
+
+    The request path never compiles: the engine AOT-compiles one fused
+    classifier+routing+estimator executable per batch bucket at warmup
+    (``docs/SERVING.md``). Buckets default to powers of two up to
+    ``max_batch``; requests coalesce in the micro-batcher until the batch
+    fills or the oldest request has waited ``max_wait_ms``.
+    """
+
+    max_batch: int = 64        # largest (and last) bucket; batches never exceed it
+    max_wait_ms: float = 2.0   # coalescing window before a partial batch flushes
+    max_queue: int = 256       # bounded request queue; beyond it, shed Overloaded
+    # Default per-request deadline in ms; 0 disables. Requests whose deadline
+    # has passed are shed (typed Overloaded) at admission or dequeue, never
+    # silently served late.
+    deadline_ms: float = 0.0
+    # Explicit bucket sizes; () = powers of two up to max_batch. Tests and
+    # small deployments shrink this to bound warmup compile count.
+    buckets: tuple[int, ...] = ()
+    # Local socket endpoint for `qdml-tpu serve`.
+    host: str = "127.0.0.1"
+    port: int = 8377
+
+
+@dataclass(frozen=True)
 class EvalConfig:
     """Mirrors ``model_val`` config (``Test.py:11-21, 66``)."""
 
@@ -195,6 +221,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     # Geometry-derived model dimensions. Single-sourced from DataConfig so a
     # non-default geometry (e.g. the tiny multichip dryrun) can never silently

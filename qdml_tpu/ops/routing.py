@@ -20,8 +20,16 @@ def select_expert(stacked: jnp.ndarray, pred: jnp.ndarray) -> jnp.ndarray:
 
     ``stacked``: (S, B, D) outputs of every expert on every sample;
     ``pred``: (B,) int expert ids. Returns (B, D).
+
+    Out-of-range ids are clipped into ``[0, S-1]`` rather than silently
+    gathering garbage: under jit XLA clamps gather indices anyway, but eager
+    numpy-semantics callers (and negative ids, which numpy would WRAP to the
+    last expert) would otherwise diverge from the compiled path. A corrupted
+    classifier id thus degrades to the nearest valid expert on every path
+    identically, and ``one_hot_dispatch`` (which zeros out-of-range rows)
+    stays the only intentionally-masking variant.
     """
-    idx = pred[None, :, None]  # (1, B, 1)
+    idx = jnp.clip(pred, 0, stacked.shape[0] - 1)[None, :, None]  # (1, B, 1)
     return jnp.take_along_axis(stacked, idx, axis=0)[0]
 
 

@@ -1,0 +1,35 @@
+"""Online inference serving: engine, micro-batcher, socket server, loadgen.
+
+The first subsystem on the inference half of the stack (ROADMAP north star:
+serve heavy traffic). A checkpoint goes online in three layers:
+
+- :class:`~qdml_tpu.serve.engine.ServeEngine` — restores HDCE + classifier,
+  fuses classify->route->estimate into one jitted function, AOT-compiles it
+  per batch bucket at warmup, and proves the request path never compiles
+  (compile-cache counters);
+- :class:`~qdml_tpu.serve.batcher.MicroBatcher` — bounded queue, dynamic
+  max-batch/max-wait coalescing into power-of-two buckets, deadline-aware
+  admission that sheds typed ``Overloaded`` results;
+- :class:`~qdml_tpu.serve.server.ServeLoop` / ``qdml-tpu serve`` — the
+  worker pump and a newline-JSON local socket front-end; ``qdml-tpu
+  loadgen`` (:mod:`qdml_tpu.serve.loadgen`) drives it with open-loop
+  Poisson traffic and reports tail latency + offline-forward parity.
+
+Architecture, bucket/warmup policy, overload semantics and telemetry record
+shapes: ``docs/SERVING.md``.
+"""
+
+from qdml_tpu.serve.batcher import (  # noqa: F401
+    MicroBatcher,
+    pick_bucket,
+    power_of_two_buckets,
+)
+from qdml_tpu.serve.engine import ServeEngine  # noqa: F401
+from qdml_tpu.serve.loadgen import make_request_samples, run_loadgen  # noqa: F401
+from qdml_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from qdml_tpu.serve.server import ServeLoop, run_server, serve_async  # noqa: F401
+from qdml_tpu.serve.types import (  # noqa: F401
+    Overloaded,
+    Prediction,
+    Request,
+)

@@ -1,0 +1,142 @@
+"""Dynamic micro-batcher: bounded queue, max-batch/max-wait coalescing.
+
+The policy is the standard serving tradeoff (TorchServe/Triton-style dynamic
+batching, applied to the MoE-style top-1 HDCE pipeline): requests coalesce
+until either ``max_batch`` of them are waiting (flush immediately — a full
+bucket) or the OLDEST waiting request has aged ``max_wait_ms`` (flush partial
+— latency floor beats fill). Batches then pad up to the next power-of-two
+bucket so every shape hitting the engine was AOT-compiled at warmup
+(:mod:`qdml_tpu.serve.engine`).
+
+Admission control is deadline-aware and sheds load as typed
+:class:`~qdml_tpu.serve.types.Overloaded` results instead of letting the
+queue collapse: a full bounded queue rejects at submit; a request whose
+deadline has already passed is rejected at submit; one whose deadline expires
+while queued is shed at dequeue (running it would waste a bucket slot on an
+answer the client has already abandoned).
+
+The clock is injected (``clock=``) so every edge case — max-wait timeout,
+deadline expiry at dequeue — is deterministically testable without sleeping
+(``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Sequence
+
+from qdml_tpu.serve.types import (
+    DEADLINE_AT_DEQUEUE,
+    DEADLINE_AT_SUBMIT,
+    QUEUE_FULL,
+    Overloaded,
+    Request,
+)
+
+
+def power_of_two_buckets(max_batch: int) -> tuple[int, ...]:
+    """``(1, 2, 4, ..., max_batch)`` — max_batch itself is always the last
+    bucket even when it is not a power of two, so the batcher's largest batch
+    always has an exactly-sized executable."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits ``n``; oversize falls back to the LARGEST
+    bucket (the engine then serves the batch in largest-bucket chunks rather
+    than compiling a fresh shape on the request path)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+class MicroBatcher:
+    """Bounded FIFO request queue with max-batch/max-wait coalescing."""
+
+    def __init__(
+        self,
+        max_batch: int = 64,
+        max_wait_s: float = 0.002,
+        max_queue: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_queue < max_batch:
+            raise ValueError(
+                f"max_queue ({max_queue}) must hold at least one full batch "
+                f"({max_batch})"
+            )
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.max_queue = max_queue
+        self.clock = clock
+        self._q: deque[Request] = deque()
+        self._lock = threading.Lock()
+
+    @property
+    def depth(self) -> int:
+        return len(self._q)
+
+    def submit(self, req: Request, now: float | None = None) -> Overloaded | None:
+        """Admit ``req``; returns an :class:`Overloaded` (and does NOT enqueue)
+        when the bounded queue is full or the deadline has already passed,
+        else ``None``."""
+        now = self.clock() if now is None else now
+        req.enqueue_ts = now
+        if req.deadline is not None and req.deadline <= now:
+            return Overloaded(req.rid, DEADLINE_AT_SUBMIT)
+        with self._lock:
+            if len(self._q) >= self.max_queue:
+                return Overloaded(req.rid, QUEUE_FULL)
+            self._q.append(req)
+        return None
+
+    def next_batch(
+        self, now: float | None = None
+    ) -> tuple[list[Request], list[tuple[Request, Overloaded]]]:
+        """``(ready, shed)``: up to ``max_batch`` requests when the flush
+        policy fires (full batch, or oldest aged past ``max_wait_s``), else
+        ``[]``. ``shed`` pairs each queued request whose deadline expired
+        before it could be batched with its typed ``Overloaded`` result — the
+        REQUEST rides along because the caller must still resolve its future
+        (a shed whose future never resolves is a client hung forever)."""
+        now = self.clock() if now is None else now
+        shed: list[tuple[Request, Overloaded]] = []
+        with self._lock:
+            if self._q:
+                live = deque()
+                for r in self._q:
+                    if r.deadline is not None and r.deadline <= now:
+                        shed.append(
+                            (r, Overloaded(r.rid, DEADLINE_AT_DEQUEUE, now - r.enqueue_ts))
+                        )
+                    else:
+                        live.append(r)
+                self._q = live
+            if not self._q:
+                return [], shed
+            full = len(self._q) >= self.max_batch
+            aged = (now - self._q[0].enqueue_ts) >= self.max_wait_s
+            if not (full or aged):
+                return [], shed
+            take = min(len(self._q), self.max_batch)
+            return [self._q.popleft() for _ in range(take)], shed
+
+    def wait_hint(self, now: float | None = None) -> float:
+        """Seconds until the oldest queued request hits ``max_wait_s`` (the
+        serve loop's idle sleep bound); ``max_wait_s`` when the queue is
+        empty."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            if not self._q:
+                return self.max_wait_s
+            return max(0.0, self.max_wait_s - (now - self._q[0].enqueue_ts))

@@ -1,0 +1,230 @@
+"""Serving engine: fused HDCE inference, bucketed AOT warmup, zero request-path compiles.
+
+The online pipeline is the eval sweep's forward (``eval/sweep.py``) stripped
+to its serving core: scenario classifier -> argmax -> run ALL stacked
+``ConvP128`` trunks + shared ``FCP128`` head on the batch ->
+:func:`~qdml_tpu.ops.routing.select_expert` gather — MoE-style top-1 dispatch
+with no host round trip, one jitted function end to end.
+
+Compilation is amortized entirely into :meth:`ServeEngine.warmup` (the
+Qandle gate-matrix-caching argument applied to XLA executables): every batch
+bucket is AOT-compiled via ``jit(...).lower(...).compile()`` and executed
+once, then the compile-cache counters are SNAPSHOT — a request-path compile
+would advance ``compile_cache_stats()`` past the snapshot, and
+:meth:`request_path_compiles` exposes exactly that delta as the "warmup
+actually covered the request path" gate (a snapshot, not a global reset:
+the counters are process-wide and other telemetry consumers — StepClock,
+bench — must keep seeing the run's true totals). The request path itself
+calls pre-compiled executables only; an un-warmed shape raises instead of
+silently tracing.
+
+Padding: batches pad with zeros up to the bucket size and outputs are sliced
+back to the real count. Every per-sample op in the pipeline (convs, eval-mode
+BatchNorm over running stats, dense heads, the routing gather) is
+row-independent, so padding rows cannot perturb real rows — the "mask" is the
+valid-count slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.models.cnn import SCP128
+from qdml_tpu.models.qsc import QSCP128
+from qdml_tpu.ops.routing import select_expert
+from qdml_tpu.serve.batcher import pick_bucket, power_of_two_buckets
+from qdml_tpu.telemetry import span
+from qdml_tpu.train.hdce import HDCE
+from qdml_tpu.utils.compile_cache import compile_cache_stats, enable_compile_cache
+
+
+class ServeEngine:
+    """Checkpoint-restored HDCE pipeline behind per-bucket AOT executables."""
+
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        hdce_vars: dict,
+        clf_vars: dict,
+        quantum: bool = False,
+        buckets: tuple[int, ...] | None = None,
+    ):
+        self.cfg = cfg
+        self.quantum = quantum
+        self.buckets = tuple(
+            sorted(buckets or cfg.serve.buckets or power_of_two_buckets(cfg.serve.max_batch))
+        )
+        self.hdce = HDCE(
+            n_scenarios=cfg.data.n_scenarios,
+            features=cfg.model.features,
+            out_dim=cfg.h_out_dim,
+        )
+        if quantum:
+            self.clf: Any = QSCP128(
+                n_qubits=cfg.quantum.n_qubits,
+                n_layers=cfg.quantum.n_layers,
+                n_classes=cfg.quantum.n_classes,
+                backend=cfg.quantum.backend,
+                input_norm=cfg.quantum.input_norm,
+            )
+        else:
+            self.clf = SCP128(n_classes=cfg.quantum.n_classes)
+        # Commit vars to device once: checkpoints restore as host numpy, and
+        # re-transferring the params on every request batch would make the
+        # serving path host-bandwidth-bound.
+        self._hdce_vars = jax.tree.map(jnp.asarray, hdce_vars)
+        self._clf_vars = jax.tree.map(jnp.asarray, clf_vars)
+        self._compiled: dict[int, Any] = {}
+        self._warm = False
+        self._stats0: dict = {}
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_workdir(
+        cls,
+        cfg: ExperimentConfig,
+        workdir: str,
+        buckets: tuple[int, ...] | None = None,
+    ) -> "ServeEngine":
+        """Restore the newest trained HDCE + classifier from ``workdir``.
+
+        Tag discovery goes through :func:`~qdml_tpu.train.checkpoint.latest_tag`
+        (best > last > resume); the quantum classifier is preferred when one
+        was trained (its checkpoint meta reconciles the circuit config via
+        ``reconcile_quantum_cfg``, exactly like the eval CLI), falling back to
+        the classical ``SCP128``.
+        """
+        from qdml_tpu.train.checkpoint import (
+            latest_tag,
+            reconcile_quantum_cfg,
+            restore_params,
+        )
+
+        hdce_tag = latest_tag(workdir, "hdce")
+        if hdce_tag is None:
+            raise FileNotFoundError(
+                f"no hdce checkpoint (best/last/resume) under {workdir!r} — "
+                "run `qdml-tpu train-hdce` first"
+            )
+        hdce_vars, _ = restore_params(workdir, hdce_tag)
+        qsc_tag = latest_tag(workdir, "qsc")
+        if qsc_tag is not None:
+            clf_vars, clf_meta = restore_params(workdir, qsc_tag)
+            cfg = reconcile_quantum_cfg(cfg, clf_meta)
+            return cls(cfg, hdce_vars, clf_vars, quantum=True, buckets=buckets)
+        sc_tag = latest_tag(workdir, "sc")
+        if sc_tag is None:
+            raise FileNotFoundError(
+                f"no scenario-classifier checkpoint (qsc/sc) under {workdir!r} "
+                "— run `qdml-tpu train-sc` (or train-qsc) first"
+            )
+        clf_vars, _ = restore_params(workdir, sc_tag)
+        return cls(cfg, hdce_vars, clf_vars, quantum=False, buckets=buckets)
+
+    # -- forward ------------------------------------------------------------
+
+    def _forward(
+        self, hdce_vars: dict, clf_vars: dict, x: jnp.ndarray
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Fused classify -> all-trunks -> top-1 route. ``x``: (B, n_sub,
+        n_beam, 2) f32 -> ``(h (B, 2*h_dim), pred (B,))``."""
+        logp = self.clf.apply(clf_vars, x, train=False)
+        pred = jnp.argmax(logp, -1)
+        xs = jnp.broadcast_to(x[None], (self.cfg.data.n_scenarios,) + x.shape)
+        est_all = self.hdce.apply(hdce_vars, xs, train=False)  # (S, B, D)
+        return select_expert(est_all, pred), pred
+
+    def offline_forward(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """The parity reference: the same fused forward jitted at the natural
+        (unpadded, unbucketed) batch shape — numerically the offline eval
+        path. Loadgen/tests call this BEFORE :meth:`warmup` so its compile
+        never pollutes the request-path compile gate."""
+        h, pred = jax.jit(self._forward)(self._hdce_vars, self._clf_vars, jnp.asarray(x))
+        return np.asarray(jax.device_get(h)), np.asarray(jax.device_get(pred))
+
+    # -- warmup -------------------------------------------------------------
+
+    def warmup(self) -> dict:
+        """AOT-compile and first-execute every bucket; arm the compile gate.
+
+        Returns ``{"buckets": ..., "compile": <cache-stat deltas over
+        warmup>}``. After this returns, :meth:`request_path_compiles` starts
+        from zero — any later compile in this process is, by definition, one
+        the warmup failed to cover.
+        """
+        enable_compile_cache()
+        pre = compile_cache_stats()
+        var_specs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (self._hdce_vars, self._clf_vars),
+        )
+        hw = self.cfg.image_hw
+        for b in self.buckets:
+            with span("serve_warmup_bucket", bucket=b):
+                x_spec = jax.ShapeDtypeStruct((b, *hw, 2), jnp.float32)
+                compiled = jax.jit(self._forward).lower(*var_specs, x_spec).compile()
+                # first execute outside the request path (XLA may lazily
+                # finalize; also faults in the params transfer)
+                h, pred = compiled(
+                    self._hdce_vars, self._clf_vars, np.zeros((b, *hw, 2), np.float32)
+                )
+                jax.block_until_ready((h, pred))
+                self._compiled[b] = compiled
+        post = compile_cache_stats()
+        # SNAPSHOT the post-warmup totals (never reset the process-global
+        # counters: StepClock/bench records in the same process must keep
+        # their true run totals). request_path_compiles() diffs against this.
+        self._stats0 = post
+        self._warm = True
+        return {
+            "buckets": self.buckets,
+            "compile": {k: post[k] - pre.get(k, 0) for k in post},
+        }
+
+    def request_path_compiles(self) -> dict:
+        """Compile-cache counter deltas since warmup ended — all-zero iff
+        nothing compiled on the request path (the acceptance gate loadgen
+        reports). Clamped at zero: an external ``reset_stats()`` between
+        warmup and now can only lower the totals, never fake a compile."""
+        now = compile_cache_stats()
+        return {k: max(0, now[k] - self._stats0.get(k, 0)) for k in now}
+
+    # -- request path -------------------------------------------------------
+
+    def infer(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray, int]:
+        """Serve one coalesced batch: pad to its bucket, run the pre-compiled
+        executable, slice back. ``x``: (n, n_sub, n_beam, 2). Returns
+        ``(h (n, 2*h_dim), pred (n,), bucket)``.
+
+        Oversized batches (n > largest bucket — only reachable by direct
+        callers; the micro-batcher caps at ``max_batch``) fall back to
+        largest-bucket chunks rather than compiling a fresh shape.
+        """
+        if not self._warm:
+            raise RuntimeError("ServeEngine.infer before warmup() — request path would compile")
+        n = int(x.shape[0])
+        if n == 0:
+            raise ValueError("empty batch")
+        largest = self.buckets[-1]
+        if n > largest:
+            hs, preds = [], []
+            for lo in range(0, n, largest):
+                h, p, _ = self.infer(x[lo : lo + largest])
+                hs.append(h)
+                preds.append(p)
+            return np.concatenate(hs), np.concatenate(preds), largest
+        b = pick_bucket(n, self.buckets)
+        xp = np.zeros((b, *x.shape[1:]), np.float32)
+        xp[:n] = x
+        h, pred = self._compiled[b](self._hdce_vars, self._clf_vars, xp)
+        return (
+            np.asarray(jax.device_get(h))[:n],
+            np.asarray(jax.device_get(pred))[:n],
+            b,
+        )

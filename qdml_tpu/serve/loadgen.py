@@ -1,0 +1,148 @@
+"""Open-loop Poisson load generator + offline-parity harness.
+
+Open-loop means arrivals are scheduled by the Poisson clock, NOT by response
+completion — the generator keeps offering load while requests are in flight,
+which is the only traffic model that exposes queue growth, coalescing
+behavior, and load shedding (a closed loop self-throttles and can never
+overload the server; Schroeder et al., "Open Versus Closed: A Cautionary
+Tale").
+
+Each run reports the three acceptance numbers for the serving engine:
+
+- ``compile_cache_after_warmup`` — all-zero iff NO compile happened on the
+  request path (the engine resets the counters when warmup ends);
+- parity — per-request estimates must match the offline eval forward on the
+  same checkpoint bit-for-bit-modulo-fp (same executable family, same
+  params; the padded bucket must not change any real row), reported as
+  ``parity_max_abs_err`` plus served-vs-offline NMSE in dB;
+- tail latency — p50/p95/p99 per-request latency, throughput, batch-fill.
+
+The summary lands in the run's manifest-headed telemetry JSONL as a
+``serve_summary`` record, which ``qdml-tpu report`` diffs (rps into the
+throughput gate, latency percentiles into the serving-latency section).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import make_network_batch
+from qdml_tpu.serve.engine import ServeEngine
+from qdml_tpu.serve.metrics import ServeMetrics
+from qdml_tpu.serve.server import ServeLoop
+from qdml_tpu.serve.types import Prediction
+from qdml_tpu.telemetry import span
+from qdml_tpu.utils.metrics import nmse_db
+
+
+def make_request_samples(cfg: ExperimentConfig, n: int) -> dict[str, np.ndarray]:
+    """``n`` fresh request samples past the training range (the eval sweep's
+    offset convention, Test.py:127) round-robined over the scenario/user grid;
+    returns host arrays: ``x`` (pilot images), ``h_perf`` (ground truth),
+    ``indicator`` (true scenario)."""
+    geom = ChannelGeometry.from_config(cfg.data)
+    i = jnp.arange(n)
+    scen = i % cfg.data.n_scenarios
+    user = (i // cfg.data.n_scenarios) % cfg.data.n_users
+    start = cfg.data.data_len * 3
+    batch = make_network_batch(
+        jnp.uint32(cfg.data.seed), scen, user, start + i,
+        jnp.float32(cfg.data.snr_db), geom,
+    )
+    return {
+        "x": np.asarray(batch["yp_img"], np.float32),
+        "h_perf": np.asarray(batch["h_perf"], np.float32),
+        "indicator": np.asarray(batch["indicator"]),
+    }
+
+
+def run_loadgen(
+    cfg: ExperimentConfig,
+    engine: ServeEngine,
+    rate: float = 200.0,
+    n: int = 256,
+    seed: int = 0,
+    deadline_ms: float | None = None,
+    logger=None,
+) -> dict:
+    """Drive a warmed (or about-to-be-warmed) engine with Poisson traffic.
+
+    Order matters: the offline parity reference compiles BEFORE
+    ``engine.warmup()`` re-arms the compile counters, so the request-path
+    compile gate measures serving alone.
+    """
+    samples = make_request_samples(cfg, n)
+    x, h_perf = samples["x"], samples["h_perf"]
+
+    with span("loadgen_offline_reference", n=n):
+        offline_h, offline_pred = engine.offline_forward(x)
+    with span("serve_warmup", buckets=list(engine.buckets)):
+        warm = engine.warmup()
+
+    metrics = ServeMetrics(
+        sink=None if logger is None else logger.telemetry, log_requests=n <= 2048
+    )
+    loop = ServeLoop(engine, metrics=metrics).start()
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, n)
+    arrivals = np.cumsum(gaps)
+
+    futures = []
+    t0 = time.perf_counter()
+    with span("loadgen_traffic", rate_rps=rate, n=n):
+        for i in range(n):
+            lag = t0 + arrivals[i] - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)  # open loop: schedule by the Poisson clock only
+            futures.append(loop.submit(x[i], rid=i, deadline_ms=deadline_ms))
+        # offered window ends when the LAST request was offered — the result
+        # drain must not dilute the offered rate, or an overloaded server
+        # would look like a slow generator and mask its own overload
+        offered_elapsed = time.perf_counter() - t0
+        results = [f.result(timeout=60.0) for f in futures]
+    loop.stop()
+    cache_after = engine.request_path_compiles()
+
+    done = {r.rid: r for r in results if isinstance(r, Prediction)}
+    shed = [r for r in results if not isinstance(r, Prediction)]
+    parity_max = 0.0
+    nmse_served = nmse_offline = None
+    pred_agree = None
+    if done:
+        ids = sorted(done)
+        served_h = np.stack([done[i].h for i in ids])
+        off_h, off_p = offline_h[ids], offline_pred[ids]
+        parity_max = float(np.max(np.abs(served_h - off_h)))
+        pred_agree = float(
+            np.mean([done[i].scenario == int(off_p[k]) for k, i in enumerate(ids)])
+        )
+        pow_ = float(np.sum(h_perf[ids] ** 2))
+        nmse_served = nmse_db(float(np.sum((served_h - h_perf[ids]) ** 2)) / pow_)
+        nmse_offline = nmse_db(float(np.sum((off_h - h_perf[ids]) ** 2)) / pow_)
+
+    import jax
+
+    summary = metrics.summary(
+        compile_cache=cache_after,
+        # labels the record for report's platform-mismatch disarm: a CPU
+        # loadgen diffed against a TPU baseline compares hardware, not code
+        platform=jax.default_backend(),
+        offered_rps=round(n / offered_elapsed, 2),
+        target_rps=rate,
+        n_requests=n,
+        n_shed=len(shed),
+        parity_max_abs_err=parity_max,
+        pred_agreement=pred_agree,
+        nmse_db_served=nmse_served,
+        nmse_db_offline=nmse_offline,
+        warmup=warm,
+    )
+    metrics.flush(compile_cache=cache_after)
+    if logger is not None:
+        logger.telemetry.write_raw(summary)
+    return summary

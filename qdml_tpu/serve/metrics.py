@@ -1,0 +1,127 @@
+"""Serving telemetry: per-request/per-batch records, tail-latency counters.
+
+Everything flushes through the PR-1 telemetry layer so a serving run produces
+the same manifest-headed JSONL every other entry point does, and
+``qdml-tpu report`` can diff it against a committed baseline:
+
+- per-batch: a ``span`` record (``name="serve_batch"``) around each engine
+  dispatch, tagged with real count, bucket, and queue depth at dequeue;
+- per-request: a ``span`` record (``name="serve_request"``) whose ``dur_s``
+  is the enqueue->result latency (at load-test scale every request is cheap
+  to record; a production deployment would sample — docs/SERVING.md);
+- rolled up: ``counters`` records (``name="serve"``) with p50/p95/p99 request
+  latency, batch-fill and queue-depth distributions, shed counts, and the
+  request-path compile-cache counters, flushed on demand
+  (:meth:`ServeMetrics.flush`) and folded into the final ``serve_summary``
+  record the report gate consumes.
+"""
+
+from __future__ import annotations
+
+import time
+
+from qdml_tpu.serve.types import Overloaded, Prediction
+from qdml_tpu.telemetry import Histogram
+from qdml_tpu.telemetry.spans import get_sink
+
+
+class ServeMetrics:
+    """Latency/fill/depth collector for one serving window."""
+
+    def __init__(self, sink=None, log_requests: bool = True):
+        self._sink = sink
+        self.log_requests = log_requests
+        self.latency = Histogram()       # per-request enqueue -> result
+        self.batch_fill = Histogram()    # n / bucket per served batch (0..1)
+        self.queue_depth = Histogram()   # depth at dequeue (stored as "seconds")
+        self.batches = 0
+        self.completed = 0
+        self.shed: dict[str, int] = {}
+        self._t0 = time.perf_counter()
+
+    def _target(self):
+        return self._sink if self._sink is not None else get_sink()
+
+    def observe_batch(self, preds: list[Prediction], bucket: int, depth: int, dur_s: float) -> None:
+        self.batches += 1
+        self.completed += len(preds)
+        self.batch_fill.add(len(preds) / bucket)
+        self.queue_depth.add(float(depth))
+        target = self._target()
+        active = target is not None and getattr(target, "active", False)
+        if active:
+            target.emit(
+                "span",
+                name="serve_batch",
+                path="serve/serve_batch",
+                depth=1,
+                dur_s=round(dur_s, 6),
+                n=len(preds),
+                bucket=bucket,
+                queue_depth=depth,
+            )
+        for p in preds:
+            self.latency.add(p.latency_s)
+            if active and self.log_requests:
+                target.emit(
+                    "span",
+                    name="serve_request",
+                    path="serve/serve_request",
+                    depth=2,
+                    dur_s=round(p.latency_s, 6),
+                    rid=p.rid,
+                    bucket=bucket,
+                )
+
+    def observe_shed(self, o: Overloaded) -> None:
+        self.shed[o.reason] = self.shed.get(o.reason, 0) + 1
+
+    def _scaled(self, hist: Histogram) -> dict | None:
+        """Histogram.summary() without the ms scaling (fill/depth are not
+        durations; undo the *1e3 and rename)."""
+        s = hist.summary()
+        if s is None:
+            return None
+        return {
+            "n": s["n"],
+            "mean": round(s["mean_ms"] / 1e3, 4),
+            "p50": round(s["p50_ms"] / 1e3, 4),
+            "p95": round(s["p95_ms"] / 1e3, 4),
+            "max": round(s["max_ms"] / 1e3, 4),
+        }
+
+    def flush(self, compile_cache: dict | None = None, **tags) -> None:
+        """One ``counters`` record for the window; histograms keep
+        accumulating (the final summary sees the whole run)."""
+        target = self._target()
+        if target is not None and getattr(target, "active", False):
+            target.emit(
+                "counters",
+                name="serve",
+                latency=self.latency.summary(),
+                batch_fill=self._scaled(self.batch_fill),
+                queue_depth=self._scaled(self.queue_depth),
+                batches=self.batches,
+                completed=self.completed,
+                shed=dict(self.shed),
+                compile_cache=compile_cache,
+                **tags,
+            )
+
+    def summary(self, compile_cache: dict | None = None, **extra) -> dict:
+        """The run-level ``serve_summary`` record (``qdml-tpu report``'s
+        serving section reads exactly this shape)."""
+        elapsed = time.perf_counter() - self._t0
+        return {
+            "kind": "serve_summary",
+            "elapsed_s": round(elapsed, 3),
+            "completed": self.completed,
+            "batches": self.batches,
+            "shed": dict(self.shed),
+            "rps": round(self.completed / elapsed, 2) if elapsed > 0 else None,
+            "latency_ms": self.latency.summary(),
+            "batch_fill": self._scaled(self.batch_fill),
+            "queue_depth": self._scaled(self.queue_depth),
+            "compile_cache_after_warmup": compile_cache,
+            **extra,
+        }

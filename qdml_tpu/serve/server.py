@@ -1,0 +1,293 @@
+"""Serve loop + local socket front-end.
+
+:class:`ServeLoop` is the in-process serving core: a worker thread that
+drains the micro-batcher — shed results resolve immediately, ready batches go
+through the engine's pre-compiled executables, and every request's future
+resolves with a typed :class:`~qdml_tpu.serve.types.Prediction` or
+:class:`~qdml_tpu.serve.types.Overloaded`. The loadgen harness and the smoke
+tests drive this object directly; the socket server below is a thin framing
+layer over it.
+
+``qdml-tpu serve`` runs :func:`run_server`: an asyncio loop accepting
+newline-delimited JSON over a local TCP socket (``{"id", "x", [deadline_ms]}``
+-> ``{"id", "ok", "pred", "h", "latency_ms"}`` or
+``{"id", "ok": false, "reason"}``). One engine, one batcher: concurrent
+connections coalesce into the same buckets, which is the entire point of
+dynamic micro-batching.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.serve.batcher import MicroBatcher
+from qdml_tpu.serve.engine import ServeEngine
+from qdml_tpu.serve.metrics import ServeMetrics
+from qdml_tpu.serve.types import SHUTDOWN, Overloaded, Prediction, Request
+
+
+class ServeLoop:
+    """Worker thread pumping batcher -> engine -> futures."""
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        batcher: MicroBatcher | None = None,
+        metrics: ServeMetrics | None = None,
+    ):
+        serve_cfg = engine.cfg.serve
+        self.engine = engine
+        self.batcher = batcher or MicroBatcher(
+            max_batch=serve_cfg.max_batch,
+            max_wait_s=serve_cfg.max_wait_ms / 1e3,
+            max_queue=serve_cfg.max_queue,
+        )
+        self.metrics = metrics or ServeMetrics()
+        self._default_deadline_s = (
+            serve_cfg.deadline_ms / 1e3 if serve_cfg.deadline_ms > 0 else None
+        )
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._started = False  # stays True after stop(): a finished loop rejects
+        self._rid = 0
+
+    # -- client side --------------------------------------------------------
+
+    def submit(
+        self,
+        x: np.ndarray,
+        rid: int | str | None = None,
+        deadline_ms: float | None = None,
+    ) -> Future:
+        """Enqueue one request; the returned future resolves with a
+        Prediction or Overloaded (never raises for overload). A malformed
+        payload raises ``ValueError`` HERE, synchronously — client errors
+        must never reach the worker, where one bad shape would crash the
+        batch it was coalesced into."""
+        x = np.asarray(x, np.float32)
+        expect = (*self.engine.cfg.image_hw, 2)
+        if x.shape != expect:
+            raise ValueError(f"request x has shape {x.shape}, expected {expect}")
+        if rid is None:
+            self._rid += 1
+            rid = self._rid
+        if self._started and (self._thread is None or not self._thread.is_alive()):
+            # a stopped or CRASHED worker must not accept work: the queue
+            # would grow with futures nobody will ever resolve (clients hung
+            # forever behind a server that still accepts connections).
+            # Submits before start() are fine — start() will drain them.
+            fut: Future = Future()
+            fut.set_result(Overloaded(rid, SHUTDOWN))
+            return fut
+        now = self.batcher.clock()
+        deadline_s = (
+            deadline_ms / 1e3 if deadline_ms is not None else self._default_deadline_s
+        )
+        req = Request(
+            rid=rid,
+            x=x,
+            deadline=None if deadline_s is None else now + deadline_s,
+            future=Future(),
+        )
+        rejected = self.batcher.submit(req, now=now)
+        if rejected is not None:
+            self.metrics.observe_shed(rejected)
+            req.future.set_result(rejected)
+        else:
+            self._wake.set()
+        return req.future
+
+    # -- worker side --------------------------------------------------------
+
+    def start(self) -> "ServeLoop":
+        if not self.engine._compiled:
+            self.engine.warmup()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="serve-loop")
+        self._started = True
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the worker; with ``drain`` (default) only after the queue has
+        emptied, so every submitted future resolves."""
+        if self._thread is None:
+            return
+        if drain:
+            while self.batcher.depth > 0 and self._thread.is_alive():
+                self._wake.set()
+                time.sleep(0.001)
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10.0)
+        self._thread = None
+
+    def _serve_one(self) -> bool:
+        """Single batcher pump: resolve sheds, serve at most one batch.
+        Returns True when any work happened (the loop's idle detector)."""
+        depth = self.batcher.depth
+        batch, shed = self.batcher.next_batch()
+        for r, o in shed:
+            self.metrics.observe_shed(o)
+            if r.future is not None:
+                r.future.set_result(o)
+        if not batch:
+            return bool(shed)
+        t0 = time.perf_counter()
+        try:
+            # stack INSIDE the guard: a shape-mismatched request failing the
+            # stack must strand nobody, exactly like an engine failure
+            x = np.stack([r.x for r in batch])
+            h, pred, bucket = self.engine.infer(x)
+        except BaseException as e:
+            # a dying batch must not strand its clients: forward the failure
+            # into every future, then let the loop's finally drain the rest
+            for r in batch:
+                if r.future is not None and not r.future.done():
+                    r.future.set_exception(e)
+            raise
+        dur = time.perf_counter() - t0
+        now = self.batcher.clock()
+        preds = []
+        for i, r in enumerate(batch):
+            p = Prediction(
+                rid=r.rid,
+                h=h[i],
+                scenario=int(pred[i]),
+                latency_s=now - r.enqueue_ts,
+                bucket=bucket,
+                batch_n=len(batch),
+            )
+            preds.append(p)
+        # metrics before resolution: a client awaiting the future must be able
+        # to read a consistent histogram the moment its result arrives
+        self.metrics.observe_batch(preds, bucket, depth, dur)
+        for r, p in zip(batch, preds):
+            if r.future is not None:
+                r.future.set_result(p)
+        return True
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if not self._serve_one():
+                    # idle: sleep until the oldest request ages out or a submit wakes us
+                    self._wake.wait(timeout=max(self.batcher.wait_hint(), 1e-4))
+                    self._wake.clear()
+        finally:
+            # shutdown OR crash: resolve EVERYTHING still queued (no silent
+            # hangs) — keep pumping, the queue may hold several batches
+            while True:
+                batch, shed = self.batcher.next_batch(now=float("inf"))
+                if not batch and not shed:
+                    break
+                for r, o in shed:
+                    self.metrics.observe_shed(o)
+                    if r.future is not None:
+                        r.future.set_result(o)
+                for r in batch:
+                    if r.future is not None:
+                        r.future.set_result(Overloaded(r.rid, SHUTDOWN))
+
+
+# ---------------------------------------------------------------------------
+# Socket front-end (newline-delimited JSON over local TCP)
+# ---------------------------------------------------------------------------
+
+
+def _encode(res) -> dict:
+    if isinstance(res, Prediction):
+        return {
+            "id": res.rid,
+            "ok": True,
+            "pred": res.scenario,
+            "h": np.asarray(res.h, np.float32).tolist(),
+            "latency_ms": round(res.latency_s * 1e3, 3),
+            "bucket": res.bucket,
+        }
+    return {"id": res.rid, "ok": False, "reason": res.reason}
+
+
+async def _handle(reader, writer, loop_: ServeLoop) -> None:
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        try:
+            msg = json.loads(line)
+        except json.JSONDecodeError:
+            writer.write(b'{"ok": false, "reason": "bad_json"}\n')
+            await writer.drain()
+            continue
+        try:
+            # every well-formed line gets a typed reply — a missing/ragged
+            # "x", a non-object message, a bad deadline are client errors,
+            # not reasons to drop the connection (or touch the worker)
+            fut = loop_.submit(
+                np.asarray(msg["x"], np.float32),
+                rid=msg.get("id"),
+                deadline_ms=msg.get("deadline_ms"),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            rid = msg.get("id") if isinstance(msg, dict) else None
+            writer.write(
+                (json.dumps({"id": rid, "ok": False, "reason": f"bad_request: {e}"}) + "\n").encode()
+            )
+            await writer.drain()
+            continue
+        res = await asyncio.wrap_future(fut)
+        writer.write((json.dumps(_encode(res)) + "\n").encode())
+        await writer.drain()
+    writer.close()
+
+
+async def serve_async(
+    loop_: ServeLoop,
+    host: str,
+    port: int,
+    ready: "asyncio.Future | None" = None,
+) -> None:
+    """Accept connections until cancelled; resolves ``ready`` with the bound
+    port (port=0 binds an ephemeral port — how the tests avoid collisions)."""
+    server = await asyncio.start_server(
+        lambda r, w: _handle(r, w, loop_), host=host, port=port
+    )
+    bound = server.sockets[0].getsockname()[1]
+    if ready is not None and not ready.done():
+        ready.set_result(bound)
+    async with server:
+        await server.serve_forever()
+
+
+def run_server(cfg: ExperimentConfig, engine: ServeEngine, logger=None) -> None:
+    """Blocking entry for ``qdml-tpu serve``: warm, announce, serve until
+    interrupted; flush serving counters on the way out."""
+    metrics = ServeMetrics()
+    loop_ = ServeLoop(engine, metrics=metrics).start()
+    print(
+        json.dumps(
+            {
+                "serving": f"{cfg.serve.host}:{cfg.serve.port}",
+                "buckets": list(engine.buckets),
+                # post-warmup counters: anything non-zero here (or later)
+                # is a compile the warmup failed to cover
+                "compile_cache_after_warmup": engine.request_path_compiles(),
+            }
+        ),
+        flush=True,
+    )
+    try:
+        asyncio.run(serve_async(loop_, cfg.serve.host, cfg.serve.port))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        loop_.stop(drain=False)
+        metrics.flush(compile_cache=engine.request_path_compiles())

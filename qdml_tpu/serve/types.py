@@ -1,0 +1,63 @@
+"""Typed request/result records for the serving engine.
+
+A request is one pilot observation (``x`` of shape ``(n_sub, n_beam, 2)``)
+asking for its channel estimate. It resolves to exactly one of two typed
+results: a :class:`Prediction` (the routed HDCE estimate plus the predicted
+scenario) or an :class:`Overloaded` (the engine shed it — bounded queue full
+or deadline passed). Overload is a *result*, not an exception: under open-loop
+traffic the callers that must react to shedding are the very ones that would
+lose an exception raised on the server's worker thread.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import numpy as np
+
+# Overload reasons (the complete set; reasons are part of the wire contract)
+QUEUE_FULL = "queue_full"          # bounded queue at capacity on submit
+DEADLINE_AT_SUBMIT = "deadline_at_submit"    # deadline already past on admission
+DEADLINE_AT_DEQUEUE = "deadline_at_dequeue"  # expired while queued
+SHUTDOWN = "shutdown"              # server stopping (or its worker died)
+
+
+@dataclass
+class Request:
+    """One in-flight inference request."""
+
+    rid: int | str
+    x: np.ndarray                     # (n_sub, n_beam, 2) float32 pilot image
+    enqueue_ts: float = 0.0           # monotonic seconds, stamped on submit
+    deadline: float | None = None     # absolute monotonic seconds; None = no deadline
+    future: Future | None = None      # resolved with Prediction | Overloaded
+
+
+@dataclass
+class Prediction:
+    """Successful result: routed channel estimate + predicted scenario."""
+
+    rid: int | str
+    h: np.ndarray                     # (2 * h_dim,) float32 packed re/im estimate
+    scenario: int                     # predicted expert id (argmax of classifier)
+    latency_s: float                  # enqueue -> result, monotonic
+    bucket: int                       # padded batch bucket that served it
+    batch_n: int                      # real (unpadded) requests in that batch
+
+    @property
+    def ok(self) -> bool:
+        return True
+
+
+@dataclass
+class Overloaded:
+    """Typed load-shedding result (bounded queue / deadline admission)."""
+
+    rid: int | str
+    reason: str                       # QUEUE_FULL | DEADLINE_AT_SUBMIT | DEADLINE_AT_DEQUEUE
+    latency_s: float = 0.0            # time spent queued before shedding
+
+    @property
+    def ok(self) -> bool:
+        return False

@@ -38,7 +38,9 @@ class Histogram:
         self._vals = []
 
     def summary(self) -> dict | None:
-        """``{"n", "mean_ms", "p50_ms", "p95_ms", "max_ms"}`` or None if empty."""
+        """``{"n", "mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"}`` or
+        None if empty (p99 exists for the serving path, whose SLOs are tail
+        latencies — train-loop readers ignore the extra key)."""
         if not self._vals:
             return None
         v = sorted(self._vals)
@@ -52,6 +54,7 @@ class Histogram:
             "mean_ms": ms(sum(v) / len(v)),
             "p50_ms": ms(pct(50)),
             "p95_ms": ms(pct(95)),
+            "p99_ms": ms(pct(99)),
             "max_ms": ms(v[-1]),
         }
 

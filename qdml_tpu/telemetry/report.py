@@ -73,13 +73,32 @@ def _record_from(obj: dict) -> dict | None:
     return None
 
 
+def _serving_from(obj: dict) -> dict | None:
+    """Latency/throughput numbers from a ``serve_summary`` telemetry record
+    (the loadgen harness writes one per run). Latency percentiles live in a
+    separate namespace from throughput because their regression sign is
+    inverted: serving got WORSE when latency went UP."""
+    if obj.get("kind") != "serve_summary":
+        return None
+    out: dict = {"latency": {}, "rps": None, "platform": obj.get("platform")}
+    lat = obj.get("latency_ms") or {}
+    for key in ("p50_ms", "p95_ms", "p99_ms"):
+        if isinstance(lat.get(key), (int, float)):
+            out["latency"][key] = float(lat[key])
+    if isinstance(obj.get("rps"), (int, float)):
+        out["rps"] = float(obj["rps"])
+    return out
+
+
 def extract(path: str) -> dict:
-    """Pull ``{manifest, record, throughput, platform}`` out of one artifact."""
+    """Pull ``{manifest, record, throughput, serving, platform}`` out of one
+    artifact."""
     src: dict = {
         "path": path,
         "manifest": None,
         "record": None,
         "throughput": {},
+        "serving": None,
         "platform": None,
     }
     for obj in _iter_objs(path):
@@ -90,12 +109,25 @@ def extract(path: str) -> dict:
             # invocation, and the last record belongs to the last invocation
             src["manifest"] = obj
             continue
+        serving = _serving_from(obj)
+        if serving is not None:
+            src["serving"] = serving  # last serve_summary wins
+            if serving["rps"] is not None:
+                # completed-request throughput rides the existing gate
+                # (lower = regression, same as samples/sec)
+                src["throughput"]["serve.rps"] = serving["rps"]
+            if serving["platform"] and not src["platform"]:
+                # serving-only artifacts carry their backend too, so the
+                # platform-mismatch disarm covers latency gates (a bench
+                # record in the same stream keeps precedence)
+                src["platform"] = serving["platform"]
+            continue
         rec = _record_from(obj)
         if rec is not None:
             src["record"] = rec  # last record in the stream wins
     rec = src["record"]
     if rec is not None:
-        src["platform"] = rec.get("platform")
+        src["platform"] = rec.get("platform") or src["platform"]
         if isinstance(rec.get("value"), (int, float)):
             src["throughput"][rec.get("metric") or "value"] = float(rec["value"])
         for key, d in (rec.get("details") or {}).items():
@@ -235,6 +267,47 @@ def build_report(
         else:
             status = "ok"
         lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status} |")
+
+    # Serving-latency section: tail percentiles from serve_summary records.
+    # The delta sign is INVERTED relative to throughput — latency going UP
+    # beyond the threshold is the regression; the same platform rules arm
+    # the gate (cross-platform latencies compare hardware, not code).
+    base_lat = (base.get("serving") or {}).get("latency") or {}
+    cur_lat: dict[str, float] = {}
+    for c_src in curs:
+        cur_lat.update((c_src.get("serving") or {}).get("latency") or {})
+    if base_lat or cur_lat:
+        lines += [
+            "",
+            "## serving latency",
+            "",
+            "| percentile | baseline | current | delta | status |",
+            "|---|---|---|---|---|",
+        ]
+        for key in ("p50_ms", "p95_ms", "p99_ms"):
+            b = base_lat.get(key)
+            c = cur_lat.get(key)
+            if b is None and c is None:
+                continue
+            if b is None or c is None:
+                only = "current-only" if b is None else "baseline-only"
+                lines.append(
+                    f"| {key} | {'—' if b is None else f'{b:g}'} | "
+                    f"{'—' if c is None else f'{c:g}'} | — | {only} |"
+                )
+                continue
+            delta_pct = (c - b) / b * 100.0 if b else float("inf")
+            if delta_pct > threshold_pct:
+                status = "**REGRESSION**"
+                regressions.append(
+                    {"metric": f"serving.{key}", "baseline": b, "current": c,
+                     "delta_pct": round(delta_pct, 2)}
+                )
+            elif delta_pct < -threshold_pct:
+                status = "improved"
+            else:
+                status = "ok"
+            lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status} |")
 
     lines.append("")
     if regressions:

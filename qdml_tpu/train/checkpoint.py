@@ -116,6 +116,34 @@ def has_checkpoint(workdir: str, tag: str) -> bool:
     return os.path.isdir(os.path.join(workdir, tag))
 
 
+def latest_tag(workdir: str, prefix: str) -> str | None:
+    """Best restorable tag for a model family (``prefix`` in {'hdce', 'sc',
+    'qsc', 'dce', ...}): ``{prefix}_best`` when present, else ``_last``, else
+    ``_resume`` (whose params are a superset of either). One home for the
+    tag-discovery order the eval CLI and the serving engine both need —
+    ``None`` when the family was never trained in this workdir."""
+    for cand in (f"{prefix}_best", f"{prefix}_last", f"{prefix}_resume"):
+        if has_checkpoint(workdir, cand):
+            return cand
+    return None
+
+
+def restore_params(workdir: str, tag: str) -> tuple[dict, dict]:
+    """Eval-only restore: model variables without optimizer state.
+
+    Works on both payload shapes — ``*_best``/``*_last`` checkpoints hold
+    ``{params[, batch_stats]}`` already, while ``*_resume`` checkpoints add
+    ``opt_state``/``step``, which an inference consumer must not drag onto
+    the device (the Adam moments double the restore footprint). Returns
+    ``({"params": ..., ["batch_stats": ...]}, meta)``.
+    """
+    restored, meta = restore_checkpoint(workdir, tag)
+    out = {"params": restored["params"]}
+    if "batch_stats" in restored:
+        out["batch_stats"] = restored["batch_stats"]
+    return out, meta
+
+
 def _broadcast_meta(meta: dict) -> dict:
     """Under multi-process, make process 0's sidecar meta authoritative.
 

@@ -31,11 +31,31 @@ def _on_event(event: str, **kwargs) -> None:
 def compile_cache_stats() -> dict:
     """Snapshot of this process's compile-cache hit/miss/request counters
     (all zero until :func:`enable_compile_cache` has installed the listener
-    and a jit compile has gone through the cache)."""
+    and a jit compile has gone through the cache). ``requests`` ticks on
+    EVERY compile that consulted the cache; ``misses`` only on compiles long
+    enough to be worth persisting — so "did anything compile?" checks (the
+    serve warmup gate) must watch ``requests``, not just ``misses``."""
     return dict(_COUNTS)
 
 
+def reset_stats() -> None:
+    """Zero the counters in place — for test harnesses and standalone
+    warmup-verification scripts that want a clean window. The counters are
+    PROCESS-WIDE: long-lived consumers that share the process with others
+    (the serving engine, StepClock) must snapshot-and-diff instead of
+    resetting, or they clobber every other reader's run totals."""
+    for k in _COUNTS:
+        _COUNTS[k] = 0
+
+
 def _install_listener() -> None:
+    """Register the jax.monitoring listener exactly once per process.
+
+    Idempotent under repeated :func:`enable_compile_cache` calls — and under
+    direct repeated calls — via the module-level flag, which is only set
+    AFTER successful registration (a failed attempt may retry later without
+    ever double-registering, which would double-count every event).
+    """
     global _LISTENING
     if _LISTENING:
         return

@@ -25,6 +25,44 @@ def test_select_expert_and_one_hot_agree():
         np.testing.assert_allclose(np.asarray(a[i]), np.asarray(stacked[int(pred[i]), i]))
 
 
+def test_routing_variants_agree_property():
+    """Property test over random shapes/dtypes: the gather dispatch and the
+    one-hot einsum dispatch are the same function for ANY in-range ids —
+    including bf16 (0/1 masks and a single-nonzero sum are exact in bf16)
+    and S > 3 (the serving engine is not tied to the reference's 3 experts)."""
+    rng = np.random.default_rng(42)
+    for s, b, d in ((2, 4, 3), (3, 8, 5), (5, 16, 7), (7, 3, 2)):
+        for dtype in (jnp.float32, jnp.bfloat16):
+            stacked = jnp.asarray(rng.standard_normal((s, b, d)), dtype=dtype)
+            logp = jnp.asarray(rng.standard_normal((b, s)), dtype=dtype)
+            pred = jnp.argmax(logp, -1)
+            a = select_expert(stacked, pred)
+            o = one_hot_dispatch(stacked, logp)
+            assert a.dtype == stacked.dtype and o.dtype == stacked.dtype
+            np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(o, np.float32)
+            )
+            # and both really picked row pred[i] of expert slice
+            for i in range(b):
+                np.testing.assert_array_equal(
+                    np.asarray(a[i], np.float32),
+                    np.asarray(stacked[int(pred[i]), i], np.float32),
+                )
+
+
+def test_select_expert_clips_out_of_range_ids():
+    """Corrupted ids clip to the nearest valid expert — identically under
+    eager numpy semantics (where negatives would WRAP) and under jit (where
+    XLA clamps), so the two paths can never diverge."""
+    stacked = jnp.arange(3 * 2 * 4, dtype=jnp.float32).reshape(3, 2, 4)
+    pred = jnp.asarray([5, -4])  # above range, below range
+    eager = select_expert(stacked, pred)
+    jitted = jax.jit(select_expert)(stacked, pred)
+    np.testing.assert_array_equal(np.asarray(eager[0]), np.asarray(stacked[2, 0]))
+    np.testing.assert_array_equal(np.asarray(eager[1]), np.asarray(stacked[0, 1]))
+    np.testing.assert_array_equal(np.asarray(eager), np.asarray(jitted))
+
+
 def _sweep_cfg():
     return ExperimentConfig(
         data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),

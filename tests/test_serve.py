@@ -1,0 +1,523 @@
+"""Serving subsystem: micro-batcher edge cases, bucketed AOT engine parity,
+zero request-path compiles, loadgen harness, checkpoint tag discovery, and
+the report serving-latency section."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from qdml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    ServeConfig,
+    TrainConfig,
+)
+from qdml_tpu.serve import (
+    MicroBatcher,
+    Overloaded,
+    Prediction,
+    Request,
+    ServeEngine,
+    ServeLoop,
+    pick_bucket,
+    power_of_two_buckets,
+)
+from qdml_tpu.serve.loadgen import make_request_samples, run_loadgen
+from qdml_tpu.serve.types import (
+    DEADLINE_AT_DEQUEUE,
+    DEADLINE_AT_SUBMIT,
+    QUEUE_FULL,
+)
+
+
+def _read_jsonl(path):
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher (deterministic fake clock — no sleeping)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _req(rid, deadline=None):
+    return Request(rid=rid, x=np.zeros((2, 2, 2), np.float32), deadline=deadline)
+
+
+def _batcher(clock, max_batch=4, max_wait_s=0.005, max_queue=8):
+    return MicroBatcher(
+        max_batch=max_batch, max_wait_s=max_wait_s, max_queue=max_queue, clock=clock
+    )
+
+
+def test_empty_queue_flush_is_noop():
+    mb = _batcher(FakeClock())
+    batch, shed = mb.next_batch()
+    assert batch == [] and shed == []
+    assert mb.wait_hint() == mb.max_wait_s
+
+
+def test_max_wait_timeout_flushes_single_request():
+    clock = FakeClock()
+    mb = _batcher(clock)
+    assert mb.submit(_req(1)) is None
+    # not aged yet: coalescing window still open
+    batch, shed = mb.next_batch()
+    assert batch == [] and shed == [] and mb.depth == 1
+    assert mb.wait_hint() == pytest.approx(0.005)
+    clock.t = 0.005
+    batch, shed = mb.next_batch()
+    assert [r.rid for r in batch] == [1] and shed == [] and mb.depth == 0
+
+
+def test_full_batch_flushes_without_waiting():
+    clock = FakeClock()
+    mb = _batcher(clock, max_batch=4)
+    for i in range(6):
+        assert mb.submit(_req(i)) is None
+    batch, _ = mb.next_batch()  # t=0: full batch beats the wait window
+    assert [r.rid for r in batch] == [0, 1, 2, 3]
+    assert mb.depth == 2
+
+
+def test_deadline_already_expired_at_dequeue_is_shed():
+    clock = FakeClock()
+    mb = _batcher(clock)
+    assert mb.submit(_req(1, deadline=0.003)) is None
+    assert mb.submit(_req(2, deadline=1.0)) is None
+    clock.t = 0.01  # past req 1's deadline AND past max_wait
+    batch, shed = mb.next_batch()
+    # shed pairs (request, result): the caller needs the REQUEST back to
+    # resolve its future — a dropped future is a client hung forever
+    assert [(r.rid, o.rid) for r, o in shed] == [(1, 1)]
+    assert all(o.reason == DEADLINE_AT_DEQUEUE for _, o in shed)
+    assert shed[0][1].latency_s == pytest.approx(0.01)
+    assert [r.rid for r in batch] == [2]  # live request still served
+
+
+def test_deadline_already_expired_at_submit_rejected():
+    clock = FakeClock()
+    clock.t = 5.0
+    mb = _batcher(clock)
+    out = mb.submit(_req(1, deadline=4.0))
+    assert isinstance(out, Overloaded) and out.reason == DEADLINE_AT_SUBMIT
+    assert mb.depth == 0
+
+
+def test_bounded_queue_sheds_instead_of_collapsing():
+    mb = _batcher(FakeClock(), max_batch=2, max_queue=3)
+    assert all(mb.submit(_req(i)) is None for i in range(3))
+    out = mb.submit(_req(99))
+    assert isinstance(out, Overloaded) and out.reason == QUEUE_FULL
+    assert mb.depth == 3  # rejected request never enqueued
+
+
+def test_bucket_overflow_falls_back_to_largest():
+    buckets = (1, 2, 4, 8)
+    assert pick_bucket(3, buckets) == 4
+    assert pick_bucket(8, buckets) == 8
+    assert pick_bucket(50, buckets) == 8  # oversize -> largest, never a new shape
+    assert power_of_two_buckets(8) == (1, 2, 4, 8)
+    assert power_of_two_buckets(6) == (1, 2, 4, 6)  # max_batch always last
+    with pytest.raises(ValueError):
+        power_of_two_buckets(0)
+    with pytest.raises(ValueError):
+        MicroBatcher(max_batch=8, max_queue=4)  # queue smaller than one batch
+
+
+# ---------------------------------------------------------------------------
+# Engine: restore -> warmup -> serve, parity with the offline forward
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg():
+    return ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        serve=ServeConfig(max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=32),
+    )
+
+
+@pytest.fixture(scope="module")
+def warmed():
+    """One warmed engine + offline reference shared by the serving tests
+    (each bucket is an XLA compile; module scope keeps the suite fast)."""
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = _tiny_cfg()
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    engine = ServeEngine(cfg, hdce_vars, {"params": sc_state.params})
+    samples = make_request_samples(cfg, 32)
+    offline_h, offline_pred = engine.offline_forward(samples["x"])
+    engine.warmup()
+    return cfg, engine, samples, offline_h, offline_pred
+
+
+def test_unwarmed_engine_refuses_request_path(warmed):
+    cfg, engine, *_ = warmed
+    fresh = ServeEngine(cfg, engine._hdce_vars, engine._clf_vars)
+    with pytest.raises(RuntimeError, match="warmup"):
+        fresh.infer(np.zeros((2, *cfg.image_hw, 2), np.float32))
+
+
+def test_infer_parity_across_buckets(warmed):
+    """Every bucket (and the padded partial fills) must reproduce the offline
+    eval forward on the same checkpoint — padding rows cannot leak."""
+    cfg, engine, samples, offline_h, offline_pred = warmed
+    for n in (1, 3, 4, 5, 8):
+        h, pred, bucket = engine.infer(samples["x"][:n])
+        assert bucket == pick_bucket(n, engine.buckets)
+        assert h.shape == (n, cfg.h_out_dim)
+        np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(pred, offline_pred[:n])
+
+
+def test_oversize_batch_serves_in_largest_bucket_chunks(warmed):
+    cfg, engine, samples, offline_h, offline_pred = warmed
+    n = 19  # > largest bucket (8): 8 + 8 + 3-padded-to-4
+    x = np.concatenate([samples["x"]] * 2)[:n]
+    ref = np.concatenate([offline_h] * 2)[:n]
+    h, pred, bucket = engine.infer(x)
+    assert bucket == engine.buckets[-1] and h.shape[0] == n
+    np.testing.assert_allclose(h, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_serve_smoke_zero_request_path_compiles(warmed):
+    """The tier-1 acceptance smoke: restore -> warmup -> N requests through
+    the full loop -> parity with the offline forward and NO compile-cache
+    activity on the request path (the engine's own post-warmup snapshot —
+    the process-global counters are never reset by serving)."""
+    cfg, engine, samples, offline_h, offline_pred = warmed
+    loop = ServeLoop(engine).start()
+    try:
+        futs = [loop.submit(samples["x"][i], rid=i) for i in range(20)]
+        results = [f.result(timeout=30.0) for f in futs]
+    finally:
+        loop.stop()
+    assert all(isinstance(r, Prediction) for r in results)
+    served = np.stack([r.h for r in sorted(results, key=lambda r: r.rid)])
+    np.testing.assert_allclose(served, offline_h[:20], rtol=1e-5, atol=1e-5)
+    assert [r.scenario for r in sorted(results, key=lambda r: r.rid)] == [
+        int(p) for p in offline_pred[:20]
+    ]
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+    assert all(r.latency_s >= 0 and r.bucket in engine.buckets for r in results)
+
+
+def test_loadgen_fast_run_emits_manifest_headed_telemetry(warmed, tmp_path):
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    cfg, engine, *_ = warmed
+    path = str(tmp_path / "loadgen.metrics.jsonl")
+    logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+    summary = run_loadgen(cfg, engine, rate=2000.0, n=48, logger=logger)
+    logger.close()
+
+    assert summary["completed"] == 48 and summary["n_shed"] == 0
+    assert summary["compile_cache_after_warmup"] == {"hits": 0, "misses": 0, "requests": 0}
+    # per-request NMSE parity with the offline forward on the same checkpoint
+    assert summary["parity_max_abs_err"] < 1e-4
+    assert summary["pred_agreement"] == 1.0
+    assert summary["nmse_db_served"] == pytest.approx(summary["nmse_db_offline"], abs=1e-6)
+    assert {"p50_ms", "p95_ms", "p99_ms"} <= set(summary["latency_ms"])
+
+    lines = _read_jsonl(path)
+    assert lines[0]["kind"] == "manifest"
+    kinds = [l.get("kind") for l in lines]
+    assert "serve_summary" in kinds
+    names = {l.get("name") for l in lines if l.get("kind") in ("span", "counters")}
+    assert {"serve_batch", "serve_request", "serve"} <= names
+    cnt = [l for l in lines if l.get("kind") == "counters" and l.get("name") == "serve"][0]
+    assert cnt["latency"]["n"] == 48 and cnt["compile_cache"]["requests"] == 0
+
+
+def test_socket_server_roundtrip(warmed):
+    """The `qdml-tpu serve` framing layer: newline-JSON over local TCP."""
+    import asyncio
+    import socket
+    from concurrent.futures import Future
+
+    from qdml_tpu.serve.server import serve_async
+
+    cfg, engine, samples, offline_h, offline_pred = warmed
+    loop_ = ServeLoop(engine).start()
+    aloop = asyncio.new_event_loop()
+    t = threading.Thread(target=aloop.run_forever, daemon=True)
+    t.start()
+    ready: Future = Future()
+    task = asyncio.run_coroutine_threadsafe(
+        serve_async(loop_, "127.0.0.1", 0, ready), aloop
+    )
+    try:
+        port = ready.result(timeout=10.0)
+        with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+            fh = sk.makefile("rw")
+            for i in range(3):
+                fh.write(json.dumps({"id": i, "x": samples["x"][i].tolist()}) + "\n")
+                fh.flush()
+                resp = json.loads(fh.readline())
+                assert resp["ok"] and resp["id"] == i
+                assert resp["pred"] == int(offline_pred[i])
+                np.testing.assert_allclose(
+                    np.asarray(resp["h"], np.float32), offline_h[i], rtol=1e-5, atol=1e-5
+                )
+                assert resp["latency_ms"] >= 0
+            # malformed line answers with a typed error, connection survives
+            fh.write("not json\n")
+            fh.flush()
+            assert json.loads(fh.readline()) == {"ok": False, "reason": "bad_json"}
+            # valid JSON but bad payload: typed bad_request, connection and
+            # worker both survive (nothing reaches the batcher)
+            fh.write(json.dumps({"id": 9}) + "\n")
+            fh.flush()
+            resp = json.loads(fh.readline())
+            assert resp["ok"] is False and resp["reason"].startswith("bad_request")
+            fh.write(json.dumps({"id": 10, "x": [[1.0]]}) + "\n")
+            fh.flush()
+            resp = json.loads(fh.readline())
+            assert resp["ok"] is False and "shape" in resp["reason"]
+            # and a real request still round-trips afterwards
+            fh.write(json.dumps({"id": 11, "x": samples["x"][0].tolist()}) + "\n")
+            fh.flush()
+            assert json.loads(fh.readline())["ok"] is True
+    finally:
+        task.cancel()
+        aloop.call_soon_threadsafe(aloop.stop)
+        t.join(timeout=5.0)
+        loop_.stop()
+
+
+def test_dequeue_shed_resolves_future(warmed):
+    """Regression: a request whose deadline expires IN QUEUE must still
+    resolve its future (typed Overloaded) — driving the loop's pump directly
+    with a fake clock, no worker thread, no races."""
+    cfg, engine, samples, *_ = warmed
+    clock = FakeClock()
+    loop = ServeLoop(
+        engine,
+        batcher=MicroBatcher(max_batch=4, max_wait_s=0.005, max_queue=8, clock=clock),
+    )
+    fut = loop.submit(samples["x"][0], rid=1, deadline_ms=3.0)
+    assert not fut.done()
+    clock.t = 0.01  # deadline (t=0.003) passes while queued
+    loop._serve_one()
+    res = fut.result(timeout=1.0)
+    assert isinstance(res, Overloaded) and res.reason == DEADLINE_AT_DEQUEUE
+    assert loop.metrics.shed[DEADLINE_AT_DEQUEUE] == 1
+
+
+def test_submit_validates_shape_synchronously(warmed):
+    """Client errors never reach the worker (one ragged request inside a
+    coalesced batch would crash everyone else's batch)."""
+    cfg, engine, *_ = warmed
+    loop = ServeLoop(engine)
+    with pytest.raises(ValueError, match="shape"):
+        loop.submit(np.zeros((3, 3), np.float32))
+
+
+def test_dead_worker_rejects_instead_of_stranding(warmed):
+    """submit() on a loop whose worker has exited resolves immediately with
+    a typed shutdown result — never an unresolvable future."""
+    from qdml_tpu.serve.types import SHUTDOWN
+
+    cfg, engine, samples, *_ = warmed
+    loop = ServeLoop(engine).start()
+    loop.stop()
+    res = loop.submit(samples["x"][0]).result(timeout=1.0)
+    assert isinstance(res, Overloaded) and res.reason == SHUTDOWN
+
+
+def test_overload_shedding_under_burst(warmed):
+    """A burst beyond the bounded queue resolves every future with a typed
+    result — completed + shed == submitted, nothing hangs or raises."""
+    cfg, engine, *_ = warmed
+    batcher = MicroBatcher(max_batch=4, max_wait_s=0.05, max_queue=4)
+    loop = ServeLoop(engine, batcher=batcher)
+    # don't start the worker yet: the whole burst lands on a stalled queue
+    x = np.zeros((2, *cfg.image_hw, 2), np.float32)[0]
+    futs = [loop.submit(x, rid=i) for i in range(16)]
+    loop.start()
+    try:
+        results = [f.result(timeout=30.0) for f in futs]
+    finally:
+        loop.stop()
+    ok = [r for r in results if isinstance(r, Prediction)]
+    shed = [r for r in results if isinstance(r, Overloaded)]
+    assert len(ok) + len(shed) == 16
+    assert len(shed) == 12 and all(o.reason == QUEUE_FULL for o in shed)
+    assert loop.metrics.shed[QUEUE_FULL] == 12
+
+
+@pytest.mark.slow
+def test_loadgen_soak_open_loop_with_deadlines(warmed, tmp_path):
+    """Soak: sustained open-loop Poisson traffic with deadlines over a small
+    queue — load is shed (typed), everything else parity-checks, and the
+    request path still never compiles."""
+    import dataclasses
+
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    cfg, engine, *_ = warmed
+    cfg = dataclasses.replace(
+        cfg, serve=dataclasses.replace(cfg.serve, max_queue=16, max_wait_ms=0.5)
+    )
+    logger = MetricsLogger(
+        str(tmp_path / "soak.jsonl"), echo=False, manifest=run_manifest(cfg)
+    )
+    summary = run_loadgen(
+        cfg, engine, rate=2000.0, n=1500, deadline_ms=100.0, logger=logger
+    )
+    logger.close()
+    assert summary["completed"] + summary["n_shed"] == 1500
+    assert summary["completed"] > 0
+    assert summary["compile_cache_after_warmup"]["requests"] == 0
+    assert summary["parity_max_abs_err"] < 1e-4
+    assert set(summary["shed"]) <= {QUEUE_FULL, DEADLINE_AT_SUBMIT, DEADLINE_AT_DEQUEUE}
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint tag discovery + eval-only restore (serving's restore path)
+# ---------------------------------------------------------------------------
+
+
+def test_latest_tag_preference_and_eval_only_restore(tmp_path):
+    from qdml_tpu.train.checkpoint import latest_tag, restore_params, save_checkpoint
+
+    wd = str(tmp_path)
+    assert latest_tag(wd, "hdce") is None
+
+    resume_payload = {
+        "params": {"w": np.arange(4.0, dtype=np.float32)},
+        "opt_state": {"mu": np.ones(4, np.float32)},
+        "step": np.asarray(7),
+        "batch_stats": {"mean": np.zeros(4, np.float32)},
+    }
+    save_checkpoint(wd, "hdce_resume", resume_payload, {"epoch": 3})
+    assert latest_tag(wd, "hdce") == "hdce_resume"
+    # eval-only restore: params + batch_stats come back, optimizer state does not
+    vars_, meta = restore_params(wd, "hdce_resume")
+    assert set(vars_) == {"params", "batch_stats"} and meta["epoch"] == 3
+    np.testing.assert_array_equal(vars_["params"]["w"], resume_payload["params"]["w"])
+
+    save_checkpoint(wd, "hdce_last", {"params": {"w": np.ones(4, np.float32)}})
+    assert latest_tag(wd, "hdce") == "hdce_last"
+    save_checkpoint(wd, "hdce_best", {"params": {"w": np.zeros(4, np.float32)}})
+    assert latest_tag(wd, "hdce") == "hdce_best"  # best beats last beats resume
+    # a params-only payload restores without a batch_stats key
+    vars_, _ = restore_params(wd, "hdce_best")
+    assert set(vars_) == {"params"}
+    assert latest_tag(wd, "qsc") is None  # other families unaffected
+
+
+# ---------------------------------------------------------------------------
+# Compile-cache counters: listener idempotency + reset
+# ---------------------------------------------------------------------------
+
+
+def test_install_listener_idempotent(monkeypatch):
+    from jax import monitoring
+
+    from qdml_tpu.utils import compile_cache as cc
+
+    calls = []
+    monkeypatch.setattr(cc, "_LISTENING", False)
+    monkeypatch.setattr(monitoring, "register_event_listener", lambda fn: calls.append(fn))
+    cc.enable_compile_cache()
+    cc.enable_compile_cache()
+    cc._install_listener()
+    assert len(calls) == 1  # one listener, however many times enabling repeats
+
+
+def test_reset_stats_zeroes_counters():
+    from qdml_tpu.utils import compile_cache as cc
+
+    cc._on_event("/jax/compilation_cache/cache_hits")
+    cc._on_event("/jax/compilation_cache/cache_misses")
+    cc._on_event("/jax/compilation_cache/compile_requests_use_cache")
+    stats = cc.compile_cache_stats()
+    assert stats["hits"] >= 1 and stats["misses"] >= 1 and stats["requests"] >= 1
+    cc.reset_stats()
+    assert cc.compile_cache_stats() == {"hits": 0, "misses": 0, "requests": 0}
+    # snapshot is a copy, not the live dict
+    snap = cc.compile_cache_stats()
+    cc._on_event("/jax/compilation_cache/cache_hits")
+    assert snap["hits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Report: serving-latency section
+# ---------------------------------------------------------------------------
+
+
+def _serve_summary_rec(p50, p95, p99, rps, platform=None):
+    rec = {
+        "kind": "serve_summary",
+        "rps": rps,
+        "latency_ms": {"n": 100, "p50_ms": p50, "p95_ms": p95, "p99_ms": p99},
+    }
+    if platform is not None:
+        rec["platform"] = platform
+    return rec
+
+
+def _write(tmp_path, name, *objs):
+    p = tmp_path / name
+    with open(p, "w") as fh:
+        for o in objs:
+            fh.write(json.dumps(o) + "\n")
+    return str(p)
+
+
+def test_report_serving_latency_section_and_gate(tmp_path):
+    from qdml_tpu.telemetry.report import EXIT_OK, EXIT_REGRESSION, build_report, report_main
+
+    base = _write(tmp_path, "base.jsonl", _serve_summary_rec(5.0, 9.0, 12.0, 800.0))
+    # p99 +50%, rps -40%: both must gate
+    bad = _write(tmp_path, "bad.jsonl", _serve_summary_rec(5.1, 9.2, 18.0, 480.0))
+    md, regressions, armed = build_report([bad], base, 10.0)
+    assert "## serving latency" in md and armed
+    names = {r["metric"] for r in regressions}
+    assert "serving.p99_ms" in names and "serve.rps" in names
+    assert "serving.p50_ms" not in names  # within threshold
+    assert report_main([f"--current={bad}", f"--baseline={base}"]) == EXIT_REGRESSION
+
+    # latency IMPROVING (going down) must not gate
+    good = _write(tmp_path, "good.jsonl", _serve_summary_rec(2.0, 4.0, 6.0, 900.0))
+    md, regressions, armed = build_report([good], base, 10.0)
+    assert not regressions and "improved" in md
+    assert report_main([f"--current={good}", f"--baseline={base}"]) == EXIT_OK
+
+
+def test_report_serving_platform_mismatch_disarms(tmp_path):
+    """A CPU loadgen run diffed against a TPU baseline compares hardware,
+    not code: deltas shown, serving gate disarmed (loadgen stamps its
+    backend into serve_summary precisely so this check can fire)."""
+    from qdml_tpu.telemetry.report import EXIT_OK, build_report, report_main
+
+    base = _write(
+        tmp_path, "tpu.jsonl", _serve_summary_rec(1.0, 2.0, 3.0, 9000.0, platform="tpu")
+    )
+    cur = _write(
+        tmp_path, "cpu.jsonl", _serve_summary_rec(10.0, 20.0, 30.0, 400.0, platform="cpu")
+    )
+    md, regressions, armed = build_report([cur], base, 10.0)
+    assert regressions and not armed and "platform mismatch" in md
+    assert report_main([f"--current={cur}", f"--baseline={base}"]) == EXIT_OK
