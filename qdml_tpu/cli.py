@@ -16,10 +16,12 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli import-torch --out=SRCDIR  # reference .pth -> orbax
     python -m qdml_tpu.cli export-torch --out=DSTDIR  # orbax -> reference .pth
     python -m qdml_tpu.cli report --current=PATH[,..] --baseline=PATH
-                                  [--threshold=PCT] [--out=FILE.md]
-                                  # telemetry delta table; exit 3 on regression
+                                  [--threshold=PCT] [--out=FILE.md] [--json=FILE.json]
+                                  # telemetry delta table (+ cost section,
+                                  # machine-readable gate); exit 3 on regression
     python -m qdml_tpu.cli serve  [--serve.port=8377 ...]  # online inference:
                                   # restore ckpt, AOT-warm buckets, JSON/TCP loop
+                                  # ({"op": "metrics"} returns live counters)
     python -m qdml_tpu.cli loadgen [--rate=RPS] [--n=N]    # open-loop Poisson
                                   # traffic vs an in-process warmed engine
 
@@ -226,7 +228,12 @@ def main(argv: list[str] | None = None) -> int:
             loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
             batch = next(iter(loader.epoch(0)))
             model, state = init_hdce_state(cfg, loader.steps_per_epoch)
-            step = make_hdce_train_step(model, state.tx)
+            # probes follow the same knob as the train loops, so the profiled
+            # program is the one a real run with this config executes (and
+            # --train.probe_every=0 compiles them out, matching its contract)
+            step = make_hdce_train_step(
+                model, state.tx, probes=cfg.train.probe_every > 0
+            )
             with span("compile"):  # compile + first execute, outside the trace
                 state, m = step(state, batch)
                 force(m["loss"])
@@ -335,6 +342,15 @@ def main(argv: list[str] | None = None) -> int:
         # reference prints total minutes (Runner...py:437-440)
         print(f"total time: {(time.time() - t0) / 60.0:.2f} min")
         return 0
+    except Exception as e:
+        # divergence watchdog trips arrive as typed errors carrying the
+        # flight-recorder dump path — surface the pointer, not a traceback
+        from qdml_tpu.telemetry import DivergenceError
+
+        if isinstance(e, DivergenceError):
+            print(f"DIVERGED: {e}")
+            return 4
+        raise
     finally:
         # always detach the global sink and close the stream — an exception
         # mid-command (or an in-process caller) must not leave later spans

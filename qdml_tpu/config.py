@@ -155,6 +155,21 @@ class TrainConfig:
     # multiply_add_fusion.53). Accumulation still happens in f32; only the
     # stored moments are rounded. A documented deviation, never the default.
     moments_dtype: str = "float32"
+    # Numerics flight recorder (telemetry/numerics.py, docs/FLIGHTREC.md).
+    # probe_every: log one on-device `numerics` probe record (grad/update
+    # norms, fused NaN/Inf count) every N host-visible steps — the probe is
+    # computed inside the compiled step (no extra compiles, pinned in
+    # tests), only the device->host fetch follows this cadence; 0 compiles
+    # the probes OUT of the step program entirely (static flag — the
+    # watchdog's loss checks still work). The first step of a run is always
+    # logged.
+    probe_every: int = 100
+    # Divergence watchdog: convert NaN/Inf losses/grads (and, when
+    # watchdog_grad_norm_max > 0, grad-norm explosions past that ceiling)
+    # into a typed DivergenceError with a flight-recorder dump
+    # (results/<name>/flightrec/) instead of a silently garbage run.
+    watchdog: bool = True
+    watchdog_grad_norm_max: float = 0.0
     seed: int = 0
     workdir: str = "workspace"   # checkpoint root (reference ./workspace/Pn_128/HDCE)
     resume: bool = False         # reference cannot resume; we can
@@ -196,6 +211,11 @@ class ServeConfig:
     # Explicit bucket sizes; () = powers of two up to max_batch. Tests and
     # small deployments shrink this to bound warmup compile count.
     buckets: tuple[int, ...] = ()
+    # Serve-loop worker threads pumping batcher -> engine. 1 (default) is the
+    # PR-2 behavior; >1 overlaps host-side result handling with device
+    # dispatch. Each worker keeps its own ServeMetrics; snapshots merge them
+    # (telemetry Histogram.merge), so quantiles aggregate exactly.
+    workers: int = 1
     # Local socket endpoint for `qdml-tpu serve`.
     host: str = "127.0.0.1"
     port: int = 8377

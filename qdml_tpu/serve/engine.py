@@ -39,6 +39,8 @@ from qdml_tpu.models.qsc import QSCP128
 from qdml_tpu.ops.routing import select_expert
 from qdml_tpu.serve.batcher import pick_bucket, power_of_two_buckets
 from qdml_tpu.telemetry import span
+from qdml_tpu.telemetry import cost as _cost
+from qdml_tpu.telemetry.spans import get_sink
 from qdml_tpu.train.hdce import HDCE
 from qdml_tpu.utils.compile_cache import compile_cache_stats, enable_compile_cache
 
@@ -82,6 +84,9 @@ class ServeEngine:
         self._compiled: dict[int, Any] = {}
         self._warm = False
         self._stats0: dict = {}
+        # per-bucket XLA cost records (flops/bytes/peak memory/roofline),
+        # filled by warmup from each AOT-compiled executable
+        self.bucket_cost: dict[str, dict] = {}
 
     # -- construction -------------------------------------------------------
 
@@ -176,6 +181,15 @@ class ServeEngine:
                 )
                 jax.block_until_ready((h, pred))
                 self._compiled[b] = compiled
+                # XLA cost accounting straight off the AOT executable (the
+                # one place a COMPILED analysis is free — no extra compile,
+                # we are holding the executable anyway): flops, bytes, peak
+                # temp memory, roofline class per bucket
+                rec = _cost.analyze(compiled)
+                self.bucket_cost[str(b)] = rec
+                sink = get_sink()
+                if sink is not None and getattr(sink, "active", False):
+                    sink.emit("cost", name="serve_bucket", bucket=b, **rec)
         post = compile_cache_stats()
         # SNAPSHOT the post-warmup totals (never reset the process-global
         # counters: StepClock/bench records in the same process must keep
@@ -185,6 +199,7 @@ class ServeEngine:
         return {
             "buckets": self.buckets,
             "compile": {k: post[k] - pre.get(k, 0) for k in post},
+            "cost": self.bucket_cost,
         }
 
     def request_path_compiles(self) -> dict:

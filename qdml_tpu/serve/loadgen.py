@@ -107,6 +107,14 @@ def run_loadgen(
         results = [f.result(timeout=60.0) for f in futures]
     loop.stop()
     cache_after = engine.request_path_compiles()
+    # End-of-run poll of the live `{"op": "metrics"}` view, folded SLIM: the
+    # summary below is already built from the same (merged) collectors, so
+    # only the fields the verb adds ride along — worker/queue/bucket state
+    # plus `completed` as a cross-check that the verb saw the same window.
+    live = loop.live_metrics()
+    live_slim = {
+        k: live[k] for k in ("workers", "queue_depth_now", "buckets", "completed")
+    }
 
     done = {r.rid: r for r in results if isinstance(r, Prediction)}
     shed = [r for r in results if not isinstance(r, Prediction)]
@@ -127,7 +135,10 @@ def run_loadgen(
 
     import jax
 
-    summary = metrics.summary(
+    # aggregate across ALL serve-loop workers (== metrics when workers=1);
+    # worker 0's collector alone would undercount a multi-worker loop
+    metrics_all = loop.merged_metrics(sink=metrics._sink)
+    summary = metrics_all.summary(
         compile_cache=cache_after,
         # labels the record for report's platform-mismatch disarm: a CPU
         # loadgen diffed against a TPU baseline compares hardware, not code
@@ -141,8 +152,9 @@ def run_loadgen(
         nmse_db_served=nmse_served,
         nmse_db_offline=nmse_offline,
         warmup=warm,
+        server_metrics=live_slim,
     )
-    metrics.flush(compile_cache=cache_after)
+    metrics_all.flush(compile_cache=cache_after, workers=loop.workers)
     if logger is not None:
         logger.telemetry.write_raw(summary)
     return summary

@@ -76,6 +76,23 @@ class ServeMetrics:
     def observe_shed(self, o: Overloaded) -> None:
         self.shed[o.reason] = self.shed.get(o.reason, 0) + 1
 
+    def merge(self, other: "ServeMetrics") -> "ServeMetrics":
+        """Fold another collector into this one (``Histogram.merge`` keeps
+        raw samples, so the merged quantiles are exact, not approximate).
+        Per-WORKER collectors aggregate this way: each serve-loop worker
+        thread records into its own ServeMetrics — no cross-thread lock on
+        the hot path — and snapshots merge on demand. The window start is
+        the earliest of the two, so a merged ``rps`` spans the union."""
+        self.latency.merge(other.latency)
+        self.batch_fill.merge(other.batch_fill)
+        self.queue_depth.merge(other.queue_depth)
+        self.batches += other.batches
+        self.completed += other.completed
+        for k, v in other.shed.items():
+            self.shed[k] = self.shed.get(k, 0) + v
+        self._t0 = min(self._t0, other._t0)
+        return self
+
     def _scaled(self, hist: Histogram) -> dict | None:
         """Histogram.summary() without the ms scaling (fill/depth are not
         durations; undo the *1e3 and rename)."""
@@ -107,6 +124,14 @@ class ServeMetrics:
                 compile_cache=compile_cache,
                 **tags,
             )
+
+    def snapshot(self, compile_cache: dict | None = None, **extra) -> dict:
+        """The live-metrics view (``{"op": "metrics"}`` serve verb): the
+        summary fields without the ``serve_summary`` record kind — a poll of
+        a running server is a reading, not a run artifact."""
+        s = self.summary(compile_cache=compile_cache, **extra)
+        s.pop("kind", None)
+        return s
 
     def summary(self, compile_cache: dict | None = None, **extra) -> dict:
         """The run-level ``serve_summary`` record (``qdml-tpu report``'s

@@ -34,13 +34,22 @@ from qdml_tpu.serve.types import SHUTDOWN, Overloaded, Prediction, Request
 
 
 class ServeLoop:
-    """Worker thread pumping batcher -> engine -> futures."""
+    """Worker thread(s) pumping batcher -> engine -> futures.
+
+    ``workers`` (default ``cfg.serve.workers``) threads share the one
+    batcher and engine; each records into its OWN :class:`ServeMetrics`
+    (no cross-thread contention on the hot path) and
+    :meth:`merged_metrics`/:meth:`live_metrics` aggregate them exactly via
+    ``Histogram.merge``. ``self.metrics`` is worker 0's collector — the
+    single-worker default keeps the PR-2 behavior and tests unchanged.
+    """
 
     def __init__(
         self,
         engine: ServeEngine,
         batcher: MicroBatcher | None = None,
         metrics: ServeMetrics | None = None,
+        workers: int | None = None,
     ):
         serve_cfg = engine.cfg.serve
         self.engine = engine
@@ -50,12 +59,21 @@ class ServeLoop:
             max_queue=serve_cfg.max_queue,
         )
         self.metrics = metrics or ServeMetrics()
+        self.workers = max(1, int(workers if workers is not None else serve_cfg.workers))
+        self._worker_metrics = [self.metrics] + [
+            ServeMetrics(
+                sink=self.metrics._sink, log_requests=self.metrics.log_requests
+            )
+            for _ in range(self.workers - 1)
+        ]
         self._default_deadline_s = (
             serve_cfg.deadline_ms / 1e3 if serve_cfg.deadline_ms > 0 else None
         )
         self._stop = threading.Event()
         self._wake = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
+        self._exit_lock = threading.Lock()
+        self._live_workers = 0
         self._started = False  # stays True after stop(): a finished loop rejects
         self._rid = 0
 
@@ -79,7 +97,7 @@ class ServeLoop:
         if rid is None:
             self._rid += 1
             rid = self._rid
-        if self._started and (self._thread is None or not self._thread.is_alive()):
+        if self._started and not any(t.is_alive() for t in self._threads):
             # a stopped or CRASHED worker must not accept work: the queue
             # would grow with futures nobody will ever resolve (clients hung
             # forever behind a server that still accepts connections).
@@ -111,32 +129,68 @@ class ServeLoop:
         if not self.engine._compiled:
             self.engine.warmup()
         self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True, name="serve-loop")
+        self._threads = [
+            threading.Thread(
+                target=self._run,
+                args=(self._worker_metrics[i],),
+                daemon=True,
+                name=f"serve-loop-{i}",
+            )
+            for i in range(self.workers)
+        ]
         self._started = True
-        self._thread.start()
+        self._live_workers = len(self._threads)
+        for t in self._threads:
+            t.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
-        """Stop the worker; with ``drain`` (default) only after the queue has
-        emptied, so every submitted future resolves."""
-        if self._thread is None:
+        """Stop the workers; with ``drain`` (default) only after the queue
+        has emptied, so every submitted future resolves."""
+        if not self._threads:
             return
         if drain:
-            while self.batcher.depth > 0 and self._thread.is_alive():
+            while self.batcher.depth > 0 and any(t.is_alive() for t in self._threads):
                 self._wake.set()
                 time.sleep(0.001)
         self._stop.set()
         self._wake.set()
-        self._thread.join(timeout=10.0)
-        self._thread = None
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads = []
 
-    def _serve_one(self) -> bool:
+    def merged_metrics(self, sink=None) -> ServeMetrics:
+        """All workers' collectors folded into one fresh ServeMetrics (exact
+        quantile aggregation — ``Histogram.merge`` keeps raw samples).
+        ``sink`` binds the aggregate's flush target (loadgen passes its
+        logger's telemetry stream)."""
+        agg = ServeMetrics(sink=sink, log_requests=False)
+        for m in self._worker_metrics:
+            agg.merge(m)
+        return agg
+
+    def live_metrics(self) -> dict:
+        """The ``{"op": "metrics"}`` serve-verb payload: merged per-worker
+        counters/histograms, current queue depth, bucket layout, and the
+        request-path compile-cache snapshot — a running server is observable
+        without restarting it. Safe to call any time (also after stop)."""
+        return self.merged_metrics().snapshot(
+            compile_cache=self.engine.request_path_compiles(),
+            workers=self.workers,
+            queue_depth_now=self.batcher.depth,
+            buckets=list(self.engine.buckets),
+        )
+
+    def _serve_one(self, metrics: ServeMetrics | None = None) -> bool:
         """Single batcher pump: resolve sheds, serve at most one batch.
-        Returns True when any work happened (the loop's idle detector)."""
+        Returns True when any work happened (the loop's idle detector).
+        ``metrics`` is the calling worker's collector (worker 0's when
+        driven directly, e.g. by the fake-clock tests)."""
+        metrics = metrics if metrics is not None else self.metrics
         depth = self.batcher.depth
         batch, shed = self.batcher.next_batch()
         for r, o in shed:
-            self.metrics.observe_shed(o)
+            metrics.observe_shed(o)
             if r.future is not None:
                 r.future.set_result(o)
         if not batch:
@@ -169,28 +223,34 @@ class ServeLoop:
             preds.append(p)
         # metrics before resolution: a client awaiting the future must be able
         # to read a consistent histogram the moment its result arrives
-        self.metrics.observe_batch(preds, bucket, depth, dur)
+        metrics.observe_batch(preds, bucket, depth, dur)
         for r, p in zip(batch, preds):
             if r.future is not None:
                 r.future.set_result(p)
         return True
 
-    def _run(self) -> None:
+    def _run(self, metrics: ServeMetrics) -> None:
         try:
             while not self._stop.is_set():
-                if not self._serve_one():
+                if not self._serve_one(metrics):
                     # idle: sleep until the oldest request ages out or a submit wakes us
                     self._wake.wait(timeout=max(self.batcher.wait_hint(), 1e-4))
                     self._wake.clear()
         finally:
             # shutdown OR crash: resolve EVERYTHING still queued (no silent
-            # hangs) — keep pumping, the queue may hold several batches
-            while True:
+            # hangs) — but only once no OTHER worker can still serve it. A
+            # single crashed worker must not shed a queue its surviving
+            # peers are actively draining; the LAST worker out (crash or
+            # stop) always drains, so nothing strands either way.
+            with self._exit_lock:
+                self._live_workers -= 1
+                last_out = self._live_workers <= 0
+            while self._stop.is_set() or last_out:
                 batch, shed = self.batcher.next_batch(now=float("inf"))
                 if not batch and not shed:
                     break
                 for r, o in shed:
-                    self.metrics.observe_shed(o)
+                    metrics.observe_shed(o)
                     if r.future is not None:
                         r.future.set_result(o)
                 for r in batch:
@@ -225,6 +285,19 @@ async def _handle(reader, writer, loop_: ServeLoop) -> None:
             msg = json.loads(line)
         except json.JSONDecodeError:
             writer.write(b'{"ok": false, "reason": "bad_json"}\n')
+            await writer.drain()
+            continue
+        if isinstance(msg, dict) and msg.get("op") == "metrics":
+            # live observability verb: counters/histograms/compile-cache of
+            # the RUNNING server, no restart, no inference submitted. Off the
+            # event loop: the merge copies+sorts every raw histogram sample,
+            # which is O(requests served) on a long-lived server — it must
+            # not stall every connected client's reply path while it runs.
+            metrics_view = await asyncio.get_running_loop().run_in_executor(
+                None, loop_.live_metrics
+            )
+            reply = {"id": msg.get("id"), "ok": True, "metrics": metrics_view}
+            writer.write((json.dumps(reply) + "\n").encode())
             await writer.drain()
             continue
         try:
@@ -271,15 +344,18 @@ def run_server(cfg: ExperimentConfig, engine: ServeEngine, logger=None) -> None:
     """Blocking entry for ``qdml-tpu serve``: warm, announce, serve until
     interrupted; flush serving counters on the way out."""
     metrics = ServeMetrics()
-    loop_ = ServeLoop(engine, metrics=metrics).start()
+    loop_ = ServeLoop(engine, metrics=metrics, workers=cfg.serve.workers).start()
     print(
         json.dumps(
             {
                 "serving": f"{cfg.serve.host}:{cfg.serve.port}",
                 "buckets": list(engine.buckets),
+                "workers": loop_.workers,
                 # post-warmup counters: anything non-zero here (or later)
                 # is a compile the warmup failed to cover
                 "compile_cache_after_warmup": engine.request_path_compiles(),
+                # per-bucket XLA cost accounting from the AOT warmup
+                "cost": engine.bucket_cost,
             }
         ),
         flush=True,
@@ -290,4 +366,7 @@ def run_server(cfg: ExperimentConfig, engine: ServeEngine, logger=None) -> None:
         pass
     finally:
         loop_.stop(drain=False)
-        metrics.flush(compile_cache=engine.request_path_compiles())
+        # merged across workers: the same aggregate the metrics verb serves
+        loop_.merged_metrics().flush(
+            compile_cache=engine.request_path_compiles(), workers=loop_.workers
+        )

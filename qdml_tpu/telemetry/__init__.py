@@ -25,6 +25,13 @@ conventions: ``docs/TELEMETRY.md``.
 """
 
 from qdml_tpu.telemetry.core import Telemetry, is_primary  # noqa: F401
+from qdml_tpu.telemetry import cost  # noqa: F401
+from qdml_tpu.telemetry.numerics import (  # noqa: F401
+    DivergenceError,
+    FlightRecorder,
+    Watchdog,
+    probe_tree,
+)
 from qdml_tpu.telemetry.counters import (  # noqa: F401
     Histogram,
     StepClock,

@@ -1,0 +1,176 @@
+"""XLA cost accounting: FLOPs, bytes, peak memory, roofline classification.
+
+A throughput regression has two very different causes — the program got
+slower, or the program *changed* (more FLOPs, more bytes) — and telemetry
+that records only samples/sec cannot tell them apart. This module records
+what the compiled HLO actually costs, straight from XLA's own analyses:
+
+- :func:`analyze` accepts either a ``jax.stages.Compiled`` (full:
+  ``cost_analysis()`` + ``memory_analysis()``) or a ``jax.stages.Lowered``
+  (``cost_analysis()`` only — no compile paid just for accounting: the train
+  loops and bench analyze the *lowering* of the step they are about to run,
+  which traces but never compiles, so the step-path compile count is
+  untouched);
+- the record carries FLOPs, bytes accessed, peak temp memory (compiled
+  source only), the derived arithmetic intensity, and a roofline
+  classification against the platform's ridge point
+  (``docs/ROOFLINE.md``);
+- **degradation is structural**: ``cost_analysis()`` is backend-dependent
+  and may return ``None`` or raise on some platforms/versions — every
+  failure path degrades to ``{"available": false, "reason": ...}`` instead
+  of crashing the train/serve/bench run that asked
+  (``tests/test_numerics.py`` pins this with a monkeypatched backend).
+
+Consumers: the four train loops and ``bench.py`` emit one ``cost`` record
+per compiled program into their manifest-headed JSONL (via
+:func:`maybe_emit_cost` — inert without an active sink), the serving engine
+attaches one per AOT warmup bucket, and ``qdml-tpu report`` grows a cost
+section that flags regressed benchmarks whose FLOPs/bytes also moved
+(program change vs. plain slowdown).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any
+
+from qdml_tpu.telemetry import spans as _spans
+
+# (peak bf16 FLOP/s, HBM bytes/s) by platform — ridge intensity is their
+# ratio (FLOP/byte). TPU numbers match bench.py's _PEAK_BF16 generation
+# table + published HBM bandwidths; "cpu" is a nominal desktop-class ridge
+# (the classification is a coarse label there, the raw intensity is the
+# portable number).
+_PLATFORM_PEAKS: dict[str, tuple[float, float]] = {
+    "tpu-v4": (275e12, 1.23e12),
+    "tpu-v5e": (197e12, 8.19e11),
+    "tpu-v5p": (459e12, 2.77e12),
+    "tpu-v6e": (918e12, 1.64e12),
+    "cpu": (1e11, 1.2e10),
+}
+_DEFAULT_RIDGE_PLATFORM = "tpu-v5e"
+
+
+def detect_platform() -> str:
+    """Cost-table platform label: ``cpu``/``gpu`` from the live backend, any
+    accelerator plugin (the tunnelled TPU registers under its own name)
+    labelled ``tpu-<gen>`` from ``PALLAS_AXON_TPU_GEN``. Never imports jax
+    (host-side callers) and never raises."""
+    jax = sys.modules.get("jax")
+    backend = None
+    if jax is not None:
+        try:
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001
+            backend = None
+    if backend in ("cpu", "gpu") or backend is None:
+        return backend or "unknown"
+    return f"tpu-{os.environ.get('PALLAS_AXON_TPU_GEN', 'v5e')}"
+
+
+def ridge_intensity(platform: str) -> float:
+    peak, bw = _PLATFORM_PEAKS.get(
+        platform, _PLATFORM_PEAKS[_DEFAULT_RIDGE_PLATFORM]
+    )
+    return peak / bw
+
+
+def _first_dict(ca: Any) -> dict | None:
+    """Normalize ``cost_analysis()`` output: Compiled returns a one-element
+    list of dicts, Lowered a plain dict, broken backends None/[]."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+def analyze(executable: Any, platform: str | None = None) -> dict:
+    """Cost record for one lowered/compiled XLA program. Never raises."""
+    platform = platform or detect_platform()
+    flops = bytes_accessed = None
+    reason = None
+    try:
+        ca = _first_dict(executable.cost_analysis())
+        if ca is not None:
+            f = ca.get("flops")
+            b = ca.get("bytes accessed")
+            flops = float(f) if isinstance(f, (int, float)) else None
+            bytes_accessed = float(b) if isinstance(b, (int, float)) else None
+        else:
+            reason = "cost_analysis() returned no properties"
+    except Exception as e:  # noqa: BLE001 — backend-dependent API
+        reason = f"cost_analysis failed: {type(e).__name__}: {e}"
+    mem: dict[str, int] = {}
+    memory_analysis = getattr(executable, "memory_analysis", None)
+    if callable(memory_analysis):
+        try:
+            m = memory_analysis()
+            if m is not None:
+                for field, key in (
+                    ("temp_size_in_bytes", "peak_temp_bytes"),
+                    ("argument_size_in_bytes", "argument_bytes"),
+                    ("output_size_in_bytes", "output_bytes"),
+                    ("generated_code_size_in_bytes", "generated_code_bytes"),
+                ):
+                    v = getattr(m, field, None)
+                    if isinstance(v, int):
+                        mem[key] = v
+        except Exception:  # noqa: BLE001 — memory stats are a bonus
+            pass
+    if flops is None and bytes_accessed is None and not mem:
+        return {
+            "available": False,
+            "reason": reason or "backend exposes no cost/memory analysis",
+            "platform": platform,
+        }
+    out: dict[str, Any] = {
+        "available": True,
+        "platform": platform,
+        # provenance from the API shape (only Compiled has memory_analysis),
+        # NOT from whether the stats materialized — a Compiled whose memory
+        # stats fail must not masquerade as a cheap lowered analysis.
+        # "lowered" records carry no memory stats by design: the analysis ran
+        # on the pre-compile HLO precisely to avoid paying a compile.
+        "source": "compiled" if callable(memory_analysis) else "lowered",
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "peak_temp_bytes": mem.get("peak_temp_bytes"),
+        **{k: v for k, v in mem.items() if k != "peak_temp_bytes"},
+    }
+    if flops and bytes_accessed:
+        ai = flops / bytes_accessed
+        ridge = ridge_intensity(platform)
+        out["arithmetic_intensity"] = round(ai, 4)
+        out["ridge_intensity"] = round(ridge, 2)
+        out["roofline"] = "compute-bound" if ai >= ridge else "memory-bound"
+    else:
+        out["roofline"] = "unknown"
+    return out
+
+
+def analyze_jit(jitted: Any, *args, platform: str | None = None, **kwargs) -> dict:
+    """Cost record for a jitted callable at concrete/abstract args: traces
+    (``.lower``, cheap) but never compiles — the caller's own first dispatch
+    still performs the one and only compile. Never raises."""
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+    except Exception as e:  # noqa: BLE001
+        return {
+            "available": False,
+            "reason": f"lowering failed: {type(e).__name__}: {e}",
+            "platform": platform or detect_platform(),
+        }
+    return analyze(lowered, platform=platform)
+
+
+def maybe_emit_cost(name: str, jitted: Any, *args, sink=None, **tags) -> dict | None:
+    """Emit one ``cost`` record for ``jitted`` at ``args`` into the explicit
+    or process-global telemetry sink; a no-op (returning None, not even
+    tracing) when no sink is active — unit tests driving the trainers
+    directly see zero behavior change."""
+    target = sink if sink is not None else _spans.get_sink()
+    if target is None or not getattr(target, "active", False):
+        return None
+    rec = analyze_jit(jitted, *args)
+    target.emit("cost", name=name, **rec, **tags)
+    return rec

@@ -37,6 +37,15 @@ class Histogram:
     def reset(self) -> None:
         self._vals = []
 
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold another histogram's samples into this one (the per-worker
+        serving collectors aggregate this way). Exact, not approximate: the
+        collector keeps raw samples, so merged quantiles equal quantiles of
+        the concatenated sample set (property-tested in
+        ``tests/test_numerics.py``). Returns ``self`` for chaining."""
+        self._vals.extend(other._vals)
+        return self
+
     def summary(self) -> dict | None:
         """``{"n", "mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"}`` or
         None if empty (p99 exists for the serving path, whose SLOs are tail

@@ -1,0 +1,393 @@
+"""Numerics flight recorder: on-device training probes + divergence watchdog.
+
+PR 1 made *time* observable (spans, step clocks) and PR 2 made *serving*
+observable (tail latencies, shed counts); nothing yet explains *why a run
+went bad*. A NaN loss in the QSC loop (QuantumNAT's Gaussian parameter-noise
+injection is exactly the knob that silently destabilizes training) or a
+slowly exploding gradient norm used to surface as a garbage checkpoint hours
+later. This module makes per-step numerics first-class artifacts:
+
+- :func:`probe_tree` — jit-safe gradient/update statistics computed ON DEVICE
+  inside the existing train step (global + per-branch grad norms, update-to-
+  param ratios, a fused nonfinite count). The step function returns them in
+  its metrics dict, so they ride the step's existing output: no extra
+  compiles (the probe is part of the one compiled program — pinned by
+  ``tests/test_numerics.py`` against the ``utils/compile_cache`` counters)
+  and ONE extra device→host transfer per *logged* step only (the scalars sit
+  on device until the recorder's cadence fetches them).
+- :class:`Watchdog` — the trip policy: nonfinite loss/grads/updates, or a
+  configurable grad-norm ceiling (``train.watchdog_grad_norm_max``).
+- :class:`FlightRecorder` — the per-loop integration object every trainer
+  drives: emits ``numerics`` records into the run's manifest-headed JSONL on
+  the ``train.probe_every`` cadence, snapshots last-known-good params, and on
+  a watchdog trip dumps a post-mortem bundle to
+  ``<results_dir>/<run>/flightrec/`` (bundle.json: reason, offending
+  step/epoch/batch info, rng key, probe history tail; ``last_good`` params
+  via :mod:`qdml_tpu.train.checkpoint`) before raising a typed
+  :class:`DivergenceError` that names the dump.
+
+Formats and semantics: ``docs/FLIGHTREC.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from collections import deque
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+
+# NOTE: jax is imported lazily inside the functions that need it — this
+# module rides in ``qdml_tpu.telemetry``'s namespace, which the bench PARENT
+# process imports, and that process must never import jax (bench.py's probe
+# design: a hung tunnelled backend must not be able to hang the harness).
+from qdml_tpu.telemetry import spans as _spans
+from qdml_tpu.telemetry.core import is_primary
+
+HISTORY_TAIL = 32  # probe records retained for the post-mortem bundle
+# last-good param snapshot cadence when probes are compiled out
+# (probe_every=0 with the watchdog still armed): the loss checks alone
+# qualify a step as clean, and without SOME refresh cadence every dump
+# would "restore" to the step-0 init params.
+LAST_GOOD_FALLBACK_EVERY = 100
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged (NaN/Inf or grad-norm explosion) and the watchdog
+    converted the would-be garbage run into a typed failure. ``dump_dir``
+    points at the flight-recorder bundle (``None`` when this process is not
+    the primary writer); ``reason`` is the trip condition."""
+
+    def __init__(self, message: str, dump_dir: str | None, reason: str):
+        super().__init__(message)
+        self.dump_dir = dump_dir
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------------
+# On-device probes (traceable; called inside the jitted train steps)
+# ---------------------------------------------------------------------------
+
+
+def _sumsq(tree):
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+def _nonfinite_count(tree):
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.int32(0)
+    return sum(
+        jnp.sum(~jnp.isfinite(l.astype(jnp.float32))) for l in leaves
+    ).astype(jnp.int32)
+
+
+def probe_tree(grads, params=None, updates=None) -> dict:
+    """Numerics probe over one step's gradient (and optionally param/update)
+    trees. Traceable: pure reductions to scalars, safe under ``jit``,
+    ``lax.scan``, ``vmap`` and ``shard_map`` (replicated inputs; all outputs
+    are tiny). Norms accumulate in f32 regardless of leaf dtype.
+
+    Returns (all jnp scalars):
+
+    - ``grad_norm`` — global L2 norm of ``grads``;
+    - ``branch_grad_norm`` — per-top-level-branch L2 norms (the keys are the
+      tree's static child names, so the dict structure is trace-stable);
+    - ``param_norm`` / ``update_norm`` / ``update_ratio``
+      (= ``update_norm / (param_norm + 1e-12)``) when the trees are given;
+    - ``nonfinite`` — fused NaN/Inf element count over grads AND updates (one
+      int32: a single flag the watchdog can test with one comparison).
+    """
+    import jax.numpy as jnp
+
+    out: dict[str, Any] = {}
+    out["grad_norm"] = jnp.sqrt(_sumsq(grads))
+    if isinstance(grads, Mapping):
+        out["branch_grad_norm"] = {
+            str(k): jnp.sqrt(_sumsq(v)) for k, v in grads.items()
+        }
+    nonfinite = _nonfinite_count(grads)
+    if params is not None:
+        out["param_norm"] = jnp.sqrt(_sumsq(params))
+    if updates is not None:
+        out["update_norm"] = jnp.sqrt(_sumsq(updates))
+        nonfinite = nonfinite + _nonfinite_count(updates)
+        if params is not None:
+            out["update_ratio"] = out["update_norm"] / (out["param_norm"] + 1e-12)
+    out["nonfinite"] = nonfinite
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host side: jsonification, watchdog policy, flight recorder
+# ---------------------------------------------------------------------------
+
+
+def _j(x):
+    """JSON-safe view of a fetched probe leaf: finite floats stay numeric,
+    nonfinite become strings (strict-JSON consumers must not choke on a NaN
+    the recorder exists to report); small arrays become lists, large ones a
+    summary."""
+    if isinstance(x, Mapping):
+        return {k: _j(v) for k, v in x.items()}
+    arr = np.asarray(x)
+    if arr.ndim == 0:
+        v = arr.item()
+        if isinstance(v, float) and not math.isfinite(v):
+            return str(v)
+        return v
+    if arr.size <= 16:
+        return [_j(v) for v in arr.reshape(-1)]
+    finite = arr[np.isfinite(arr)] if np.issubdtype(arr.dtype, np.floating) else arr
+    return {
+        "shape": list(arr.shape),
+        "min": _j(finite.min()) if finite.size else None,
+        "max": _j(finite.max()) if finite.size else None,
+        "last": _j(arr.reshape(-1)[-1]),
+    }
+
+
+class Watchdog:
+    """Divergence trip policy over the per-step loss and fetched probes.
+
+    Trips (returns the reason string) on:
+
+    - nonfinite loss — checked EVERY step, for free: the train loops already
+      transfer the loss each step;
+    - a nonzero fused ``nonfinite`` probe count (NaN/Inf in grads/updates);
+    - ``grad_norm`` above ``grad_norm_max`` (0 disables the ceiling — the
+      NaN/Inf trips stay armed).
+
+    Array-valued losses/probes (scan chunks stack (K,), the nat sweep stacks
+    members (E,)) are checked elementwise: ANY bad step/member trips.
+    """
+
+    def __init__(self, grad_norm_max: float = 0.0):
+        self.grad_norm_max = float(grad_norm_max)
+
+    def check(self, loss=None, probe: dict | None = None) -> str | None:
+        if loss is not None:
+            larr = np.asarray(loss, dtype=np.float64)
+            if not np.isfinite(larr).all():
+                return f"nonfinite loss ({_j(larr)})"
+        if probe is not None:
+            nf = int(np.sum(np.asarray(probe.get("nonfinite", 0))))
+            if nf > 0:
+                return f"{nf} nonfinite gradient/update element(s)"
+            gn = np.asarray(probe.get("grad_norm", 0.0), dtype=np.float64)
+            if not np.isfinite(gn).all():
+                return f"nonfinite grad norm ({_j(gn)})"
+            if self.grad_norm_max > 0 and float(np.max(gn)) > self.grad_norm_max:
+                return (
+                    f"grad norm {float(np.max(gn)):g} exceeds ceiling "
+                    f"{self.grad_norm_max:g}"
+                )
+        return None
+
+
+class FlightRecorder:
+    """Per-trainer numerics recorder + watchdog harness.
+
+    One instance per train loop (``FlightRecorder("qsc_train", cfg,
+    workdir=...)``); the loop calls :meth:`note_good` once on its initial
+    params and :meth:`on_step` once per host-visible step with the step's
+    metrics dict (device leaves — the probe is fetched here, on the logging
+    cadence, never per step). ``numerics`` records go to the explicit sink or
+    the process-global telemetry sink, exactly like :class:`StepClock`.
+
+    Disabled cleanly: ``train.probe_every == 0`` stops the records,
+    ``train.watchdog == False`` stops the trips; with both off, ``on_step``
+    is a counter increment.
+    """
+
+    def __init__(self, name: str, cfg, workdir: str | None = None, sink=None):
+        self.name = name
+        self.cfg = cfg
+        self.workdir = workdir
+        self._sink = sink
+        self.probe_every = int(cfg.train.probe_every)
+        self.watchdog = (
+            Watchdog(grad_norm_max=cfg.train.watchdog_grad_norm_max)
+            if cfg.train.watchdog
+            else None
+        )
+        self.dump_root = os.path.join(cfg.eval.results_dir, cfg.name, "flightrec")
+        self._n = 0
+        self._history: deque[dict] = deque(maxlen=HISTORY_TAIL)
+        self._last_good: tuple[int, Any] | None = None  # (step, params copy)
+
+    @property
+    def enabled(self) -> bool:
+        return self.probe_every > 0 or self.watchdog is not None
+
+    def _target(self):
+        return self._sink if self._sink is not None else _spans.get_sink()
+
+    def note_good(self, params) -> None:
+        """Snapshot known-good params (a COPY — the train steps donate their
+        state, so a kept reference would alias invalidated buffers). Trainers
+        call this once before the loop: the init/restored params are good by
+        construction, so even a first-step divergence has a restore point."""
+        if self.watchdog is None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        self._last_good = (self._n, jax.tree.map(jnp.copy, params))
+
+    def on_step(
+        self,
+        epoch: int,
+        metrics: Mapping | None,
+        loss=None,
+        params=None,
+        batch_info: dict | None = None,
+        rng=None,
+    ) -> None:
+        """One host-visible step: log on cadence, feed the watchdog, raise
+        :class:`DivergenceError` (after dumping) on a trip.
+
+        ``metrics`` is the step's metric dict with device leaves (its
+        ``probe`` entry is fetched — one transfer — only on logging steps);
+        ``loss`` is the already-transferred host loss (scalar or the scan
+        chunk / member vector); ``params``/``batch_info``/``rng`` feed the
+        last-good snapshot and the post-mortem bundle.
+        """
+        if not self.enabled:
+            return
+        import jax
+
+        self._n += 1
+        probe_host = None
+        probe = metrics.get("probe") if isinstance(metrics, Mapping) else None
+        if (
+            probe is not None
+            and self.probe_every > 0
+            and (self._n == 1 or self._n % self.probe_every == 0)
+        ):
+            probe_host = jax.device_get(probe)  # the one extra transfer
+            rec = {
+                "step": self._n,
+                "epoch": int(epoch),
+                "loss": _j(loss) if loss is not None else None,
+                **{k: _j(v) for k, v in probe_host.items()},
+            }
+            self._history.append(rec)
+            target = self._target()
+            if target is not None and getattr(target, "active", False):
+                target.emit("numerics", name=self.name, **rec)
+        if self.watchdog is None:
+            return
+        reason = self.watchdog.check(loss=loss, probe=probe_host)
+        if reason is None:
+            # retain last-good on a cadence, never per step (a tree copy per
+            # step would double param traffic for pure bookkeeping): the
+            # probe cadence when probes log, a fixed fallback cadence when
+            # probes are compiled out and only the loss checks qualify steps
+            snap = probe_host is not None or (
+                self.probe_every <= 0 and self._n % LAST_GOOD_FALLBACK_EVERY == 0
+            )
+            if snap and params is not None:
+                import jax.numpy as jnp
+
+                self._last_good = (self._n, jax.tree.map(jnp.copy, params))
+            return
+        dump_dir = self.dump(reason, epoch, batch_info=batch_info, rng=rng, loss=loss,
+                             probe_host=probe_host, metrics=metrics)
+        raise DivergenceError(
+            f"{self.name} diverged at step {self._n} (epoch {epoch}): {reason}"
+            + (f" — flight-recorder dump: {dump_dir}" if dump_dir else ""),
+            dump_dir,
+            reason,
+        )
+
+    # -- post-mortem --------------------------------------------------------
+
+    def dump(
+        self,
+        reason: str,
+        epoch: int,
+        batch_info: dict | None = None,
+        rng=None,
+        loss=None,
+        probe_host: dict | None = None,
+        metrics: Mapping | None = None,
+    ) -> str | None:
+        """Write the post-mortem bundle; returns its directory. Every process
+        joins the orbax ``last_good`` save (it is a multi-host COLLECTIVE —
+        a primary-only save would leave the primary waiting on peers that
+        already raised), while the plain-JSON bundle and telemetry record are
+        primary-only like every other shared write. Best-effort by design: a
+        failing dump must not mask the DivergenceError itself."""
+        dump_dir = os.path.join(self.dump_root, f"{self.name}-step{self._n:06d}")
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+            if probe_host is None and isinstance(metrics, Mapping) and "probe" in metrics:
+                try:
+                    import jax
+
+                    probe_host = jax.device_get(metrics["probe"])
+                except Exception:  # noqa: BLE001 — donated/poisoned buffers
+                    probe_host = None
+            last_good_meta = None
+            if self._last_good is not None:
+                from qdml_tpu.train.checkpoint import save_checkpoint
+
+                good_step, good_params = self._last_good
+                save_checkpoint(
+                    dump_dir,
+                    "last_good",
+                    {"params": good_params},
+                    {"step": good_step, "name": self.cfg.name, "loop": self.name},
+                )
+                last_good_meta = {"step": good_step, "checkpoint": "last_good"}
+            if not is_primary():
+                return dump_dir
+            from qdml_tpu.telemetry.manifest import config_hash
+
+            bundle = {
+                "kind": "flightrec_bundle",
+                "ts": round(time.time(), 3),
+                "name": self.name,
+                "run": self.cfg.name,
+                "config_hash": config_hash(self.cfg),
+                "reason": reason,
+                "step": self._n,
+                "epoch": int(epoch),
+                "loss": _j(loss) if loss is not None else None,
+                "batch_info": _j(batch_info) if batch_info else None,
+                "rng_key": _j(np.asarray(rng)) if rng is not None else None,
+                "probe": _j(probe_host) if probe_host else None,
+                "probe_history": list(self._history),
+                "last_good": last_good_meta,
+                "workdir": self.workdir,
+            }
+            with open(os.path.join(dump_dir, "bundle.json"), "w") as fh:
+                json.dump(bundle, fh, indent=2)
+            target = self._target()
+            if target is not None and getattr(target, "active", False):
+                target.emit(
+                    "flightrec_dump",
+                    name=self.name,
+                    reason=reason,
+                    step=self._n,
+                    epoch=int(epoch),
+                    dump_dir=dump_dir,
+                )
+            return dump_dir
+        except Exception as e:  # noqa: BLE001
+            print(f"[flightrec] dump failed: {type(e).__name__}: {e}", flush=True)
+            return None

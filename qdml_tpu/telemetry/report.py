@@ -91,14 +91,17 @@ def _serving_from(obj: dict) -> dict | None:
 
 
 def extract(path: str) -> dict:
-    """Pull ``{manifest, record, throughput, serving, platform}`` out of one
-    artifact."""
+    """Pull ``{manifest, record, throughput, serving, cost, platform}`` out
+    of one artifact. ``cost`` maps a program key (a bench sub-bench name, a
+    train-loop ``cost`` record name, or ``serve_bucket[N]``) to its XLA cost
+    block (:func:`qdml_tpu.telemetry.cost.analyze` shape)."""
     src: dict = {
         "path": path,
         "manifest": None,
         "record": None,
         "throughput": {},
         "serving": None,
+        "cost": {},
         "platform": None,
     }
     for obj in _iter_objs(path):
@@ -108,6 +111,12 @@ def extract(path: str) -> dict:
             # last wins: an appended/resumed stream carries one manifest per
             # invocation, and the last record belongs to the last invocation
             src["manifest"] = obj
+            continue
+        if obj.get("kind") == "cost" and obj.get("name"):
+            key = str(obj["name"])
+            if obj.get("bucket") is not None:
+                key = f"{key}[{obj['bucket']}]"
+            src["cost"][key] = obj  # last record per program wins
             continue
         serving = _serving_from(obj)
         if serving is not None:
@@ -131,8 +140,12 @@ def extract(path: str) -> dict:
         if isinstance(rec.get("value"), (int, float)):
             src["throughput"][rec.get("metric") or "value"] = float(rec["value"])
         for key, d in (rec.get("details") or {}).items():
-            if isinstance(d, dict) and isinstance(d.get("samples_per_sec"), (int, float)):
+            if not isinstance(d, dict):
+                continue
+            if isinstance(d.get("samples_per_sec"), (int, float)):
                 src["throughput"][f"{key}.samples_per_sec"] = float(d["samples_per_sec"])
+            if isinstance(d.get("cost"), dict):
+                src["cost"][key] = d["cost"]
     return src
 
 
@@ -162,22 +175,52 @@ def _manifest_line(src: dict) -> str | None:
     return f"  - manifest `{os.path.basename(src['path'])}`: " + ", ".join(bits)
 
 
-def build_report(
+def _pct(cur: float, base: float) -> float | None:
+    """Relative delta, or None for a zero baseline — a ratio against zero is
+    undefined, and the alternative (float inf) leaks bare ``Infinity`` into
+    the strict-JSON ``--json`` gate output."""
+    return (cur - base) / base * 100.0 if base else None
+
+
+def _cost_deltas(base_cost: dict, cur_cost: dict) -> dict | None:
+    """FLOPs/bytes deltas between two available cost blocks; None when either
+    side has no comparable numbers."""
+    out = {}
+    for field in ("flops", "bytes_accessed"):
+        b, c = base_cost.get(field), cur_cost.get(field)
+        if isinstance(b, (int, float)) and isinstance(c, (int, float)) and b:
+            out[field] = {"baseline": b, "current": c, "delta_pct": round(_pct(c, b), 2)}
+    return out or None
+
+
+# A regressed benchmark whose program also changed by more than this is
+# flagged "program change" — the regression may be MORE work, not slower
+# execution of the same work.
+PROGRAM_CHANGE_PCT = 1.0
+
+
+def build_report_data(
     current_paths: list[str],
     baseline_path: str,
     threshold_pct: float = DEFAULT_THRESHOLD_PCT,
-) -> tuple[str, list[dict], bool]:
-    """Returns ``(markdown, regressions, gate_armed)``.
+) -> dict:
+    """Full machine-readable report: markdown + per-gate rows + cost deltas.
 
-    ``regressions`` lists every shared metric whose current value sits more
-    than ``threshold_pct`` percent below the baseline; ``gate_armed`` is False
-    when the two sides ran on different platforms (deltas reported, exit code
-    not gated)."""
+    Returns ``{"markdown", "gates", "regressions", "gate_armed",
+    "disarm_reason", "cost", "threshold_pct", ...}`` — the ``--json`` output
+    is this dict minus the markdown, so CI consumes the same resolution the
+    human-facing table shows (no markdown parsing)."""
     base = extract(baseline_path)
     curs = [extract(p) for p in current_paths]
     cur_tp: dict[str, float] = {}
     for c in curs:
         cur_tp.update(c["throughput"])
+    cur_cost: dict[str, dict] = {}
+    for c in curs:
+        cur_cost.update(c["cost"])
+    gates: list[dict] = []
+    cost_rows: list[dict] = []
+    disarm_reason: str | None = None
     # Platform resolution must match the value resolution (later files win a
     # shared metric, so the later file's platform labels the merged set);
     # heterogeneous current platforms disarm the gate below.
@@ -203,6 +246,9 @@ def build_report(
     gate_armed = True
     if len(set(cur_platforms)) > 1:
         gate_armed = False
+        disarm_reason = (
+            f"current artifacts span platforms {sorted(set(cur_platforms))}"
+        )
         lines.append(
             f"> **note**: current artifacts span platforms {sorted(set(cur_platforms))} "
             "— merged deltas are not attributable to one platform, regression "
@@ -211,6 +257,9 @@ def build_report(
         lines.append("")
     elif base["platform"] and cur_platform and base["platform"] != cur_platform:
         gate_armed = False
+        disarm_reason = (
+            f"platform mismatch: baseline {base['platform']} vs current {cur_platform}"
+        )
         lines.append(
             f"> **note**: platform mismatch (baseline {base['platform']} vs "
             f"current {cur_platform}) — deltas shown, regression gate disarmed "
@@ -219,12 +268,29 @@ def build_report(
         )
         lines.append("")
 
+    def _data(note: str | None = None) -> dict:
+        return {
+            "schema": 1,
+            "baseline": baseline_path,
+            "current": list(current_paths),
+            "threshold_pct": threshold_pct,
+            "baseline_platform": base["platform"],
+            "current_platform": cur_platform,
+            "gate_armed": gate_armed,
+            "disarm_reason": disarm_reason,
+            "gates": gates,
+            "regressions": regressions,
+            "cost": cost_rows,
+            "note": note,
+            "markdown": "\n".join(lines),
+        }
+
     if not base["throughput"]:
         lines.append(
             "_baseline carries no throughput metrics (nothing to gate; "
             "e.g. a targets-only BASELINE.json)._"
         )
-        return "\n".join(lines), regressions, gate_armed
+        return _data("baseline carries no throughput metrics")
     if not cur_tp:
         # A baseline with numbers and a current run that measured NOTHING is
         # a gate failure, not a pass: the fully-errored bench path still
@@ -240,7 +306,15 @@ def build_report(
             {"metric": "(no throughput measured)", "baseline": None,
              "current": None, "delta_pct": None}
         )
-        return "\n".join(lines), regressions, True
+        # the sentinel is a real gate row too: --json consumers iterating
+        # `gates` must see WHAT failed, not just exit_code 3
+        gates.append(
+            {"metric": "(no throughput measured)", "kind": "throughput",
+             "baseline": None, "current": None, "delta_pct": None,
+             "status": "regression"}
+        )
+        gate_armed, disarm_reason = True, None
+        return _data("current artifacts carry no throughput metrics")
 
     lines += [
         "| metric | baseline | current | delta | status |",
@@ -251,22 +325,54 @@ def build_report(
         c = cur_tp.get(key)
         if b is None or c is None:
             only = "current-only" if b is None else "baseline-only"
+            gates.append(
+                {"metric": key, "kind": "throughput", "baseline": b,
+                 "current": c, "delta_pct": None, "status": only}
+            )
             lines.append(
                 f"| {key} | {'—' if b is None else f'{b:g}'} | "
                 f"{'—' if c is None else f'{c:g}'} | — | {only} |"
             )
             continue
-        delta_pct = (c - b) / b * 100.0 if b else float("inf")
-        if delta_pct < -threshold_pct:
-            status = "**REGRESSION**"
-            regressions.append(
-                {"metric": key, "baseline": b, "current": c, "delta_pct": round(delta_pct, 2)}
+        delta_pct = _pct(c, b)
+        program_change = None
+        if delta_pct is None:
+            gates.append(
+                {"metric": key, "kind": "throughput", "baseline": b, "current": c,
+                 "delta_pct": None, "status": "zero-baseline"}
             )
+            lines.append(f"| {key} | {b:g} | {c:g} | — | zero-baseline |")
+            continue
+        if delta_pct < -threshold_pct:
+            status_key, status_md = "regression", "**REGRESSION**"
+            # Perf regression vs program change: when the regressed
+            # sub-bench's own XLA cost moved too, the slowdown is (at least
+            # partly) MORE WORK, not slower execution of the same program.
+            prog = key.rsplit(".", 1)[0]
+            deltas = None
+            if prog in base["cost"] and prog in cur_cost:
+                deltas = _cost_deltas(base["cost"][prog], cur_cost[prog])
+            if deltas and any(
+                abs(d["delta_pct"]) > PROGRAM_CHANGE_PCT for d in deltas.values()
+            ):
+                program_change = deltas
+                status_key = "regression+program-change"
+                status_md += " (program changed)"
+            reg = {"metric": key, "baseline": b, "current": c,
+                   "delta_pct": round(delta_pct, 2)}
+            if program_change:
+                reg["program_change"] = program_change
+            regressions.append(reg)
         elif delta_pct > threshold_pct:
-            status = "improved"
+            status_key = status_md = "improved"
         else:
-            status = "ok"
-        lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status} |")
+            status_key = status_md = "ok"
+        row = {"metric": key, "kind": "throughput", "baseline": b, "current": c,
+               "delta_pct": round(delta_pct, 2), "status": status_key}
+        if program_change:
+            row["program_change"] = program_change
+        gates.append(row)
+        lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status_md} |")
 
     # Serving-latency section: tail percentiles from serve_summary records.
     # The delta sign is INVERTED relative to throughput — latency going UP
@@ -291,42 +397,133 @@ def build_report(
                 continue
             if b is None or c is None:
                 only = "current-only" if b is None else "baseline-only"
+                gates.append(
+                    {"metric": f"serving.{key}", "kind": "latency", "baseline": b,
+                     "current": c, "delta_pct": None, "status": only}
+                )
                 lines.append(
                     f"| {key} | {'—' if b is None else f'{b:g}'} | "
                     f"{'—' if c is None else f'{c:g}'} | — | {only} |"
                 )
                 continue
-            delta_pct = (c - b) / b * 100.0 if b else float("inf")
+            delta_pct = _pct(c, b)
+            if delta_pct is None:
+                gates.append(
+                    {"metric": f"serving.{key}", "kind": "latency", "baseline": b,
+                     "current": c, "delta_pct": None, "status": "zero-baseline"}
+                )
+                lines.append(f"| {key} | {b:g} | {c:g} | — | zero-baseline |")
+                continue
             if delta_pct > threshold_pct:
-                status = "**REGRESSION**"
+                status_key, status_md = "regression", "**REGRESSION**"
                 regressions.append(
                     {"metric": f"serving.{key}", "baseline": b, "current": c,
                      "delta_pct": round(delta_pct, 2)}
                 )
             elif delta_pct < -threshold_pct:
-                status = "improved"
+                status_key = status_md = "improved"
             else:
-                status = "ok"
-            lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status} |")
+                status_key = status_md = "ok"
+            gates.append(
+                {"metric": f"serving.{key}", "kind": "latency", "baseline": b,
+                 "current": c, "delta_pct": round(delta_pct, 2), "status": status_key}
+            )
+            lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status_md} |")
+
+    # Cost section: the XLA accounting for every program both sides measured.
+    # A FLOPs/bytes delta is a PROGRAM change (config, lowering, fusion), a
+    # regression with flat cost is an execution change — the table separates
+    # the two failure stories.
+    shared_cost = sorted(
+        k
+        for k in set(base["cost"]) & set(cur_cost)
+        if base["cost"][k].get("available") and cur_cost[k].get("available")
+    )
+    if shared_cost:
+        lines += [
+            "",
+            "## cost (XLA program accounting)",
+            "",
+            "| program | GFLOPs | Δ flops | MB accessed | Δ bytes | roofline |",
+            "|---|---|---|---|---|---|",
+        ]
+        for k in shared_cost:
+            bc, cc = base["cost"][k], cur_cost[k]
+            deltas = _cost_deltas(bc, cc) or {}
+            f_d = deltas.get("flops", {}).get("delta_pct")
+            b_d = deltas.get("bytes_accessed", {}).get("delta_pct")
+            changed = any(
+                abs(d["delta_pct"]) > PROGRAM_CHANGE_PCT for d in deltas.values()
+            )
+            cost_rows.append(
+                {"program": k, "baseline": {f: bc.get(f) for f in
+                                            ("flops", "bytes_accessed", "peak_temp_bytes", "roofline")},
+                 "current": {f: cc.get(f) for f in
+                             ("flops", "bytes_accessed", "peak_temp_bytes", "roofline")},
+                 "deltas": deltas, "program_changed": changed}
+            )
+            gflops = (
+                f"{cc['flops'] / 1e9:.3f}" if isinstance(cc.get("flops"), (int, float)) else "—"
+            )
+            mb = (
+                f"{cc['bytes_accessed'] / 1e6:.2f}"
+                if isinstance(cc.get("bytes_accessed"), (int, float))
+                else "—"
+            )
+            roof = cc.get("roofline", "unknown")
+            if cc.get("roofline") != bc.get("roofline"):
+                roof = f"{bc.get('roofline')} → {roof}"
+            if changed:  # inside the last cell: a 7th cell would be dropped
+                roof += " — **program changed**"
+            lines.append(
+                f"| {k} | {gflops} | "
+                f"{'—' if f_d is None else f'{f_d:+.1f}%'} | {mb} | "
+                f"{'—' if b_d is None else f'{b_d:+.1f}%'} | {roof} |"
+            )
 
     lines.append("")
+    flagged = [r for r in regressions if r.get("program_change")]
     if regressions:
         lines.append(
             f"**{len(regressions)} metric(s) regressed beyond {threshold_pct:g}%**"
             + ("" if gate_armed else " (gate disarmed: platform mismatch)")
         )
+        if flagged:
+            lines.append(
+                f"- {len(flagged)} regression(s) coincide with a changed "
+                "program (FLOPs/bytes moved): likely a config/lowering "
+                "change, not a pure slowdown — "
+                + ", ".join(r["metric"] for r in flagged)
+            )
     else:
         lines.append("No regressions beyond threshold.")
-    return "\n".join(lines), regressions, gate_armed
+    return _data()
+
+
+def build_report(
+    current_paths: list[str],
+    baseline_path: str,
+    threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+) -> tuple[str, list[dict], bool]:
+    """Back-compat view of :func:`build_report_data`: ``(markdown,
+    regressions, gate_armed)``. ``regressions`` lists every shared metric
+    whose current value regressed beyond ``threshold_pct``; ``gate_armed``
+    is False when the two sides ran on different platforms."""
+    data = build_report_data(current_paths, baseline_path, threshold_pct)
+    return data["markdown"], data["regressions"], data["gate_armed"]
 
 
 def report_main(argv: list[str]) -> int:
-    """CLI entry: parse ``--current/--baseline/--threshold/--out``, print the
-    markdown, return the gate's exit code."""
+    """CLI entry: parse ``--current/--baseline/--threshold/--out/--json``,
+    print the markdown, return the gate's exit code. ``--json=PATH`` also
+    writes the machine-readable gate output (per-gate status + deltas,
+    disarm reason, cost deltas, the exit code itself) so CI consumes the
+    gate without parsing markdown."""
     currents: list[str] = []
     baseline: str | None = None
     threshold = DEFAULT_THRESHOLD_PCT
     out: str | None = None
+    json_out: str | None = None
     for arg in argv:
         if arg.startswith("--current="):
             currents += [p for p in arg.split("=", 1)[1].split(",") if p]
@@ -341,23 +538,33 @@ def report_main(argv: list[str]) -> int:
                 return EXIT_USAGE
         elif arg.startswith("--out="):
             out = arg.split("=", 1)[1]
+        elif arg.startswith("--json="):
+            json_out = arg.split("=", 1)[1]
         else:
             print(f"report: unrecognised argument {arg!r}")
             return EXIT_USAGE
     if not currents or baseline is None:
         print(
             "usage: qdml-tpu report --current=PATH[,PATH...] --baseline=PATH "
-            "[--threshold=PCT] [--out=FILE.md]"
+            "[--threshold=PCT] [--out=FILE.md] [--json=FILE.json]"
         )
         return EXIT_USAGE
     for p in currents + [baseline]:
         if not os.path.exists(p):
             print(f"report: no such file {p!r}")
             return EXIT_USAGE
-    md, regressions, gate_armed = build_report(currents, baseline, threshold)
+    data = build_report_data(currents, baseline, threshold)
+    md = data["markdown"]
     print(md)
+    rc = EXIT_REGRESSION if (data["regressions"] and data["gate_armed"]) else EXIT_OK
     if out:
         os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
         with open(out, "w") as fh:
             fh.write(md + "\n")
-    return EXIT_REGRESSION if (regressions and gate_armed) else EXIT_OK
+    if json_out:
+        payload = {k: v for k, v in data.items() if k != "markdown"}
+        payload["exit_code"] = rc
+        os.makedirs(os.path.dirname(json_out) or ".", exist_ok=True)
+        with open(json_out, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    return rc

@@ -17,6 +17,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+import optax
 
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
@@ -25,13 +27,17 @@ from qdml_tpu.models.cnn import DCEP128, activation_dtype
 from qdml_tpu.models.losses import nmse_loss
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
-from qdml_tpu.telemetry import StepClock, span
+from qdml_tpu.telemetry import FlightRecorder, StepClock, probe_tree, span
+from qdml_tpu.telemetry.cost import maybe_emit_cost
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
 
 
-def _dce_step(model: DCEP128, state: TrainState, batch: dict) -> tuple[TrainState, dict]:
-    """One DCE grid step (traceable; jitted by the makers below)."""
+def _dce_step(
+    model: DCEP128, state: TrainState, batch: dict, probes: bool = True
+) -> tuple[TrainState, dict]:
+    """One DCE grid step (traceable; jitted by the makers below).
+    ``probes=False`` compiles the numerics probe out (static flag)."""
     x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
     label = batch["h_label"].reshape(x.shape[0], -1)
     perf = batch["h_perf"].reshape(x.shape[0], -1)
@@ -49,28 +55,40 @@ def _dce_step(model: DCEP128, state: TrainState, batch: dict) -> tuple[TrainStat
     (loss, (new_stats, loss_perf)), grads = jax.value_and_grad(
         loss_fn, has_aux=True
     )(state.params)
-    state = state.apply_gradients(grads=grads)
-    state = state.replace(batch_stats=new_stats)
-    return state, {"loss": loss, "loss_perf": loss_perf}
+    # optax applied explicitly (flax's apply_gradients verbatim) so the
+    # numerics probe sees the actual per-step UPDATES, not a params diff
+    updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
+    m = {"loss": loss, "loss_perf": loss_perf}
+    if probes:
+        m["probe"] = probe_tree(grads, state.params, updates)
+    state = state.replace(
+        step=state.step + 1,
+        params=optax.apply_updates(state.params, updates),
+        opt_state=new_opt_state,
+        batch_stats=new_stats,
+    )
+    return state, m
 
 
-def make_dce_train_step(model: DCEP128) -> Callable:
+def make_dce_train_step(model: DCEP128, probes: bool = True) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
-        return _dce_step(model, state, batch)
+        return _dce_step(model, state, batch, probes=probes)
 
     return step
 
 
-def make_dce_scan_steps(model: DCEP128, geom: ChannelGeometry) -> Callable:
+def make_dce_scan_steps(
+    model: DCEP128, geom: ChannelGeometry, probes: bool = True
+) -> Callable:
     """K DCE train steps in ONE device dispatch via the shared scan machinery
     (:func:`qdml_tpu.train.scan.make_scan_steps`)."""
     from qdml_tpu.train.scan import make_scan_steps
 
     return make_scan_steps(
-        partial(_dce_step, model), geom, ("yp_img", "h_label", "h_perf")
+        partial(_dce_step, model, probes=probes), geom, ("yp_img", "h_label", "h_perf")
     )
 
 
@@ -117,7 +135,8 @@ def train_dce(
     train_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "train", geom)
     val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
     model, state = init_dce_state(cfg, train_loader.steps_per_epoch)
-    train_step = make_dce_train_step(model)
+    probes_on = cfg.train.probe_every > 0  # 0 compiles the probes out
+    train_step = make_dce_train_step(model, probes=probes_on)
     eval_step = make_dce_eval_step(model)
 
     start_epoch = 0
@@ -132,9 +151,13 @@ def train_dce(
 
     scan_run = None
     if scan_eligible(cfg, None, train_loader, logger):
-        scan_run = make_dce_scan_steps(model, geom)
+        scan_run = make_dce_scan_steps(model, geom, probes=probes_on)
 
     clock = StepClock("dce_train")
+    # Numerics flight recorder + one lowered-cost record (docs/FLIGHTREC.md)
+    rec = FlightRecorder("dce_train", cfg, workdir=workdir)
+    rec.note_good(state.params)
+    cost_done = False
     history: dict[str, list] = {"train_loss": [], "val_nmse": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
@@ -143,17 +166,36 @@ def train_dce(
                 seed = jnp.uint32(cfg.data.seed)
                 scen, user = train_loader.grid_coords
                 for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
+                    if not cost_done:
+                        maybe_emit_cost(
+                            "dce_train_scan", scan_run, state, seed, scen,
+                            user, idx, snrs, scan_steps=cfg.train.scan_steps,
+                        )
+                        cost_done = True
                     with clock.step() as st:
                         state, ms = scan_run(state, seed, scen, user, idx, snrs)
                         st.transfer()
-                        tot = tot + float(jnp.sum(ms["loss"]))
+                        losses = np.asarray(jax.device_get(ms["loss"]))
+                        tot = tot + float(losses.sum())
+                    rec.on_step(
+                        epoch, ms, loss=losses, params=state.params,
+                        batch_info={"dispatch": "scan", "idx": idx, "snrs": snrs},
+                    )
                     n += idx.shape[0]
             else:
                 for batch in train_loader.epoch(epoch):
+                    if not cost_done:
+                        maybe_emit_cost("dce_train_step", train_step, state, batch)
+                        cost_done = True
                     with clock.step() as st:
                         state, m = train_step(state, batch)
                         st.transfer()
-                        tot = tot + float(m["loss"])
+                        loss = float(m["loss"])
+                        tot = tot + loss
+                    rec.on_step(
+                        epoch, m, loss=loss, params=state.params,
+                        batch_info={"dispatch": "step", "step_in_epoch": n},
+                    )
                     n += 1
         clock.epoch_end(epoch=epoch)
         train_loss = tot / max(n, 1)

@@ -38,6 +38,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
@@ -46,7 +47,8 @@ from qdml_tpu.models.cnn import FCP128, StackedConvP128, activation_dtype
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
 from qdml_tpu.train.scan import make_scan_steps, scan_eligible
-from qdml_tpu.telemetry import StepClock, span
+from qdml_tpu.telemetry import FlightRecorder, StepClock, probe_tree, span
+from qdml_tpu.telemetry.cost import maybe_emit_cost
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger, nmse_db
 
@@ -86,8 +88,12 @@ def cell_nmse(pred: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
     return err / pow_
 
 
-def _fused_step(model: HDCE, state: TrainState, batch: dict) -> tuple[TrainState, dict]:
-    """One fused grid step (traceable; jitted by the makers below)."""
+def _fused_step(
+    model: HDCE, state: TrainState, batch: dict, probes: bool = True
+) -> tuple[TrainState, dict]:
+    """One fused grid step (traceable; jitted by the makers below).
+    ``probes=False`` compiles the numerics probe out entirely (a static
+    trace-time flag: the loops pass ``train.probe_every > 0``)."""
     s, u, b = batch["yp_img"].shape[:3]
     x = batch["yp_img"].reshape(s, u * b, *batch["yp_img"].shape[3:])
     label = batch["h_label"]
@@ -108,23 +114,37 @@ def _fused_step(model: HDCE, state: TrainState, batch: dict) -> tuple[TrainState
     (loss, (new_stats, loss_perf)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params
     )
-    state = state.apply_gradients(grads=grads)
-    state = state.replace(batch_stats=new_stats)
-    return state, {"loss": loss, "loss_perf": loss_perf}
+    # optax applied explicitly (flax's apply_gradients verbatim) so the
+    # numerics probe sees the actual per-step UPDATES, not a params diff
+    updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
+    m = {"loss": loss, "loss_perf": loss_perf}
+    if probes:
+        m["probe"] = probe_tree(grads, state.params, updates)
+    state = state.replace(
+        step=state.step + 1,
+        params=optax.apply_updates(state.params, updates),
+        opt_state=new_opt_state,
+        batch_stats=new_stats,
+    )
+    return state, m
 
 
-def make_hdce_train_step(model: HDCE, tx) -> Callable:
+def make_hdce_train_step(model: HDCE, tx, probes: bool = True) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
-        return _fused_step(model, state, batch)
+        return _fused_step(model, state, batch, probes=probes)
 
     return step
 
 
 def make_hdce_scan_steps(
-    model: HDCE, geom: ChannelGeometry, mesh=None, fed: bool = False
+    model: HDCE,
+    geom: ChannelGeometry,
+    mesh=None,
+    fed: bool = False,
+    probes: bool = True,
 ) -> Callable:
     """K HDCE train steps in ONE device dispatch: the shared scan machinery
     (:func:`qdml_tpu.train.scan.make_scan_steps` — rationale, SPMD
@@ -132,7 +152,7 @@ def make_hdce_scan_steps(
     HDCE step. Bitwise-identical update sequence to per-step dispatch
     (``tests/test_train.py``)."""
     return make_scan_steps(
-        partial(_fused_step, model),
+        partial(_fused_step, model, probes=probes),
         geom,
         ("yp_img", "h_label", "h_perf"),
         mesh=mesh,
@@ -198,7 +218,10 @@ def train_hdce(
     train_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "train", geom)
     val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
     model, state = init_hdce_state(cfg, train_loader.steps_per_epoch)
-    train_step = make_hdce_train_step(model, state.tx)
+    # probe_every=0 compiles the numerics probes OUT of the step program
+    # (static flag); the watchdog's loss checks don't need them
+    probes_on = cfg.train.probe_every > 0
+    train_step = make_hdce_train_step(model, state.tx, probes=probes_on)
     eval_step = make_hdce_eval_step(model)
 
     start_epoch = 0
@@ -234,12 +257,19 @@ def train_hdce(
     scan_k = cfg.train.scan_steps
     scan_run = None
     if scan_eligible(cfg, mesh, train_loader, logger):
-        scan_run = make_hdce_scan_steps(model, geom, mesh=mesh, fed=fed)
+        scan_run = make_hdce_scan_steps(model, geom, mesh=mesh, fed=fed, probes=probes_on)
 
     # Telemetry (events reach the CLI-installed global sink, or the logger's
     # own stream when bound): per-epoch train/val spans plus a StepClock
     # separating compile vs steady-state vs host-transfer time per dispatch.
     clock = StepClock("hdce_train")
+    # Numerics flight recorder: probes ride the step's metrics (computed on
+    # device inside the jitted step), fetched/logged on the probe_every
+    # cadence; the watchdog turns NaN/Inf into a typed DivergenceError with
+    # a post-mortem dump (docs/FLIGHTREC.md).
+    rec = FlightRecorder("hdce_train", cfg, workdir=workdir)
+    rec.note_good(state.params)
+    cost_done = False
     history: dict[str, list] = {"train_loss": [], "val_nmse": [], "val_nmse_perf": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
@@ -248,6 +278,15 @@ def train_hdce(
                 seed = jnp.uint32(cfg.data.seed)
                 scen, user = train_loader.grid_coords
                 for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
+                    if not cost_done:
+                        # one cost record per run: lowering only (traces, no
+                        # extra compile — the first dispatch below still does
+                        # the one and only compile)
+                        maybe_emit_cost(
+                            "hdce_train_scan", scan_run, state, seed, scen,
+                            user, idx, snrs, scan_steps=scan_k,
+                        )
+                        cost_done = True
                     with clock.step() as st:
                         state, ms = scan_run(state, seed, scen, user, idx, snrs)
                         # one bulk transfer for the (K,) loss vector — K
@@ -256,15 +295,27 @@ def train_hdce(
                         # removed
                         st.transfer()
                         losses = np.asarray(jax.device_get(ms["loss"]))
+                    rec.on_step(
+                        epoch, ms, loss=losses, params=state.params,
+                        batch_info={"dispatch": "scan", "idx": idx, "snrs": snrs},
+                    )
                     tot, n = tot + float(losses.sum()), n + losses.size
                     if (n // scan_k) % max(cfg.train.print_freq // scan_k, 1) == 0:
                         logger.log(step=int(state.step), epoch=epoch, loss=float(losses[-1]))
             else:
                 for batch in train_loader.epoch(epoch):
+                    pb = place_train(batch)
+                    if not cost_done:
+                        maybe_emit_cost("hdce_train_step", train_step, state, pb)
+                        cost_done = True
                     with clock.step() as st:
-                        state, m = train_step(state, place_train(batch))
+                        state, m = train_step(state, pb)
                         st.transfer()
                         loss = float(m["loss"])
+                    rec.on_step(
+                        epoch, m, loss=loss, params=state.params,
+                        batch_info={"dispatch": "step", "step_in_epoch": n},
+                    )
                     tot, n = tot + loss, n + 1
                     if n % cfg.train.print_freq == 0:
                         logger.log(step=int(state.step), epoch=epoch, loss=loss)

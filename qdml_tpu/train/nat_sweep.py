@@ -37,7 +37,8 @@ from qdml_tpu.train.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
-from qdml_tpu.telemetry import StepClock, span
+from qdml_tpu.telemetry import FlightRecorder, StepClock, probe_tree, span
+from qdml_tpu.telemetry.cost import maybe_emit_cost
 from qdml_tpu.train.optim import get_optimizer
 from qdml_tpu.utils.metrics import MetricsLogger
 
@@ -81,10 +82,11 @@ def init_sweep(cfg: ExperimentConfig, noise_levels: Sequence[float], steps_per_e
     return model, tx, params, opt_state, sigmas
 
 
-def _make_vstep(model: QSCP128, tx) -> Callable:
+def _make_vstep(model: QSCP128, tx, probes: bool = True) -> Callable:
     """vmap over the ensemble of one member's QuantumNAT train step — the
     single definition both dispatch paths bind, so the noise-injection /
-    optimizer logic cannot drift between them."""
+    optimizer logic cannot drift between them. ``probes=False`` compiles the
+    numerics probe out (static flag)."""
 
     def member_step(params, opt_state, rng, sigma, x, labels):
         def loss_fn(p):
@@ -94,15 +96,21 @@ def _make_vstep(model: QSCP128, tx) -> Callable:
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
+        # metrics dict (vmapped to per-member leaves): loss + numerics probe
+        m = {"loss": loss}
+        if probes:
+            m["probe"] = probe_tree(grads, params, updates)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return params, opt_state, m
 
     return jax.vmap(member_step, in_axes=(0, 0, 0, 0, None, None))
 
 
-def make_sweep_train_step(model: QSCP128, tx) -> Callable:
-    """jit(vmap(member step)): (E-stacked params/opt/rng/sigma, shared batch)."""
-    vstep = _make_vstep(model, tx)
+def make_sweep_train_step(model: QSCP128, tx, probes: bool = True) -> Callable:
+    """jit(vmap(member step)): (E-stacked params/opt/rng/sigma, shared batch)
+    -> ``(params, opt_state, metrics)`` with per-member ``loss``/``probe``
+    leaves in the metrics dict."""
+    vstep = _make_vstep(model, tx, probes=probes)
 
     from functools import partial
 
@@ -117,7 +125,9 @@ def make_sweep_train_step(model: QSCP128, tx) -> Callable:
     return step
 
 
-def make_sweep_scan_steps(model: QSCP128, tx, sigmas, geom, mesh=None) -> Callable:
+def make_sweep_scan_steps(
+    model: QSCP128, tx, sigmas, geom, mesh=None, probes: bool = True
+) -> Callable:
     """K ensemble train steps in ONE device dispatch via the shared scan
     machinery (:func:`qdml_tpu.train.scan.make_scan_steps`). The scan carry
     is the ``(params, opt_state)`` stacked-ensemble pair; ``rngs`` has shape
@@ -125,14 +135,14 @@ def make_sweep_scan_steps(model: QSCP128, tx, sigmas, geom, mesh=None) -> Callab
     the per-step dispatch loop's noise stream."""
     from qdml_tpu.train.scan import make_scan_steps
 
-    vstep = _make_vstep(model, tx)
+    vstep = _make_vstep(model, tx, probes=probes)
 
     def step_body(state, batch, rngs):
         params, opt_state = state
         x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
         labels = batch["indicator"].reshape(-1)
-        params, opt_state, losses = vstep(params, opt_state, rngs, sigmas, x, labels)
-        return (params, opt_state), {"loss": losses}
+        params, opt_state, ms = vstep(params, opt_state, rngs, sigmas, x, labels)
+        return (params, opt_state), ms
 
     return make_scan_steps(
         step_body, geom, ("yp_img", "indicator"), mesh=mesh, with_rng=True
@@ -185,7 +195,8 @@ def train_nat_sweep(
     model, tx, params, opt_state, sigmas = init_sweep(
         cfg, noise_levels, train_loader.steps_per_epoch
     )
-    train_step = make_sweep_train_step(model, tx)
+    probes_on = cfg.train.probe_every > 0  # 0 compiles the probes out
+    train_step = make_sweep_train_step(model, tx, probes=probes_on)
     eval_step = make_sweep_eval_step(model)
     n_members = len(noise_levels)
     # Same architecture-fact record the QSC trainer writes (train/qsc.py):
@@ -283,9 +294,17 @@ def train_nat_sweep(
 
     scan_run = None
     if scan_eligible(cfg, mesh, train_loader, logger):
-        scan_run = make_sweep_scan_steps(model, tx, sigmas, geom, mesh=mesh)
+        scan_run = make_sweep_scan_steps(
+            model, tx, sigmas, geom, mesh=mesh, probes=probes_on
+        )
 
     clock = StepClock("nat_sweep_train")
+    # Numerics flight recorder over the stacked ensemble: probes/losses are
+    # per-member vectors, and ANY nonfinite member trips the watchdog (a
+    # spiked-sigma member poisons its slice of every vmapped dispatch).
+    rec = FlightRecorder("nat_sweep_train", cfg, workdir=workdir)
+    rec.note_good(params)
+    cost_done = False
     history = {"train_loss": [], "val_loss": [], "val_acc": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         rng = jax.random.fold_in(base_rng, epoch)
@@ -298,23 +317,47 @@ def train_nat_sweep(
                 for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
                     rng, subs = presplit_keys(rng, idx.shape[0])
                     member_keys = jax.vmap(lambda s: jax.random.split(s, n_members))(subs)
+                    if not cost_done:
+                        maybe_emit_cost(
+                            "nat_sweep_train_scan", scan_run, (params, opt_state),
+                            seed, scen, user, idx, snrs, member_keys,
+                            scan_steps=cfg.train.scan_steps, n_members=n_members,
+                        )
+                        cost_done = True
                     with clock.step() as st:
                         (params, opt_state), ms = scan_run(
                             (params, opt_state), seed, scen, user, idx, snrs, member_keys
                         )
                         st.transfer()
-                        tot += np.asarray(ms["loss"]).sum(0)
+                        losses = np.asarray(jax.device_get(ms["loss"]))
+                        tot += losses.sum(0)
+                    rec.on_step(
+                        epoch, ms, loss=losses, params=params, rng=member_keys,
+                        batch_info={"dispatch": "scan", "idx": idx, "snrs": snrs},
+                    )
                     n += idx.shape[0]
             else:
                 for batch in train_loader.epoch(epoch):
                     rng, sub = jax.random.split(rng)
                     rngs = jax.random.split(sub, n_members)
+                    pb = place_train(batch)
+                    if not cost_done:
+                        maybe_emit_cost(
+                            "nat_sweep_train_step", train_step, params, opt_state,
+                            rngs, sigmas, pb, n_members=n_members,
+                        )
+                        cost_done = True
                     with clock.step() as st:
-                        params, opt_state, losses = train_step(
-                            params, opt_state, rngs, sigmas, place_train(batch)
+                        params, opt_state, ms = train_step(
+                            params, opt_state, rngs, sigmas, pb
                         )
                         st.transfer()
-                        tot += np.asarray(losses)
+                        losses = np.asarray(jax.device_get(ms["loss"]))
+                        tot += losses
+                    rec.on_step(
+                        epoch, ms, loss=losses, params=params, rng=rngs,
+                        batch_info={"dispatch": "step", "step_in_epoch": n},
+                    )
                     n += 1
         clock.epoch_end(epoch=epoch)
         train_loss = tot / max(n, 1)

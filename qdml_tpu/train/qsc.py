@@ -21,6 +21,8 @@ from functools import partial
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
+import optax
 
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
@@ -31,7 +33,8 @@ from qdml_tpu.models.qsc import QSCP128
 from qdml_tpu.quantum.circuits import resolve_backend
 from qdml_tpu.train.checkpoint import save_checkpoint, save_train_state, try_resume
 from qdml_tpu.train.optim import get_optimizer
-from qdml_tpu.telemetry import StepClock, span
+from qdml_tpu.telemetry import FlightRecorder, StepClock, probe_tree, span
+from qdml_tpu.telemetry.cost import maybe_emit_cost
 from qdml_tpu.train.state import TrainState
 from qdml_tpu.utils.metrics import MetricsLogger
 
@@ -51,9 +54,15 @@ def build_classifier(cfg: ExperimentConfig, quantum: bool) -> nn.Module:
 
 
 def _sc_step(
-    model: nn.Module, needs_rng: bool, state: TrainState, batch: dict, rng: jax.Array
+    model: nn.Module,
+    needs_rng: bool,
+    state: TrainState,
+    batch: dict,
+    rng: jax.Array,
+    probes: bool = True,
 ) -> tuple[TrainState, dict]:
-    """One classifier grid step (traceable; jitted by the makers below)."""
+    """One classifier grid step (traceable; jitted by the makers below).
+    ``probes=False`` compiles the numerics probe out (static flag)."""
     x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
     labels = batch["indicator"].reshape(-1)
 
@@ -63,22 +72,36 @@ def _sc_step(
         return nll_loss(log_probs, labels)
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
-    state = state.apply_gradients(grads=grads)
-    return state, {"loss": loss}
+    # optax applied explicitly (flax's apply_gradients verbatim) so the
+    # numerics probe sees the actual per-step UPDATES, not a params diff
+    updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
+    m = {"loss": loss}
+    if probes:
+        m["probe"] = probe_tree(grads, state.params, updates)
+    state = state.replace(
+        step=state.step + 1,
+        params=optax.apply_updates(state.params, updates),
+        opt_state=new_opt_state,
+    )
+    return state, m
 
 
-def make_sc_train_step(model: nn.Module, needs_rng: bool) -> Callable:
+def make_sc_train_step(model: nn.Module, needs_rng: bool, probes: bool = True) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict, rng: jax.Array):
-        return _sc_step(model, needs_rng, state, batch, rng)
+        return _sc_step(model, needs_rng, state, batch, rng, probes=probes)
 
     return step
 
 
 def make_sc_scan_steps(
-    model: nn.Module, geom: ChannelGeometry, needs_rng: bool, mesh=None
+    model: nn.Module,
+    geom: ChannelGeometry,
+    needs_rng: bool,
+    mesh=None,
+    probes: bool = True,
 ) -> Callable:
     """K classifier train steps in ONE device dispatch: the shared scan
     machinery (:func:`qdml_tpu.train.scan.make_scan_steps`) bound to the
@@ -88,7 +111,7 @@ def make_sc_scan_steps(
     from qdml_tpu.train.scan import make_scan_steps
 
     return make_scan_steps(
-        partial(_sc_step, model, needs_rng),
+        partial(_sc_step, model, needs_rng, probes=probes),
         geom,
         ("yp_img", "indicator"),
         mesh=mesh,
@@ -145,7 +168,8 @@ def train_classifier(
     val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
     model, state = init_sc_state(cfg, quantum, train_loader.steps_per_epoch)
     needs_rng = quantum and cfg.quantum.use_quantumnat
-    train_step = make_sc_train_step(model, needs_rng)
+    probes_on = cfg.train.probe_every > 0  # 0 compiles the probes out
+    train_step = make_sc_train_step(model, needs_rng, probes=probes_on)
     eval_step = make_sc_eval_step(model)
     tag = "qsc" if quantum else "sc"
 
@@ -175,12 +199,18 @@ def train_classifier(
     scan_k = cfg.train.scan_steps
     scan_run = None
     if scan_eligible(cfg, mesh, train_loader, logger):
-        scan_run = make_sc_scan_steps(model, geom, needs_rng, mesh=mesh)
+        scan_run = make_sc_scan_steps(model, geom, needs_rng, mesh=mesh, probes=probes_on)
 
     # Fold the start epoch into the QuantumNAT noise stream so resumed epochs
     # draw FRESH noise instead of replaying epochs 0..start_epoch-1's draws.
     rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed + 1), start_epoch)
     clock = StepClock(f"{tag}_train")
+    # Numerics flight recorder: the QuantumNAT noise stream is exactly the
+    # knob that can silently destabilize this loop — a NaN here becomes a
+    # typed DivergenceError with a post-mortem dump (docs/FLIGHTREC.md).
+    rec = FlightRecorder(f"{tag}_train", cfg, workdir=workdir)
+    rec.note_good(state.params)
+    cost_done = False
     history: dict[str, list] = {"train_loss": [], "val_loss": [], "val_acc": []}
     for epoch in range(start_epoch, cfg.train.n_epochs):
         tot, n = 0.0, 0
@@ -190,18 +220,38 @@ def train_classifier(
                 scen, user = train_loader.grid_coords
                 for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
                     rng, subs = presplit_keys(rng, idx.shape[0])
+                    if not cost_done:
+                        maybe_emit_cost(
+                            f"{tag}_train_scan", scan_run, state, seed, scen,
+                            user, idx, snrs, subs, scan_steps=scan_k,
+                        )
+                        cost_done = True
                     with clock.step() as st:
                         state, ms = scan_run(state, seed, scen, user, idx, snrs, subs)
                         st.transfer()
-                        tot = tot + float(jnp.sum(ms["loss"]))
+                        losses = np.asarray(jax.device_get(ms["loss"]))
+                        tot = tot + float(losses.sum())
+                    rec.on_step(
+                        epoch, ms, loss=losses, params=state.params, rng=subs,
+                        batch_info={"dispatch": "scan", "idx": idx, "snrs": snrs},
+                    )
                     n += idx.shape[0]
             else:
                 for batch in train_loader.epoch(epoch):
                     rng, sub = jax.random.split(rng)
+                    pb = place_train(batch)
+                    if not cost_done:
+                        maybe_emit_cost(f"{tag}_train_step", train_step, state, pb, sub)
+                        cost_done = True
                     with clock.step() as st:
-                        state, m = train_step(state, place_train(batch), sub)
+                        state, m = train_step(state, pb, sub)
                         st.transfer()
-                        tot = tot + float(m["loss"])
+                        loss = float(m["loss"])
+                        tot = tot + loss
+                    rec.on_step(
+                        epoch, m, loss=loss, params=state.params, rng=sub,
+                        batch_info={"dispatch": "step", "step_in_epoch": n},
+                    )
                     n += 1
         clock.epoch_end(epoch=epoch)
         train_loss = tot / max(n, 1)

@@ -33,7 +33,8 @@ def test_zero_noise_member_matches_plain_qsc_step():
     model, tx, params, opt_state, sigmas = init_sweep(cfg, [0.0, 0.1], loader.steps_per_epoch)
     step = make_sweep_train_step(model, tx)
     rngs = jax.random.split(jax.random.PRNGKey(7), 2)
-    new_params, _, losses = step(params, opt_state, rngs, sigmas, batch)
+    new_params, _, ms = step(params, opt_state, rngs, sigmas, batch)
+    losses = ms["loss"]
 
     # independent plain step on member 0's params
     import optax
@@ -81,7 +82,8 @@ def test_noise_perturbs_only_qweights():
     step = make_sweep_train_step(model, tx)
     rng = jax.random.split(jax.random.PRNGKey(3), 2)
     rng = jnp.stack([rng[0], rng[0]])  # same noise draw for both
-    _, _, losses = step(shared, shared_opt, rng, jnp.asarray([0.0, 0.5]), batch)
+    _, _, ms = step(shared, shared_opt, rng, jnp.asarray([0.0, 0.5]), batch)
+    losses = ms["loss"]
     assert abs(float(losses[0]) - float(losses[1])) > 1e-6
 
 

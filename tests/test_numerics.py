@@ -1,0 +1,586 @@
+"""Numerics flight recorder + XLA cost accounting: probe semantics under
+jit/scan/shard_map, zero step-path recompiles, the divergence watchdog's
+forced-NaN dump-and-raise contract, cost degradation, histogram merging, and
+the report cost section / --json gate output."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qdml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    QuantumConfig,
+    TrainConfig,
+    override,
+)
+from qdml_tpu.telemetry import (
+    DivergenceError,
+    FlightRecorder,
+    Histogram,
+    Telemetry,
+    Watchdog,
+    cost,
+    probe_tree,
+    run_manifest,
+    set_sink,
+)
+from qdml_tpu.utils.compile_cache import compile_cache_stats
+
+
+def _read_jsonl(path):
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def tiny_cfg(**overrides) -> ExperimentConfig:
+    cfg = ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=80),
+        model=ModelConfig(features=16),
+        train=TrainConfig(batch_size=16, n_epochs=1, print_freq=1000),
+    )
+    for k, v in overrides.items():
+        cfg = override(cfg, k, v)
+    return cfg
+
+
+_TREE = {
+    "trunk": {"w": jnp.arange(6.0).reshape(2, 3) / 10, "b": jnp.ones(3)},
+    "head": {"w": -jnp.ones((3, 2))},
+}
+
+
+# ---------------------------------------------------------------------------
+# probe_tree semantics
+# ---------------------------------------------------------------------------
+
+
+def test_probe_tree_values_and_branches():
+    params = jax.tree.map(lambda x: x * 2.0, _TREE)
+    updates = jax.tree.map(lambda x: x * -0.01, _TREE)
+    p = probe_tree(_TREE, params, updates)
+    leaves = np.concatenate([np.ravel(l) for l in jax.tree.leaves(_TREE)])
+    assert float(p["grad_norm"]) == pytest.approx(np.linalg.norm(leaves), rel=1e-6)
+    # per-branch norms are the top-level children
+    assert set(p["branch_grad_norm"]) == {"trunk", "head"}
+    assert float(p["branch_grad_norm"]["head"]) == pytest.approx(np.sqrt(6.0), rel=1e-6)
+    assert float(p["param_norm"]) == pytest.approx(2 * np.linalg.norm(leaves), rel=1e-6)
+    # update ratio: |0.01 g| / |2 g| = 0.005
+    assert float(p["update_ratio"]) == pytest.approx(0.005, rel=1e-5)
+    assert int(p["nonfinite"]) == 0
+
+
+def test_probe_tree_counts_nonfinite_fused():
+    bad = {"a": jnp.asarray([1.0, np.nan]), "b": jnp.asarray([np.inf])}
+    upd = {"a": jnp.asarray([np.nan, np.nan]), "b": jnp.asarray([0.0])}
+    p = probe_tree(bad, params=None, updates=upd)
+    # 2 in grads + 2 in updates, one fused counter
+    assert int(p["nonfinite"]) == 4
+
+
+def test_probe_tree_matches_under_jit_and_zero_recompiles():
+    """jit(probe) == eager probe, and repeated calls with fresh data never
+    recompile (the compile-cache request counter is the witness)."""
+    jitted = jax.jit(lambda g: probe_tree(g, g, g))
+    eager = probe_tree(_TREE, _TREE, _TREE)
+    first = jitted(_TREE)
+    for k in ("grad_norm", "param_norm", "update_ratio"):
+        assert float(first[k]) == pytest.approx(float(eager[k]), rel=1e-6)
+    # fresh inputs prepared BEFORE the counter snapshot (eager tree ops are
+    # themselves jit-cached programs and would tick the request counter)
+    inputs = [jax.tree.map(lambda x: x + i, _TREE) for i in range(3)]
+    jax.block_until_ready(inputs)
+    base = compile_cache_stats()["requests"]
+    for tree in inputs:
+        out = jitted(tree)
+        jax.block_until_ready(out["grad_norm"])
+    assert compile_cache_stats()["requests"] == base  # zero recompiles
+
+
+def test_probe_matches_under_shard_map():
+    """probe_tree inside shard_map over the 8-device CPU mesh (replicated
+    inputs) returns the same scalars as eager — the probes are safe to embed
+    in SPMD train steps."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    fn = shard_map(
+        lambda g: probe_tree(g, g, g),
+        mesh=mesh,
+        in_specs=P(),
+        out_specs=P(),
+    )
+    out = jax.jit(fn)(_TREE)
+    eager = probe_tree(_TREE, _TREE, _TREE)
+    assert float(out["grad_norm"]) == pytest.approx(float(eager["grad_norm"]), rel=1e-6)
+    assert float(out["update_ratio"]) == pytest.approx(
+        float(eager["update_ratio"]), rel=1e-6
+    )
+    assert int(out["nonfinite"]) == 0
+
+
+def test_probe_under_scan_matches_per_step_dispatch():
+    """The scan-fused DCE path stacks per-step probes (K,) that match the
+    per-step dispatch loop's probes value-for-value — and running K steps
+    through either path adds ZERO compile-cache requests after the first."""
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.data.datasets import DMLGridLoader
+    from qdml_tpu.train.dce import init_dce_state, make_dce_scan_steps, make_dce_train_step
+
+    cfg = tiny_cfg()
+    geom = ChannelGeometry.from_config(cfg.data)
+    loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "train", geom)
+    model, state_a = init_dce_state(cfg, loader.steps_per_epoch)
+    _, state_b = init_dce_state(cfg, loader.steps_per_epoch)
+
+    step = make_dce_train_step(model)
+    per_step = []
+    steps_done = 0
+    base = None
+    for batch in loader.epoch(0):
+        state_a, m = step(state_a, batch)
+        per_step.append(
+            (float(m["probe"]["grad_norm"]), float(m["probe"]["update_ratio"]))
+        )
+        steps_done += 1
+        if steps_done == 1:
+            base = compile_cache_stats()["requests"]
+    assert compile_cache_stats()["requests"] == base  # step path never recompiled
+
+    run = make_dce_scan_steps(model, geom)
+    scen, user = loader.grid_coords
+    scanned = []
+    for idx, snrs in loader.epoch_chunks(0, k=2):
+        state_b, ms = run(state_b, jnp.uint32(cfg.data.seed), scen, user, idx, snrs)
+        gn = np.asarray(ms["probe"]["grad_norm"])
+        ur = np.asarray(ms["probe"]["update_ratio"])
+        assert gn.shape == (idx.shape[0],)  # stacked (K,) per-step probes
+        scanned.extend(zip(gn.tolist(), ur.tolist()))
+    for (a_gn, a_ur), (b_gn, b_ur) in zip(per_step, scanned):
+        assert a_gn == pytest.approx(b_gn, rel=1e-4)
+        assert a_ur == pytest.approx(b_ur, rel=1e-4)
+
+
+def test_probes_compile_out_when_disabled():
+    """probes=False (what the loops pass for train.probe_every=0) removes the
+    probe from the step program entirely — not just from the host fetch."""
+    from qdml_tpu.data.datasets import DMLGridLoader
+    from qdml_tpu.train.dce import init_dce_state, make_dce_train_step
+
+    cfg = tiny_cfg()
+    loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
+    batch = next(iter(loader.epoch(0)))
+    model, state = init_dce_state(cfg, loader.steps_per_epoch)
+    _, m = make_dce_train_step(model, probes=False)(state, batch)
+    assert "probe" not in m and "loss" in m
+
+
+# ---------------------------------------------------------------------------
+# Watchdog + FlightRecorder
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_trip_conditions():
+    wd = Watchdog(grad_norm_max=100.0)
+    assert wd.check(loss=0.5, probe={"nonfinite": 0, "grad_norm": 1.0}) is None
+    assert "loss" in wd.check(loss=float("nan"))
+    assert "loss" in wd.check(loss=np.asarray([0.1, np.inf]))  # scan chunk
+    assert "nonfinite" in wd.check(loss=0.1, probe={"nonfinite": 3, "grad_norm": 1.0})
+    assert "ceiling" in wd.check(loss=0.1, probe={"nonfinite": 0, "grad_norm": 101.0})
+    # per-member vectors: ANY bad member trips
+    assert "ceiling" in wd.check(probe={"nonfinite": 0, "grad_norm": np.asarray([1.0, 400.0])})
+    assert Watchdog(grad_norm_max=0.0).check(probe={"nonfinite": 0, "grad_norm": 1e9}) is None
+
+
+def test_flight_recorder_emits_numerics_records(tmp_path):
+    cfg = tiny_cfg(**{"train.probe_every": 2, "eval.results_dir": str(tmp_path)})
+    tele = Telemetry(str(tmp_path / "n.jsonl"))
+    rec = FlightRecorder("unit", cfg, sink=tele)
+    m = {"loss": jnp.float32(0.25), "probe": probe_tree(_TREE, _TREE, _TREE)}
+    for epoch_step in range(4):
+        rec.on_step(0, m, loss=0.25)
+    tele.close()
+    lines = [l for l in _read_jsonl(tmp_path / "n.jsonl") if l.get("kind") == "numerics"]
+    # steps 1 (always), 2 and 4 (cadence) log; step 3 does not
+    assert [l["step"] for l in lines] == [1, 2, 4]
+    assert lines[0]["name"] == "unit" and lines[0]["grad_norm"] > 0
+    assert lines[0]["branch_grad_norm"]["trunk"] > 0
+
+
+def test_last_good_refreshes_without_probes(tmp_path):
+    """probe_every=0 + watchdog on: the last-good snapshot must still refresh
+    on the fallback cadence — a long run's dump must not 'restore' to the
+    step-0 init params."""
+    from qdml_tpu.telemetry.numerics import LAST_GOOD_FALLBACK_EVERY
+
+    cfg = tiny_cfg(**{"train.probe_every": 0, "eval.results_dir": str(tmp_path)})
+    rec = FlightRecorder("unit", cfg)
+    rec.note_good({"w": jnp.zeros(3)})
+    for i in range(1, LAST_GOOD_FALLBACK_EVERY + 1):
+        rec.on_step(0, {}, loss=0.5, params={"w": jnp.full(3, float(i))})
+    with pytest.raises(DivergenceError) as ei:
+        rec.on_step(0, {}, loss=float("nan"))
+    bundle = json.load(open(os.path.join(ei.value.dump_dir, "bundle.json")))
+    assert bundle["last_good"]["step"] == LAST_GOOD_FALLBACK_EVERY
+    from qdml_tpu.train.checkpoint import restore_checkpoint
+
+    restored, _ = restore_checkpoint(ei.value.dump_dir, "last_good")
+    np.testing.assert_array_equal(
+        restored["params"]["w"], np.full(3, float(LAST_GOOD_FALLBACK_EVERY))
+    )
+
+
+def test_forced_nan_qsc_run_trips_watchdog_with_restorable_dump(tmp_path):
+    """The acceptance scenario: a QSC run whose QuantumNAT noise std is
+    spiked past overflow (sigma * N(0,1) -> inf -> sin(inf) = NaN in the
+    circuit; merely-huge finite sigmas can survive f32 range reduction) must
+    raise a typed DivergenceError naming a flight-recorder dump whose bundle
+    restores to the last-good params."""
+    from qdml_tpu.train.checkpoint import restore_checkpoint
+    from qdml_tpu.train.qsc import train_classifier
+
+    cfg = tiny_cfg(
+        **{
+            "train.probe_every": 1,
+            "train.n_epochs": 2,
+            "eval.results_dir": str(tmp_path / "results"),
+        }
+    )
+    cfg = dataclasses.replace(
+        cfg,
+        quantum=QuantumConfig(
+            n_qubits=4, use_quantumnat=True, noise_level=float("inf")
+        ),
+    )
+    with pytest.raises(DivergenceError) as ei:
+        train_classifier(cfg, quantum=True, workdir=str(tmp_path / "wd"))
+    err = ei.value
+    assert err.dump_dir is not None and err.dump_dir in str(err)
+    assert "flightrec" in err.dump_dir
+    bundle = json.load(open(os.path.join(err.dump_dir, "bundle.json")))
+    assert bundle["reason"] == err.reason and bundle["name"] == "qsc_train"
+    assert bundle["probe_history"]  # the tail that led up to the trip
+    assert bundle["rng_key"] is not None  # the offending noise draw is replayable
+    assert bundle["batch_info"] is not None
+    # the bundle's last_good checkpoint restores to finite params
+    assert bundle["last_good"] is not None
+    restored, meta = restore_checkpoint(err.dump_dir, bundle["last_good"]["checkpoint"])
+    assert meta["loop"] == "qsc_train"
+    for leaf in jax.tree.leaves(restored["params"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_watchdog_disabled_lets_nan_run_continue(tmp_path):
+    """train.watchdog=false restores the old silently-NaN behavior (the knob
+    must actually disconnect the trip, not just the dump)."""
+    from qdml_tpu.train.qsc import train_classifier
+
+    cfg = tiny_cfg(
+        **{
+            "train.watchdog": False,
+            "train.probe_every": 0,
+            "eval.results_dir": str(tmp_path / "results"),
+        }
+    )
+    cfg = dataclasses.replace(
+        cfg,
+        quantum=QuantumConfig(
+            n_qubits=4, use_quantumnat=True, noise_level=float("inf")
+        ),
+    )
+    _, hist = train_classifier(cfg, quantum=True)
+    assert not np.isfinite(hist["train_loss"]).all()  # it really did NaN
+
+
+def test_hdce_loop_emits_numerics_and_cost_records(tmp_path):
+    """Full-loop integration: a sink-attached HDCE run writes manifest-headed
+    numerics AND cost records (the acceptance shape for train loops)."""
+    from qdml_tpu.train.hdce import train_hdce
+
+    cfg = tiny_cfg(**{"eval.results_dir": str(tmp_path / "results")})
+    tele = Telemetry(str(tmp_path / "train.jsonl"), manifest=run_manifest(cfg))
+    set_sink(tele)
+    try:
+        train_hdce(cfg)
+    finally:
+        set_sink(None)
+        tele.close()
+    lines = _read_jsonl(tmp_path / "train.jsonl")
+    assert lines[0]["kind"] == "manifest"
+    numerics = [l for l in lines if l.get("kind") == "numerics"]
+    assert numerics and numerics[0]["name"] == "hdce_train"
+    assert numerics[0]["grad_norm"] > 0 and numerics[0]["nonfinite"] == 0
+    costs = [l for l in lines if l.get("kind") == "cost"]
+    assert costs and costs[0]["name"] == "hdce_train_step"
+    assert costs[0]["available"] is True
+    assert costs[0]["flops"] > 0 and costs[0]["bytes_accessed"] > 0
+    assert costs[0]["roofline"] in ("compute-bound", "memory-bound")
+
+
+# ---------------------------------------------------------------------------
+# cost.analyze: real lowered/compiled programs + structural degradation
+# ---------------------------------------------------------------------------
+
+
+def test_cost_analyze_lowered_and_compiled():
+    def f(x):
+        return (x @ x).sum()
+
+    lowered = jax.jit(f).lower(jnp.ones((32, 32)))
+    rec = cost.analyze(lowered)
+    assert rec["available"] and rec["source"] == "lowered"
+    assert rec["flops"] > 0 and rec["bytes_accessed"] > 0
+    assert rec["peak_temp_bytes"] is None  # lowered-only: no memory stats
+    assert rec["roofline"] in ("compute-bound", "memory-bound")
+
+    compiled = lowered.compile()
+    rec2 = cost.analyze(compiled)
+    assert rec2["available"] and rec2["source"] == "compiled"
+    assert rec2["peak_temp_bytes"] is not None
+    assert rec2["argument_bytes"] > 0
+
+
+def test_cost_analyze_degrades_when_backend_unavailable():
+    """The satellite bugfix: cost_analysis() raising (or returning nothing)
+    must yield {"available": false, "reason": ...}, never an exception."""
+
+    class Broken:
+        def cost_analysis(self):
+            raise NotImplementedError("no cost analysis on this backend")
+
+    rec = cost.analyze(Broken())
+    assert rec["available"] is False and "NotImplementedError" in rec["reason"]
+
+    class Empty:
+        def cost_analysis(self):
+            return None
+
+        def memory_analysis(self):
+            return None
+
+    rec = cost.analyze(Empty())
+    assert rec["available"] is False and "reason" in rec
+
+    class MemOnly:
+        def cost_analysis(self):
+            return []
+
+        def memory_analysis(self):
+            class M:
+                temp_size_in_bytes = 123
+                argument_size_in_bytes = 7
+
+            return M()
+
+    rec = cost.analyze(MemOnly())
+    assert rec["available"] and rec["peak_temp_bytes"] == 123
+    assert rec["roofline"] == "unknown"  # no flops/bytes to classify
+
+
+def test_cost_analyze_jit_never_raises_on_bad_args():
+    rec = cost.analyze_jit(jax.jit(lambda x: x), object())
+    assert rec["available"] is False and "lowering failed" in rec["reason"]
+
+
+def test_maybe_emit_cost_inert_without_sink(tmp_path):
+    assert cost.maybe_emit_cost("x", jax.jit(lambda x: x), jnp.ones(2)) is None
+    tele = Telemetry(str(tmp_path / "c.jsonl"))
+    rec = cost.maybe_emit_cost("x", jax.jit(lambda x: x * 2), jnp.ones(2), sink=tele)
+    tele.close()
+    assert rec is not None
+    lines = _read_jsonl(tmp_path / "c.jsonl")
+    assert lines[0]["kind"] == "cost" and lines[0]["name"] == "x"
+
+
+def test_roofline_classification_table():
+    assert cost.ridge_intensity("tpu-v5e") == pytest.approx(197e12 / 8.19e11)
+    # far above any ridge -> compute-bound; far below -> memory-bound
+    hi = {"flops": 1e15, "bytes accessed": 1e9}
+    lo = {"flops": 1e9, "bytes accessed": 1e9}
+
+    class Stub:
+        def __init__(self, ca):
+            self._ca = ca
+
+        def cost_analysis(self):
+            return self._ca
+
+    assert cost.analyze(Stub(hi), platform="tpu-v5e")["roofline"] == "compute-bound"
+    assert cost.analyze(Stub(lo), platform="tpu-v5e")["roofline"] == "memory-bound"
+
+
+# ---------------------------------------------------------------------------
+# Histogram.merge (satellite): merged quantiles == concatenated quantiles
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_histogram_merge_property(seed):
+    """Property test: for random sample sets split into random parts, the
+    merged histogram's summary equals the summary of one histogram fed the
+    concatenation (exact — the collector keeps raw samples)."""
+    rng = np.random.default_rng(seed)
+    n_parts = int(rng.integers(1, 5))
+    parts = [rng.exponential(0.01, rng.integers(1, 200)) for _ in range(n_parts)]
+    merged = Histogram()
+    for part in parts:
+        h = Histogram()
+        for v in part:
+            h.add(float(v))
+        merged.merge(h)
+    ref = Histogram()
+    for v in np.concatenate(parts):
+        ref.add(float(v))
+    assert merged.summary() == ref.summary()
+
+
+# ---------------------------------------------------------------------------
+# report: cost section, program-change flag, --json gate output
+# ---------------------------------------------------------------------------
+
+
+def _bench_record(value, flops, bytes_=4e6, platform="cpu_fallback"):
+    return {
+        "metric": "hdce_train_samples_per_sec_per_chip",
+        "value": value,
+        "platform": platform,
+        "details": {
+            "hdce_f32": {
+                "samples_per_sec": value,
+                "cost": {
+                    "available": True,
+                    "flops": flops,
+                    "bytes_accessed": bytes_,
+                    "roofline": "memory-bound",
+                },
+            }
+        },
+    }
+
+
+def _write(tmp_path, name, *objs):
+    p = tmp_path / name
+    with open(p, "w") as fh:
+        for o in objs:
+            fh.write(json.dumps(o) + "\n")
+    return str(p)
+
+
+def test_report_flags_regression_with_program_change(tmp_path):
+    from qdml_tpu.telemetry.report import build_report_data
+
+    base = _write(tmp_path, "b.jsonl", _bench_record(1000.0, flops=1e9))
+    cur = _write(tmp_path, "c.jsonl", _bench_record(700.0, flops=2e9))
+    data = build_report_data([cur], base, 10.0)
+    assert data["gate_armed"]
+    reg = [r for r in data["regressions"] if r["metric"] == "hdce_f32.samples_per_sec"]
+    assert reg and reg[0]["program_change"]["flops"]["delta_pct"] == pytest.approx(100.0)
+    assert "program changed" in data["markdown"]
+    assert "## cost" in data["markdown"]
+    row = [r for r in data["cost"] if r["program"] == "hdce_f32"][0]
+    assert row["program_changed"] is True
+    # same regression with UNCHANGED cost carries no program-change flag
+    cur2 = _write(tmp_path, "c2.jsonl", _bench_record(700.0, flops=1e9))
+    data2 = build_report_data([cur2], base, 10.0)
+    reg2 = [r for r in data2["regressions"] if r["metric"] == "hdce_f32.samples_per_sec"]
+    assert reg2 and "program_change" not in reg2[0]
+    assert [r for r in data2["cost"] if r["program"] == "hdce_f32"][0][
+        "program_changed"
+    ] is False
+
+
+def test_report_reads_stream_cost_records(tmp_path):
+    """kind="cost" records from train/serve streams join the cost section
+    keyed by name (and bucket)."""
+    from qdml_tpu.telemetry.report import build_report_data
+
+    def stream(v, flops):
+        return [
+            {"kind": "manifest"},
+            {"kind": "cost", "name": "hdce_train_step", "available": True,
+             "flops": flops, "bytes_accessed": 1e6, "roofline": "memory-bound"},
+            {"kind": "cost", "name": "serve_bucket", "bucket": 8, "available": True,
+             "flops": 5e8, "bytes_accessed": 2e6, "roofline": "memory-bound"},
+            {"metric": "m", "value": v, "platform": "cpu"},
+        ]
+
+    base = _write(tmp_path, "b.jsonl", *stream(100.0, 1e9))
+    cur = _write(tmp_path, "c.jsonl", *stream(95.0, 1e9))
+    data = build_report_data([cur], base, 10.0)
+    assert {r["program"] for r in data["cost"]} == {"hdce_train_step", "serve_bucket[8]"}
+
+
+def test_lint_markers_parses_durations_and_detects_markers(tmp_path):
+    """scripts/lint_markers.py: duration parsing, slow-marker source
+    detection (the real `slow`-marked soak test in test_serve.py), and
+    allowlist behavior."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_markers",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "lint_markers.py"),
+    )
+    lm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lm)
+
+    durations = lm.parse_durations(
+        "  12.34s call     tests/test_a.py::test_x\n"
+        "   0.50s setup    tests/test_a.py::test_x\n"  # setup phase ignored
+        "   7.00s call     tests/test_a.py::test_y[p0]\n"
+        "   9.00s call     tests/test_a.py::test_y[p1]\n"
+    )
+    assert durations == {
+        "tests/test_a.py::test_x": 12.34,
+        "tests/test_a.py::test_y": 9.0,  # max over parametrizations
+    }
+    serve_py = os.path.join(os.path.dirname(__file__), "test_serve.py")
+    assert lm.has_slow_marker(serve_py, "test_loadgen_soak_open_loop_with_deadlines")
+    assert not lm.has_slow_marker(serve_py, "test_empty_queue_flush_is_noop")
+
+    dur = tmp_path / "d.log"
+    dur.write_text("  30.00s call     tests/test_serve.py::test_empty_queue_flush_is_noop\n")
+    assert lm.main([f"--durations={dur}", "--allow=/nonexistent"]) == 1  # offender
+    allow = tmp_path / "allow.txt"
+    allow.write_text("tests/test_serve.py::test_empty_queue_flush_is_noop  # reason\n")
+    assert lm.main([f"--durations={dur}", f"--allow={allow}"]) == 0
+    # the committed allowlist keeps the real tier-1 suite lint-clean
+    assert os.path.exists(
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "tier1_slow_allowlist.txt")
+    )
+
+
+def test_report_json_gate_output(tmp_path, capsys):
+    from qdml_tpu.telemetry.report import EXIT_REGRESSION, report_main
+
+    base = _write(tmp_path, "b.jsonl", _bench_record(1000.0, flops=1e9))
+    cur = _write(tmp_path, "c.jsonl", _bench_record(700.0, flops=2e9))
+    json_path = tmp_path / "gate.json"
+    rc = report_main(
+        [f"--current={cur}", f"--baseline={base}", f"--json={json_path}"]
+    )
+    capsys.readouterr()
+    assert rc == EXIT_REGRESSION
+    gate = json.load(open(json_path))
+    assert gate["exit_code"] == EXIT_REGRESSION
+    assert gate["gate_armed"] is True and gate["disarm_reason"] is None
+    assert "markdown" not in gate  # machine-readable only
+    by_metric = {g["metric"]: g for g in gate["gates"]}
+    assert by_metric["hdce_f32.samples_per_sec"]["status"] == "regression+program-change"
+    assert by_metric["hdce_f32.samples_per_sec"]["delta_pct"] == pytest.approx(-30.0)
+    assert gate["cost"][0]["program_changed"] is True
+
+    # disarm reason surfaces in the json too
+    base2 = _write(tmp_path, "b2.jsonl", _bench_record(1000.0, flops=1e9, platform="tpu-v5e"))
+    json2 = tmp_path / "gate2.json"
+    rc2 = report_main([f"--current={cur}", f"--baseline={base2}", f"--json={json2}"])
+    capsys.readouterr()
+    gate2 = json.load(open(json2))
+    assert rc2 == 0 and gate2["gate_armed"] is False
+    assert "platform mismatch" in gate2["disarm_reason"]

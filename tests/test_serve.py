@@ -237,6 +237,14 @@ def test_loadgen_fast_run_emits_manifest_headed_telemetry(warmed, tmp_path):
     assert summary["pred_agreement"] == 1.0
     assert summary["nmse_db_served"] == pytest.approx(summary["nmse_db_offline"], abs=1e-6)
     assert {"p50_ms", "p95_ms", "p99_ms"} <= set(summary["latency_ms"])
+    # the end-of-run poll of the live-metrics verb, folded slim (only the
+    # fields the verb ADDS; the summary already carries the histograms)
+    assert summary["server_metrics"] == {
+        "workers": 1, "queue_depth_now": 0,
+        "buckets": list(engine.buckets), "completed": 48,
+    }
+    # warmup cost accounting rides into the serve_summary record
+    assert all(c["available"] for c in summary["warmup"]["cost"].values())
 
     lines = _read_jsonl(path)
     assert lines[0]["kind"] == "manifest"
@@ -391,6 +399,121 @@ def test_loadgen_soak_open_loop_with_deadlines(warmed, tmp_path):
     assert summary["compile_cache_after_warmup"]["requests"] == 0
     assert summary["parity_max_abs_err"] < 1e-4
     assert set(summary["shed"]) <= {QUEUE_FULL, DEADLINE_AT_SUBMIT, DEADLINE_AT_DEQUEUE}
+
+
+# ---------------------------------------------------------------------------
+# Live metrics verb + per-worker metrics merge + warmup cost accounting
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_returns_per_bucket_cost(warmed):
+    """Every AOT bucket carries a COMPILED cost record (flops, bytes, peak
+    temp memory, roofline) — the serving half of the cost-accounting
+    acceptance criterion."""
+    cfg, engine, *_ = warmed
+    assert set(engine.bucket_cost) == {str(b) for b in engine.buckets}
+    for rec in engine.bucket_cost.values():
+        assert rec["available"] is True and rec["source"] == "compiled"
+        assert rec["flops"] > 0 and rec["bytes_accessed"] > 0
+        assert rec["peak_temp_bytes"] is not None
+        assert rec["roofline"] in ("compute-bound", "memory-bound")
+
+
+def test_live_metrics_snapshot(warmed):
+    """ServeLoop.live_metrics — the `{"op": "metrics"}` payload — reports
+    counters, tail percentiles, batch fill, shed counts, queue depth and the
+    compile-cache snapshot of a RUNNING loop."""
+    cfg, engine, samples, *_ = warmed
+    loop = ServeLoop(engine).start()
+    try:
+        futs = [loop.submit(samples["x"][i], rid=i) for i in range(12)]
+        results = [f.result(timeout=30.0) for f in futs]
+        live = loop.live_metrics()
+    finally:
+        loop.stop()
+    assert all(isinstance(r, Prediction) for r in results)
+    assert "kind" not in live  # a reading, not a run artifact
+    assert live["completed"] == 12 and live["workers"] == 1
+    assert live["queue_depth_now"] == 0 and live["buckets"] == list(engine.buckets)
+    assert live["latency_ms"]["n"] == 12
+    assert {"p50_ms", "p95_ms", "p99_ms"} <= set(live["latency_ms"])
+    assert live["compile_cache_after_warmup"] == {"hits": 0, "misses": 0, "requests": 0}
+
+
+def test_multi_worker_loop_merges_per_worker_metrics(warmed):
+    """workers=2: both workers drain the shared batcher into their own
+    collectors; the merged view accounts for every request exactly once and
+    parity still holds (the engine is thread-safe post-warmup)."""
+    cfg, engine, samples, offline_h, _ = warmed
+    loop = ServeLoop(engine, workers=2).start()
+    try:
+        assert len(loop._threads) == 2
+        # two bounded waves (the queue holds 32): every future resolves, and
+        # work lands on whichever worker dequeues first
+        results = []
+        for wave in range(2):
+            futs = [
+                loop.submit(samples["x"][i % 32], rid=wave * 32 + i)
+                for i in range(32)
+            ]
+            results += [f.result(timeout=30.0) for f in futs]
+    finally:
+        loop.stop()
+    preds = [r for r in results if isinstance(r, Prediction)]
+    assert len(preds) == 64  # bounded waves: nothing shed, nothing stranded
+    for r in preds:
+        np.testing.assert_allclose(r.h, offline_h[r.rid % 32], rtol=1e-5, atol=1e-5)
+    merged = loop.merged_metrics()
+    assert merged.completed == 64
+    assert merged.latency.summary()["n"] == 64
+    assert merged.batches == sum(m.batches for m in loop._worker_metrics)
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+
+
+def test_socket_metrics_verb(warmed):
+    """`{"op": "metrics"}` over the TCP framing returns the live counters
+    without submitting any inference."""
+    import asyncio
+    import socket
+    from concurrent.futures import Future
+
+    from qdml_tpu.serve.server import serve_async
+
+    cfg, engine, samples, *_ = warmed
+    loop_ = ServeLoop(engine).start()
+    aloop = asyncio.new_event_loop()
+    t = threading.Thread(target=aloop.run_forever, daemon=True)
+    t.start()
+    ready: Future = Future()
+    task = asyncio.run_coroutine_threadsafe(
+        serve_async(loop_, "127.0.0.1", 0, ready), aloop
+    )
+    try:
+        port = ready.result(timeout=10.0)
+        with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+            fh = sk.makefile("rw")
+            # one real request so the counters are non-trivial
+            fh.write(json.dumps({"id": 0, "x": samples["x"][0].tolist()}) + "\n")
+            fh.flush()
+            assert json.loads(fh.readline())["ok"] is True
+            fh.write(json.dumps({"op": "metrics", "id": "m1"}) + "\n")
+            fh.flush()
+            resp = json.loads(fh.readline())
+            assert resp["ok"] is True and resp["id"] == "m1"
+            m = resp["metrics"]
+            assert m["completed"] >= 1 and m["latency_ms"]["n"] >= 1
+            assert m["compile_cache_after_warmup"]["requests"] == 0
+            assert m["buckets"] == list(engine.buckets)
+            # the verb itself submitted no inference
+            fh.write(json.dumps({"op": "metrics"}) + "\n")
+            fh.flush()
+            m2 = json.loads(fh.readline())
+            assert m2["metrics"]["completed"] == m["completed"]
+    finally:
+        task.cancel()
+        aloop.call_soon_threadsafe(aloop.stop)
+        t.join(timeout=5.0)
+        loop_.stop()
 
 
 # ---------------------------------------------------------------------------
