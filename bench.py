@@ -477,7 +477,7 @@ def run_child(platform: str) -> int:
     """Run every measurement, print one JSON dict to stdout."""
     import jax
 
-    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.telemetry import DivergenceError, run_manifest
     from qdml_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -578,7 +578,15 @@ def run_child(platform: str) -> int:
     for key, fn in benches:
         try:
             out[key] = fn()
-        except Exception as e:
+        except DivergenceError as e:
+            # typed divergence keeps its flight-recorder pointer in the
+            # artifact instead of being flattened into a generic error string
+            out[key] = {
+                "error": f"DivergenceError: {e}",
+                "diverged": True,
+                "flightrec_dump": e.dump_dir,
+            }
+        except Exception as e:  # lint: disable=broad-except(sub-bench isolation: one failing sub-bench must not kill the others; DivergenceError is handled above)
             out[key] = {"error": f"{type(e).__name__}: {e}"}
     from qdml_tpu.utils.compile_cache import compile_cache_stats
 
@@ -837,7 +845,7 @@ def _write_telemetry_jsonl(path: str, manifest: dict | None, record: dict) -> No
         with open(path, "w") as fh:
             fh.write(json.dumps(manifest) + "\n")
             fh.write(json.dumps({"kind": "bench_record", **record}) + "\n")
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # lint: disable=broad-except(bench telemetry write is best-effort — the result was already printed; a write failure must not kill a finished bench)
         print(f"[bench] telemetry write failed: {e}", file=sys.stderr, flush=True)
 
 

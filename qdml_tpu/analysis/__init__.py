@@ -1,0 +1,26 @@
+"""graftlint: the project-aware JAX/TPU static analysis suite.
+
+``qdml-tpu lint`` (and ``scripts/run_lint.sh``) runs an AST-based rule set
+derived from bugs this repo has actually shipped or review-hardened —
+recompile traps, host syncs in hot paths, primary-only collectives that
+deadlock multihost, serve-path lock/future discipline, broad excepts that
+swallow the project's typed errors — plus the slow-marker budget rule folded
+in from ``scripts/lint_markers.py``. Per-line
+``# lint: disable=rule(reason)`` suppressions and a checked-in baseline
+(``scripts/lint_baseline.json``) keep the gate zero-findings-or-allowlisted.
+
+Rule catalog with the shipped bug behind each rule: ``docs/ANALYSIS.md``.
+The runtime complement (``jax.experimental.checkify`` threaded through the
+train steps and serve engine) lives in :mod:`qdml_tpu.telemetry.sanitizer`.
+"""
+
+from qdml_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    LintEngine,
+    LintResult,
+    ModuleContext,
+    load_baseline,
+    parse_suppressions,
+    save_baseline,
+)
+from qdml_tpu.analysis.rules import RULES, all_rules  # noqa: F401

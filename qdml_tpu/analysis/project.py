@@ -1,0 +1,117 @@
+"""Project-aware lint configuration: the maps that make graftlint *this
+repo's* linter instead of a generic JAX style checker.
+
+Every entry here encodes a hazard this codebase has actually shipped or
+review-hardened (docs/ANALYSIS.md carries the full catalog with the history):
+
+- :data:`DEFAULT_PATHS` — what ``qdml-tpu lint`` scans. ``tests/`` is
+  deliberately excluded from the AST rules (fixture files under
+  ``tests/fixtures/lint/`` contain intentional violations; test modules run
+  device ops at import time by design) — test wall-clock budgets are covered
+  by the separate slow-marker rule over a ``--durations`` report instead.
+- :data:`LOCK_MAP` — the serve-path lock discipline: thread-shared attributes
+  and the lock that must be held to touch them (the PR-2 soak-test race
+  shape: ``MicroBatcher._q`` mutated while a worker drains it).
+- :data:`HOT_HOST_FUNCS` — host-side request-path functions where every
+  device→host sync must be deliberate (audited via suppression, never
+  incidental).
+- :data:`COLLECTIVE_CALLS` — calls that are multi-host collectives (orbax
+  saves above all): guarding them behind ``is_primary()`` deadlocks every
+  non-primary process at the collective's barrier — the exact bug
+  review-hardened in PR 3's flight-recorder dump path.
+- :data:`TYPED_EXCEPTIONS` — the project's typed error contracts that a
+  broad ``except`` can silently swallow (``DivergenceError`` exits the CLI
+  with code 4; serving sheds via typed ``Overloaded`` results).
+"""
+
+from __future__ import annotations
+
+# Paths scanned by default (repo-relative; directories recurse over *.py).
+DEFAULT_PATHS: tuple[str, ...] = (
+    "qdml_tpu",
+    "scripts",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+# Thread-shared state -> required lock, per file and class. Attribute reads
+# AND writes outside a ``with self.<lock>:`` block are findings (``__init__``
+# is exempt: construction happens-before any sharing).
+LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
+    "qdml_tpu/serve/batcher.py": {"MicroBatcher": {"_q": "_lock"}},
+    "qdml_tpu/serve/server.py": {"ServeLoop": {"_live_workers": "_exit_lock"}},
+}
+
+# (file, ClassName.method) host-side hot paths audited for device->host
+# syncs. Traceable (jit-reachable) functions are detected automatically; this
+# map adds the host-side serve request path, where a sync is sometimes THE
+# point (the reply fetch) but must carry a written justification.
+HOT_HOST_FUNCS: dict[str, tuple[str, ...]] = {
+    "qdml_tpu/serve/engine.py": ("ServeEngine.infer",),
+    "qdml_tpu/serve/server.py": ("ServeLoop._serve_one",),
+}
+
+# Call names that are (or wrap) multi-host collectives. save_checkpoint /
+# save_train_state wrap orbax saves, which are collective across processes.
+COLLECTIVE_CALLS: frozenset[str] = frozenset(
+    {
+        "save_checkpoint",
+        "save_train_state",
+        "broadcast_one_to_all",
+        "sync_global_devices",
+        "process_allgather",
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "all_to_all",
+    }
+)
+
+# Guard predicates that make a block primary-only.
+PRIMARY_GUARDS: frozenset[str] = frozenset({"is_primary", "process_index"})
+
+# Typed exceptions a broad except can swallow (rule broad-except's message
+# names them so the fix is obvious).
+TYPED_EXCEPTIONS: tuple[str, ...] = ("DivergenceError", "KeyboardInterrupt")
+
+# Names whose call is a host-side device sync when it appears in a traced
+# (jit-reachable) function or a HOT_HOST_FUNCS request path.
+HOST_SYNC_ATTRS: frozenset[str] = frozenset({"item", "device_get", "block_until_ready"})
+HOST_SYNC_NAMES: frozenset[str] = frozenset({"float", "int", "bool"})
+HOST_SYNC_NP: frozenset[str] = frozenset({"asarray", "array"})
+
+# Wall-clock sources that silently freeze into a jitted program as constants.
+WALL_CLOCK_CALLS: frozenset[str] = frozenset(
+    {"time", "monotonic", "perf_counter", "process_time", "now", "utcnow", "today"}
+)
+
+# Entry points whose function-valued arguments get traced by JAX (used to
+# seed jit-reachability beyond literal @jax.jit decorators). Matched on the
+# last attribute segment of the callee.
+TRACING_ENTRY_POINTS: frozenset[str] = frozenset(
+    {
+        "jit",
+        "vmap",
+        "pmap",
+        "scan",
+        "cond",
+        "while_loop",
+        "fori_loop",
+        "shard_map",
+        "checkify",
+        "checkify_step",
+        "remat",
+        "checkpoint",
+        "grad",
+        "value_and_grad",
+        "make_scan_steps",
+        "custom_vjp",
+        "custom_jvp",
+    }
+)
+
+# Train-step maker naming convention: these must audit their jit for
+# donate_argnums/static_* (eval-step makers are exempt — nothing to donate).
+TRAIN_MAKER_PATTERN = r"^make_\w*(train|scan)\w*step"

@@ -1,0 +1,610 @@
+"""graftlint rule set: ~10 JAX/TPU hazard classes this repo has shipped.
+
+Each rule is a callable ``(ModuleContext) -> list[Finding]`` registered in
+:data:`RULES` with its id and a one-line rationale (docs/ANALYSIS.md carries
+the full catalog, with the shipped bug each rule would have caught).
+
+Rules are deliberately precise over exhaustive: a lint that cries wolf gets
+disabled; one that encodes the exact shape of a bug we shipped gets trusted.
+Every heuristic documents what it intentionally does NOT catch.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable
+
+from qdml_tpu.analysis.engine import Finding, ModuleContext, dotted_name
+from qdml_tpu.analysis import project
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# ---------------------------------------------------------------------------
+# 1. jit-mutable-global — jitted code closing over module-level mutable state
+# ---------------------------------------------------------------------------
+
+
+def rule_jit_mutable_global(ctx: ModuleContext) -> list[Finding]:
+    """A traced function reading a module-level dict/list/set closes over a
+    value jit BAKES IN at trace time: later mutations are silently ignored
+    (or worse, retrigger a retrace via a non-hashable static). Reads of
+    immutable module constants (tuples, numbers, strings) are fine and not
+    flagged."""
+    out: list[Finding] = []
+    if not ctx.mutable_globals:
+        return out
+    for fn in ctx.traced:
+        params = {a.arg for a in _all_args(fn)}
+        local_stores: set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                targets = [sub.target]
+            else:
+                continue
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        local_stores.add(n.id)
+        seen: set[str] = set()
+        for sub in ast.walk(fn):
+            if not (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)):
+                continue
+            name = sub.id
+            if (
+                name in ctx.mutable_globals
+                and name not in params
+                and name not in local_stores
+                and name not in seen
+            ):
+                seen.add(name)
+                out.append(
+                    ctx.finding(
+                        "jit-mutable-global",
+                        sub,
+                        f"jit-reachable {ctx.qualname(fn)!r} reads module-level "
+                        f"mutable {name!r}: the traced program freezes its value "
+                        "at first compile — pass it as an argument or make it "
+                        "immutable",
+                    )
+                )
+    return out
+
+
+def _all_args(fn: ast.AST) -> list[ast.arg]:
+    a = fn.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs] + (
+        [a.vararg] if a.vararg else []
+    ) + ([a.kwarg] if a.kwarg else [])
+
+
+# ---------------------------------------------------------------------------
+# 2. train-step-jit-audit — makers must declare donation/static intent
+# ---------------------------------------------------------------------------
+
+_TRAIN_MAKER_RE = re.compile(project.TRAIN_MAKER_PATTERN)
+
+
+def rule_train_step_jit_audit(ctx: ModuleContext) -> list[Finding]:
+    """A train-step maker jitting without ``donate_argnums``/``static_*`` is
+    how the double-HBM-footprint step ships: the optimizer state and params
+    are both live across the update unless donated. Eval-step makers are
+    exempt (nothing to donate); makers that delegate jitting elsewhere (the
+    scan machinery) carry no jit and are not flagged."""
+    out: list[Finding] = []
+    audit_kws = {"donate_argnums", "donate_argnames", "static_argnums", "static_argnames"}
+    for fn, qual in ctx.functions:
+        if not _TRAIN_MAKER_RE.match(fn.name):
+            continue
+        for sub in ast.walk(fn):
+            jit_call = None
+            if isinstance(sub, ast.Call):
+                callee = ctx.canonical(sub.func)
+                if callee and callee.rsplit(".", 1)[-1] == "jit":
+                    jit_call = sub
+                elif callee and callee.rsplit(".", 1)[-1] == "partial" and any(
+                    (ctx.canonical(a) or "").rsplit(".", 1)[-1] == "jit" for a in sub.args
+                ):
+                    jit_call = sub
+            elif isinstance(sub, _FuncNode) and sub is not fn:
+                for dec in sub.decorator_list:
+                    callee = ctx.canonical(dec)
+                    if callee and callee.rsplit(".", 1)[-1] == "jit":
+                        out.append(
+                            ctx.finding(
+                                "train-step-jit-audit",
+                                dec,
+                                f"train-step maker {qual!r} jits with no "
+                                "donate_argnums/static_* audit — donate the "
+                                "state (utils.platform.donation_argnums) or "
+                                "declare statics explicitly",
+                            )
+                        )
+            if jit_call is not None and not (
+                {kw.arg for kw in jit_call.keywords} & audit_kws
+            ):
+                out.append(
+                    ctx.finding(
+                        "train-step-jit-audit",
+                        jit_call,
+                        f"train-step maker {qual!r} jits with no "
+                        "donate_argnums/static_* audit — donate the state "
+                        "(utils.platform.donation_argnums) or declare statics "
+                        "explicitly",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 3. tracer-branch — Python control flow on traced values
+# ---------------------------------------------------------------------------
+
+
+def rule_tracer_branch(ctx: ModuleContext) -> list[Finding]:
+    """``if``/``while`` on a value produced by a jnp/jax op inside a traced
+    function raises TracerBoolConversionError at best and silently freezes a
+    branch at worst. Static Python flags (``if probes:`` bound before jit)
+    are NOT flagged — only tests referencing locals assigned from jnp/jax
+    calls, or containing such a call directly."""
+    out: list[Finding] = []
+    for fn in ctx.traced:
+        device_locals: set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and _mentions_jax_call(ctx, sub.value):
+                for t in sub.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            device_locals.add(n.id)
+        for sub in ast.walk(fn):
+            if not isinstance(sub, (ast.If, ast.While)):
+                continue
+            test = sub.test
+            bad = _mentions_jax_call(ctx, test) or any(
+                isinstance(n, ast.Name) and n.id in device_locals
+                for n in ast.walk(test)
+            )
+            if bad:
+                kind = "if" if isinstance(sub, ast.If) else "while"
+                out.append(
+                    ctx.finding(
+                        "tracer-branch",
+                        sub,
+                        f"Python `{kind}` on a traced value inside jit-reachable "
+                        f"{ctx.qualname(fn)!r} — use jnp.where/lax.cond/"
+                        "lax.while_loop (host branching cannot see device values)",
+                    )
+                )
+    return out
+
+
+def _mentions_jax_call(ctx: ModuleContext, node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            callee = ctx.canonical(sub.func)
+            if callee and (
+                callee.startswith("jax.numpy.")
+                or callee.startswith("jax.lax.")
+                or callee.startswith("jax.nn.")
+            ):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# 4. host-sync-hot-path — device->host syncs in step/request paths
+# ---------------------------------------------------------------------------
+
+
+def rule_host_sync_hot_path(ctx: ModuleContext) -> list[Finding]:
+    """``.item()`` / ``float()`` / ``np.asarray`` / ``jax.device_get`` inside
+    a traced step body breaks tracing outright; inside the serve request path
+    (project.HOT_HOST_FUNCS) each one is a dispatch stall that must be
+    deliberate — the audit is the point: intentional syncs carry a
+    suppression with the reason written next to them."""
+    out: list[Finding] = []
+    hot_host = project.HOT_HOST_FUNCS.get(ctx.path, ())
+    targets: list[tuple[ast.AST, str, str]] = []  # (fn, qual, kind)
+    for fn, qual in ctx.functions:
+        if fn in ctx.traced:
+            targets.append((fn, qual, "jit-reachable"))
+        elif qual in hot_host:
+            targets.append((fn, qual, "serve-request-path"))
+    for fn, qual, kind in targets:
+        nested = {
+            sub for sub in ast.walk(fn) if isinstance(sub, _FuncNode) and sub is not fn
+        }
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            if any(sub in ast.walk(n) for n in nested) and kind == "serve-request-path":
+                continue  # nested defs in host funcs judged on their own merits
+            label = None
+            callee = ctx.canonical(sub.func)
+            if isinstance(sub.func, ast.Attribute) and sub.func.attr in project.HOST_SYNC_ATTRS:
+                label = f".{sub.func.attr}()"
+            elif callee in ("numpy.asarray", "numpy.array"):
+                label = callee.replace("numpy", "np")
+            elif (
+                kind == "jit-reachable"  # float()/int() on host values in the
+                # serve request path is plain Python; on a tracer it breaks
+                # the trace — only the traced bodies get this check
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id in project.HOST_SYNC_NAMES
+                and sub.args
+                and not isinstance(sub.args[0], ast.Constant)
+            ):
+                label = f"{sub.func.id}()"
+            if label:
+                out.append(
+                    ctx.finding(
+                        "host-sync-hot-path",
+                        sub,
+                        f"host sync {label} in {kind} {qual!r} — a device->host "
+                        "transfer here stalls the dispatch pipeline (or breaks "
+                        "tracing); move it off the hot path or suppress with the "
+                        "reason the sync is deliberate",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 5. wall-clock-in-jit — time frozen into the traced program
+# ---------------------------------------------------------------------------
+
+
+def rule_wall_clock_in_jit(ctx: ModuleContext) -> list[Finding]:
+    """``time.time()``/``datetime.now()`` inside a traced function evaluates
+    ONCE at trace time and compiles to a constant — every later step reuses
+    the first step's timestamp. Timing belongs outside the step (StepClock)."""
+    out: list[Finding] = []
+    for fn in ctx.traced:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = ctx.canonical(sub.func)
+            if not callee:
+                continue
+            head, _, tail = callee.rpartition(".")
+            if tail in project.WALL_CLOCK_CALLS and head.split(".")[0] in (
+                "time",
+                "datetime",
+            ):
+                out.append(
+                    ctx.finding(
+                        "wall-clock-in-jit",
+                        sub,
+                        f"{callee}() inside jit-reachable {ctx.qualname(fn)!r} "
+                        "freezes to a trace-time constant — time the dispatch "
+                        "from the host (telemetry.StepClock)",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 6. primary-only-collective — multihost deadlock by is_primary guard
+# ---------------------------------------------------------------------------
+
+
+def rule_primary_only_collective(ctx: ModuleContext) -> list[Finding]:
+    """A collective (orbax save, psum, multihost broadcast) reached by the
+    primary process only: every other process never joins and the primary
+    blocks at the collective's barrier forever — the exact shape PR 3
+    review-hardened in the flight-recorder dump. Two forms: the collective
+    lexically inside ``if is_primary():``, and the early-return form
+    (``if not is_primary(): return`` followed by a collective)."""
+    out: list[Finding] = []
+
+    def is_primary_test(test: ast.AST) -> bool:
+        for sub in ast.walk(test):
+            name = dotted_name(sub.func) if isinstance(sub, ast.Call) else None
+            if name and name.rsplit(".", 1)[-1] in project.PRIMARY_GUARDS:
+                return True
+        return False
+
+    def collectives_in(node: ast.AST):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func)
+                if name and name.rsplit(".", 1)[-1] in project.COLLECTIVE_CALLS:
+                    yield sub, name
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.If) or not is_primary_test(node.test):
+            continue
+        # form 1: collective inside the guarded body (either branch)
+        for branch in (node.body, node.orelse):
+            for stmt in branch:
+                for call, name in collectives_in(stmt):
+                    out.append(
+                        ctx.finding(
+                            "primary-only-collective",
+                            call,
+                            f"collective {name!r} guarded by a primary-process "
+                            "check: non-primary processes never join and the "
+                            "primary deadlocks at the barrier — run the "
+                            "collective on ALL processes, guard only the "
+                            "host-side write",
+                        )
+                    )
+        # form 2: `if <primary test>: return/raise` then a collective later
+        body_exits = any(isinstance(s, (ast.Return, ast.Raise)) for s in node.body)
+        if not body_exits:
+            continue
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            continue
+        for call, name in collectives_in(fn):
+            if call.lineno > node.body[-1].lineno:
+                out.append(
+                    ctx.finding(
+                        "primary-only-collective",
+                        call,
+                        f"collective {name!r} after a primary-gated early "
+                        f"return (line {node.lineno}): non-primary processes "
+                        "exit before joining — move the collective above the "
+                        "guard (PR 3's flight-recorder fix)",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 7. serve-lock-discipline — thread-shared state touched outside its lock
+# ---------------------------------------------------------------------------
+
+
+def rule_serve_lock_discipline(ctx: ModuleContext) -> list[Finding]:
+    """The project lock map (analysis/project.py) names the serve-path
+    attributes that are shared across threads and the lock that owns each.
+    Any ``self.<attr>`` access outside ``with self.<lock>:`` (except in
+    ``__init__``, which happens-before sharing) is a data race of the shape
+    the PR-2 soak test caught hanging."""
+    lock_map = project.LOCK_MAP.get(ctx.path)
+    if not lock_map:
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef) or node.name not in lock_map:
+            continue
+        attr_locks = lock_map[node.name]
+        for fn_node in ast.walk(node):
+            if not isinstance(fn_node, _FuncNode) or fn_node.name == "__init__":
+                continue
+            for sub in ast.walk(fn_node):
+                if not (
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    and sub.attr in attr_locks
+                ):
+                    continue
+                lock = attr_locks[sub.attr]
+                if not _under_lock(ctx, sub, lock):
+                    out.append(
+                        ctx.finding(
+                            "serve-lock-discipline",
+                            sub,
+                            f"self.{sub.attr} accessed outside `with "
+                            f"self.{lock}:` in {node.name}.{fn_node.name} — "
+                            "thread-shared serve state must hold its lock "
+                            "(lock map: analysis/project.py)",
+                        )
+                    )
+    return out
+
+
+def _under_lock(ctx: ModuleContext, node: ast.AST, lock_attr: str) -> bool:
+    cur = ctx.parent.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                if (
+                    isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                    and expr.attr == lock_attr
+                ):
+                    return True
+        if isinstance(cur, _FuncNode):
+            return False
+        cur = ctx.parent.get(cur)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# 8. stranded-future — dequeue without guaranteed resolution
+# ---------------------------------------------------------------------------
+
+
+def rule_stranded_future(ctx: ModuleContext) -> list[Finding]:
+    """A function that pops requests off a queue AND resolves futures must
+    guarantee resolution on every exit path: an exception between the pop and
+    ``set_result`` strands the client forever (the PR-2 soak-test hang). The
+    check requires a ``try`` whose handler or ``finally`` resolves
+    (``set_result``/``set_exception``) in any function that both dequeues and
+    touches ``.future``."""
+    out: list[Finding] = []
+    for fn, qual in ctx.functions:
+        dequeues = [
+            sub
+            for sub in ast.walk(fn)
+            if isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in ("next_batch", "popleft", "get_nowait")
+        ]
+        if not dequeues:
+            continue
+        touches_future = any(
+            isinstance(sub, ast.Attribute) and sub.attr == "future"
+            for sub in ast.walk(fn)
+        )
+        if not touches_future:
+            continue
+        guarded = False
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Try):
+                continue
+            resolve_zones = list(sub.finalbody)
+            for h in sub.handlers:
+                resolve_zones.extend(h.body)
+            for stmt in resolve_zones:
+                for call in ast.walk(stmt):
+                    if (
+                        isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr in ("set_result", "set_exception")
+                    ):
+                        guarded = True
+        if not guarded:
+            out.append(
+                ctx.finding(
+                    "stranded-future",
+                    dequeues[0],
+                    f"{qual!r} dequeues requests and resolves futures with no "
+                    "try/except/finally that resolves on failure — an exception "
+                    "between the pop and set_result hangs the client forever",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 9. broad-except — typed errors silently swallowed
+# ---------------------------------------------------------------------------
+
+
+def rule_broad_except(ctx: ModuleContext) -> list[Finding]:
+    """``except:`` / ``except Exception`` / ``except BaseException`` swallow
+    the project's typed failures (DivergenceError carries the flight-recorder
+    dump; KeyboardInterrupt under BaseException kills ctrl-C). Handlers that
+    unconditionally re-raise (a bare ``raise`` anywhere in the handler) are
+    inspect-and-forward patterns and are not flagged."""
+    out: list[Finding] = []
+    broad = {"Exception", "BaseException"}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names: list[str] = []
+        if node.type is None:
+            names = ["(bare)"]
+        elif isinstance(node.type, ast.Name) and node.type.id in broad:
+            names = [node.type.id]
+        elif isinstance(node.type, ast.Tuple):
+            names = [e.id for e in node.type.elts if isinstance(e, ast.Name) and e.id in broad]
+        if not names:
+            continue
+        if any(isinstance(sub, ast.Raise) and sub.exc is None for sub in ast.walk(node)):
+            continue  # inspect-and-re-raise
+        swallows = ", ".join(project.TYPED_EXCEPTIONS)
+        if names == ["Exception"]:
+            swallows = project.TYPED_EXCEPTIONS[0]
+        out.append(
+            ctx.finding(
+                "broad-except",
+                node,
+                f"broad `except {names[0]}` can swallow typed {swallows} — "
+                "narrow to the exceptions this site expects, or suppress with "
+                "the reason the catch-all is load-bearing",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 10. import-time-jnp — device ops at module import
+# ---------------------------------------------------------------------------
+
+
+def rule_import_time_jnp(ctx: ModuleContext) -> list[Finding]:
+    """A ``jnp.`` op at module scope allocates device buffers (and may
+    initialize the backend) the moment anything imports the module — before
+    distributed init, before platform pinning, in processes (the bench
+    parent) that must never touch jax. Constants belong in numpy or inside
+    functions."""
+    out: list[Finding] = []
+    # walk the module but never descend into function/class bodies: what's
+    # left executes at import time (including top-level if/try/for blocks)
+    stack: list[ast.AST] = list(ctx.tree.body)
+    while stack:
+        stmt = stack.pop()
+        if isinstance(stmt, (*_FuncNode, ast.ClassDef)):
+            continue
+        for sub in ast.iter_child_nodes(stmt):
+            stack.append(sub)
+        if isinstance(stmt, ast.Call):
+            callee = ctx.canonical(stmt.func)
+            if callee and (
+                callee.startswith("jax.numpy.") or callee.startswith("jax.lax.")
+            ):
+                out.append(
+                    ctx.finding(
+                        "import-time-jnp",
+                        stmt,
+                        f"{callee} called at module import time — device "
+                        "allocation/backend init as an import side effect; "
+                        "build device constants inside the function that "
+                        "uses them",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
+    "jit-mutable-global": (
+        rule_jit_mutable_global,
+        "jitted code closing over module-level mutable state",
+    ),
+    "train-step-jit-audit": (
+        rule_train_step_jit_audit,
+        "train-step makers must declare donate_argnums/static_* intent",
+    ),
+    "tracer-branch": (
+        rule_tracer_branch,
+        "Python if/while on traced values inside jit-reachable code",
+    ),
+    "host-sync-hot-path": (
+        rule_host_sync_hot_path,
+        "device->host syncs inside train-step / serve-request paths",
+    ),
+    "wall-clock-in-jit": (
+        rule_wall_clock_in_jit,
+        "time.time()/datetime.now() frozen into traced programs",
+    ),
+    "primary-only-collective": (
+        rule_primary_only_collective,
+        "collectives guarded by is_primary (multihost deadlock)",
+    ),
+    "serve-lock-discipline": (
+        rule_serve_lock_discipline,
+        "thread-shared serve state touched outside its lock",
+    ),
+    "stranded-future": (
+        rule_stranded_future,
+        "queue pop without guaranteed future resolution on all exit paths",
+    ),
+    "broad-except": (
+        rule_broad_except,
+        "bare/broad except swallowing DivergenceError/KeyboardInterrupt",
+    ),
+    "import-time-jnp": (
+        rule_import_time_jnp,
+        "jnp ops at module import time",
+    ),
+    # "slow-marker" is data-driven (needs a --durations report) and lives in
+    # qdml_tpu.analysis.slowmarkers; the CLI folds it in when given the data.
+}
+
+
+def all_rules() -> list[Callable[[ModuleContext], list[Finding]]]:
+    return [fn for fn, _doc in RULES.values()]

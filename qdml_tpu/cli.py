@@ -17,8 +17,14 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli export-torch --out=DSTDIR  # orbax -> reference .pth
     python -m qdml_tpu.cli report --current=PATH[,..] --baseline=PATH
                                   [--threshold=PCT] [--out=FILE.md] [--json=FILE.json]
+                                  [--lint=LINT.json]
                                   # telemetry delta table (+ cost section,
                                   # machine-readable gate); exit 3 on regression
+                                  # (--lint folds a lint-gate row in too)
+    python -m qdml_tpu.cli lint   [--baseline] [--write-baseline] [--json=F]
+                                  [--durations=F] [--paths=...] [--list-rules]
+                                  # graftlint static analysis gate
+                                  # (docs/ANALYSIS.md); exit 1 on new findings
     python -m qdml_tpu.cli serve  [--serve.port=8377 ...]  # online inference:
                                   # restore ckpt, AOT-warm buckets, JSON/TCP loop
                                   # ({"op": "metrics"} returns live counters)
@@ -58,7 +64,7 @@ _COMMANDS = (
     "export-torch",
     "serve",
     "loadgen",
-)  # "report" dispatches before config parsing (no jax, no workdir)
+)  # "report" and "lint" dispatch before config parsing (no jax, no workdir)
 
 _PASSTHROUGH = (  # command args, not config overrides
     "--out=",
@@ -93,6 +99,12 @@ def main(argv: list[str] | None = None) -> int:
         from qdml_tpu.telemetry.report import report_main
 
         return report_main(argv[1:])
+    if argv[0] == "lint":
+        # Host-side tool over source files: no jax, no config parsing —
+        # exit code is the static-analysis gate (docs/ANALYSIS.md).
+        from qdml_tpu.analysis.cli import lint_main
+
+        return lint_main(argv[1:])
     # Make JAX_PLATFORMS=cpu actually select the CPU backend (the plugin
     # rewrites jax_platforms at interpreter start; qdml_tpu.utils.platform
     # is the single home for the workaround).
@@ -117,7 +129,7 @@ def main(argv: list[str] | None = None) -> int:
     # Run manifest + telemetry sink: the metrics stream opens with the
     # provenance header, and library-level spans/counters (train loops, eval
     # sweep) land in the same file.
-    from qdml_tpu.telemetry import run_manifest, set_sink
+    from qdml_tpu.telemetry import DivergenceError, run_manifest, set_sink
 
     logger = MetricsLogger(
         os.path.join(workdir, f"{cmd}.metrics.jsonl"),
@@ -342,15 +354,13 @@ def main(argv: list[str] | None = None) -> int:
         # reference prints total minutes (Runner...py:437-440)
         print(f"total time: {(time.time() - t0) / 60.0:.2f} min")
         return 0
-    except Exception as e:
+    except DivergenceError as e:
         # divergence watchdog trips arrive as typed errors carrying the
-        # flight-recorder dump path — surface the pointer, not a traceback
-        from qdml_tpu.telemetry import DivergenceError
-
-        if isinstance(e, DivergenceError):
-            print(f"DIVERGED: {e}")
-            return 4
-        raise
+        # flight-recorder dump path — surface the pointer, not a traceback;
+        # everything else propagates untouched (narrowed from a broad
+        # isinstance-and-reraise, graftlint broad-except)
+        print(f"DIVERGED: {e}")
+        return 4
     finally:
         # always detach the global sink and close the stream — an exception
         # mid-command (or an in-process caller) must not leave later spans

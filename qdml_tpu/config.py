@@ -170,6 +170,15 @@ class TrainConfig:
     # (results/<name>/flightrec/) instead of a silently garbage run.
     watchdog: bool = True
     watchdog_grad_norm_max: float = 0.0
+    # Runtime numerics sanitizer (telemetry/sanitizer.py, docs/ANALYSIS.md):
+    # thread jax.experimental.checkify (NaN/Inf, div-by-zero, index-OOB
+    # checks) through the train step. OFF (default) never wraps — the traced
+    # program is byte-identical to the unflagged build (zero extra compiles,
+    # pinned in tests, the probe_every=0 static-flag pattern); ON adds one
+    # error fetch per host-visible step and surfaces trips through the
+    # flight-recorder dump + typed DivergenceError path. Debugging mode:
+    # forces per-step dispatch (scan_steps is ignored with a warning).
+    checkify: bool = False
     seed: int = 0
     workdir: str = "workspace"   # checkpoint root (reference ./workspace/Pn_128/HDCE)
     resume: bool = False         # reference cannot resume; we can
@@ -216,6 +225,12 @@ class ServeConfig:
     # dispatch. Each worker keeps its own ServeMetrics; snapshots merge them
     # (telemetry Histogram.merge), so quantiles aggregate exactly.
     workers: int = 1
+    # Runtime numerics sanitizer for the fused serving forward (the serve
+    # twin of train.checkify): warmup AOT-compiles the checkified program per
+    # bucket; a tripped check raises typed DivergenceError from infer(),
+    # which the serve loop forwards into every affected request future. OFF
+    # (default) compiles exactly today's program — zero extra compiles.
+    checkify: bool = False
     # Local socket endpoint for `qdml-tpu serve`.
     host: str = "127.0.0.1"
     port: int = 8377

@@ -425,7 +425,7 @@ class NpyGridLoader:
                         break
                     except queue.Full:
                         continue
-            except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+            except BaseException as e:  # lint: disable=broad-except(producer-thread failures (incl. KeyboardInterrupt) are forwarded through the queue and re-raised on the consumer)
                 while not stop.is_set():
                     try:
                         q.put((_ERR, e), timeout=0.1)

@@ -62,7 +62,7 @@ def _check_p(p) -> None:
     check is the real gate; a value already traced by an enclosing jit is
     unverifiable here and passes through."""
     try:
-        pv = float(p)
+        pv = float(p)  # lint: disable=host-sync-hot-path(eager concrete-value guard — traced values deliberately pass through (see docstring))
     except (jax.errors.ConcretizationTypeError, TypeError):
         return
     if not 0.0 <= pv <= 1.0:  # also rejects nan

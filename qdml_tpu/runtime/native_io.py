@@ -191,7 +191,7 @@ class NativeNpyFile:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: disable=broad-except(__del__ at interpreter shutdown — module globals may already be torn down)
             pass
 
 
@@ -308,5 +308,5 @@ class PrefetchPipeline:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: disable=broad-except(__del__ at interpreter shutdown — module globals may already be torn down)
             pass

@@ -84,7 +84,8 @@ class MicroBatcher:
 
     @property
     def depth(self) -> int:
-        return len(self._q)
+        with self._lock:  # deque len is GIL-atomic today, but the lock map
+            return len(self._q)  # makes the discipline checkable, not lucky
 
     def submit(self, req: Request, now: float | None = None) -> Overloaded | None:
         """Admit ``req``; returns an :class:`Overloaded` (and does NOT enqueue)

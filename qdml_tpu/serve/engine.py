@@ -82,6 +82,9 @@ class ServeEngine:
         self._hdce_vars = jax.tree.map(jnp.asarray, hdce_vars)
         self._clf_vars = jax.tree.map(jnp.asarray, clf_vars)
         self._compiled: dict[int, Any] = {}
+        # serve.checkify: the buckets hold checkified executables returning
+        # (err, (h, pred)); infer() raises typed DivergenceError on a trip
+        self._checkify = bool(cfg.serve.checkify)
         self._warm = False
         self._stats0: dict = {}
         # per-bucket XLA cost records (flops/bytes/peak memory/roofline),
@@ -165,6 +168,18 @@ class ServeEngine:
         """
         enable_compile_cache()
         pre = compile_cache_stats()
+        # serve.checkify: AOT-compile the checkified forward instead — same
+        # buckets, same gate; the error value is functionalized into the
+        # program, so the request path still never compiles. OFF compiles
+        # exactly the unwrapped program (byte-identical to the unflagged
+        # build; pinned in tests/test_analysis.py).
+        fwd = self._forward
+        if self._checkify:
+            from jax.experimental import checkify as _checkify
+
+            from qdml_tpu.telemetry.sanitizer import checks
+
+            fwd = _checkify.checkify(self._forward, errors=checks())
         var_specs = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (self._hdce_vars, self._clf_vars),
@@ -173,12 +188,13 @@ class ServeEngine:
         for b in self.buckets:
             with span("serve_warmup_bucket", bucket=b):
                 x_spec = jax.ShapeDtypeStruct((b, *hw, 2), jnp.float32)
-                compiled = jax.jit(self._forward).lower(*var_specs, x_spec).compile()
+                compiled = jax.jit(fwd).lower(*var_specs, x_spec).compile()
                 # first execute outside the request path (XLA may lazily
                 # finalize; also faults in the params transfer)
-                h, pred = compiled(
+                out = compiled(
                     self._hdce_vars, self._clf_vars, np.zeros((b, *hw, 2), np.float32)
                 )
+                h, pred = out[1] if self._checkify else out
                 jax.block_until_ready((h, pred))
                 self._compiled[b] = compiled
                 # XLA cost accounting straight off the AOT executable (the
@@ -237,9 +253,27 @@ class ServeEngine:
         b = pick_bucket(n, self.buckets)
         xp = np.zeros((b, *x.shape[1:]), np.float32)
         xp[:n] = x
-        h, pred = self._compiled[b](self._hdce_vars, self._clf_vars, xp)
+        out = self._compiled[b](self._hdce_vars, self._clf_vars, xp)
+        if self._checkify:
+            err, (h, pred) = out
+            # per-batch device->host error fetch: the sanitizer's contract
+            # (out of host-sync-hot-path's sight — `.get` is far too generic
+            # an attribute to track; the rule audits the unconditional syncs)
+            msg = err.get()
+            if msg:
+                from qdml_tpu.telemetry import DivergenceError
+
+                # typed failure into the serve loop's batch guard: every
+                # affected request future gets the exception, nothing hangs
+                raise DivergenceError(
+                    f"serve checkify tripped on bucket {b}: {msg.splitlines()[0]}",
+                    None,
+                    "checkify",
+                )
+        else:
+            h, pred = out
         return (
-            np.asarray(jax.device_get(h))[:n],
-            np.asarray(jax.device_get(pred))[:n],
+            np.asarray(jax.device_get(h))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
+            np.asarray(jax.device_get(pred))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
             b,
         )

@@ -139,7 +139,8 @@ class ServeLoop:
             for i in range(self.workers)
         ]
         self._started = True
-        self._live_workers = len(self._threads)
+        with self._exit_lock:  # workers read this under the same lock on exit
+            self._live_workers = len(self._threads)
         for t in self._threads:
             t.start()
         return self

@@ -33,7 +33,7 @@ def is_primary() -> bool:
         return True
     try:
         return jax.process_index() == 0
-    except Exception:
+    except Exception:  # lint: disable=broad-except(process_index before distributed init — single process acts as primary)
         return True
 
 

@@ -62,7 +62,7 @@ def detect_platform() -> str:
     if jax is not None:
         try:
             backend = jax.default_backend()
-        except Exception:  # noqa: BLE001
+        except Exception:  # lint: disable=broad-except(backend probe is provenance only; no backend reads as unknown)
             backend = None
     if backend in ("cpu", "gpu") or backend is None:
         return backend or "unknown"
@@ -98,7 +98,7 @@ def analyze(executable: Any, platform: str | None = None) -> dict:
             bytes_accessed = float(b) if isinstance(b, (int, float)) else None
         else:
             reason = "cost_analysis() returned no properties"
-    except Exception as e:  # noqa: BLE001 — backend-dependent API
+    except Exception as e:  # lint: disable=broad-except(backend-dependent API — degrades to available:false by design (monkeypatch-tested))
         reason = f"cost_analysis failed: {type(e).__name__}: {e}"
     mem: dict[str, int] = {}
     memory_analysis = getattr(executable, "memory_analysis", None)
@@ -115,7 +115,7 @@ def analyze(executable: Any, platform: str | None = None) -> dict:
                     v = getattr(m, field, None)
                     if isinstance(v, int):
                         mem[key] = v
-        except Exception:  # noqa: BLE001 — memory stats are a bonus
+        except Exception:  # lint: disable=broad-except(memory stats are a bonus on backends that expose them)
             pass
     if flops is None and bytes_accessed is None and not mem:
         return {
@@ -154,7 +154,7 @@ def analyze_jit(jitted: Any, *args, platform: str | None = None, **kwargs) -> di
     still performs the one and only compile. Never raises."""
     try:
         lowered = jitted.lower(*args, **kwargs)
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # lint: disable=broad-except(cost accounting must never kill the run it measures)
         return {
             "available": False,
             "reason": f"lowering failed: {type(e).__name__}: {e}",

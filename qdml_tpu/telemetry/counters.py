@@ -78,17 +78,17 @@ def device_memory_snapshot() -> dict | None:
     out: dict = {"devices": []}
     try:
         out["live_arrays"] = len(jax.live_arrays())
-    except Exception:
+    except Exception:  # lint: disable=broad-except(live_arrays is backend-dependent diagnostics — never load-bearing)
         pass
     try:
         devs = jax.local_devices()
-    except Exception:
+    except Exception:  # lint: disable=broad-except(no device enumeration means host-only counters)
         return out
     for d in devs:
         ent: dict = {"id": d.id, "kind": getattr(d, "device_kind", "?")}
         try:
             stats = d.memory_stats()
-        except Exception:
+        except Exception:  # lint: disable=broad-except(per-device memory_stats is unsupported on some backends)
             stats = None
         if stats:
             for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):

@@ -266,11 +266,34 @@ class FlightRecorder:
         chunk / member vector); ``params``/``batch_info``/``rng`` feed the
         last-good snapshot and the post-mortem bundle.
         """
-        if not self.enabled:
+        has_checkify = isinstance(metrics, Mapping) and "checkify_err" in metrics
+        if not self.enabled and not has_checkify:
             return
         import jax
 
         self._n += 1
+        if has_checkify:
+            # runtime sanitizer (train.checkify): the step's checkify error
+            # rides the metrics dict; fetching it is the mode's one
+            # per-step host sync. A tripped check is a divergence with an
+            # op-precise reason — same dump, same typed error as the
+            # watchdog's aggregate NaN trips.
+            from qdml_tpu.telemetry.sanitizer import error_message
+
+            msg = error_message(metrics["checkify_err"])
+            if msg is not None:
+                reason = f"checkify: {msg.splitlines()[0]}"
+                dump_dir = self.dump(
+                    reason, epoch, batch_info=batch_info, rng=rng, loss=loss,
+                    metrics=metrics,
+                )
+                raise DivergenceError(
+                    f"{self.name} tripped a checkify check at step {self._n} "
+                    f"(epoch {epoch}): {reason}"
+                    + (f" — flight-recorder dump: {dump_dir}" if dump_dir else ""),
+                    dump_dir,
+                    reason,
+                )
         probe_host = None
         probe = metrics.get("probe") if isinstance(metrics, Mapping) else None
         if (
@@ -340,7 +363,7 @@ class FlightRecorder:
                     import jax
 
                     probe_host = jax.device_get(metrics["probe"])
-                except Exception:  # noqa: BLE001 — donated/poisoned buffers
+                except Exception:  # lint: disable=broad-except(post-mortem fetch of possibly donated/poisoned buffers — the bundle ships without the probe)
                     probe_host = None
             last_good_meta = None
             if self._last_good is not None:
@@ -388,6 +411,6 @@ class FlightRecorder:
                     dump_dir=dump_dir,
                 )
             return dump_dir
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # lint: disable=broad-except(a failing dump must not mask the DivergenceError about to be raised)
             print(f"[flightrec] dump failed: {type(e).__name__}: {e}", flush=True)
             return None

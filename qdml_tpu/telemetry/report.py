@@ -199,10 +199,33 @@ def _cost_deltas(base_cost: dict, cur_cost: dict) -> dict | None:
 PROGRAM_CHANGE_PCT = 1.0
 
 
+def _lint_gate(lint_path: str | None) -> dict | None:
+    """Row data from a ``qdml-tpu lint --json`` artifact. The lint gate is
+    host-side static analysis: platform disarm rules never apply to it."""
+    if lint_path is None:
+        return None
+    try:
+        with open(lint_path) as fh:
+            lint = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"path": lint_path, "ok": False, "new_findings": None,
+                "error": f"{type(e).__name__}: {e}"}
+    return {
+        "path": lint_path,
+        "ok": bool(lint.get("ok")) and int(lint.get("new_findings") or 0) == 0,
+        "new_findings": int(lint.get("new_findings") or 0),
+        "suppressed": lint.get("suppressed"),
+        "baselined": lint.get("baselined"),
+        "per_rule": lint.get("per_rule") or {},
+        "error": None,
+    }
+
+
 def build_report_data(
     current_paths: list[str],
     baseline_path: str,
     threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+    lint_path: str | None = None,
 ) -> dict:
     """Full machine-readable report: markdown + per-gate rows + cost deltas.
 
@@ -244,6 +267,38 @@ def build_report_data(
 
     regressions: list[dict] = []
     gate_armed = True
+
+    # Lint gate (qdml-tpu lint --json artifact): folded in alongside the perf
+    # gates so CI reads ONE exit code. Static analysis is host-side — the
+    # platform-mismatch disarm below never applies to this row, and a lint
+    # failure alone forces the regression exit code (report_main).
+    lint = _lint_gate(lint_path)
+    if lint is not None:
+        if lint["error"]:
+            status, detail = "regression", f"unreadable lint artifact: {lint['error']}"
+        elif lint["ok"]:
+            status = "ok"
+            detail = (
+                f"0 new findings ({lint['suppressed']} suppressed, "
+                f"{lint['baselined']} baselined)"
+            )
+        else:
+            status = "regression"
+            per_rule = ", ".join(f"{k}: {v}" for k, v in lint["per_rule"].items())
+            detail = f"{lint['new_findings']} new finding(s) — {per_rule or 'see artifact'}"
+        gates.append(
+            {"metric": "lint.new_findings", "kind": "lint",
+             "baseline": 0, "current": lint["new_findings"],
+             "delta_pct": None, "status": status}
+        )
+        lines.append(f"- lint gate (`{lint['path']}`): **{status}** — {detail}")
+        lines.append("")
+        if status == "regression":
+            regressions.append(
+                {"metric": "lint.new_findings", "baseline": 0,
+                 "current": lint["new_findings"], "delta_pct": None}
+            )
+
     if len(set(cur_platforms)) > 1:
         gate_armed = False
         disarm_reason = (
@@ -281,6 +336,10 @@ def build_report_data(
             "gates": gates,
             "regressions": regressions,
             "cost": cost_rows,
+            "lint": lint,
+            # lint failures force the regression exit even when the perf gate
+            # is platform-disarmed: static analysis ran on THIS host's source
+            "lint_failed": bool(lint is not None and not lint["ok"]),
             "note": note,
             "markdown": "\n".join(lines),
         }
@@ -524,11 +583,14 @@ def report_main(argv: list[str]) -> int:
     threshold = DEFAULT_THRESHOLD_PCT
     out: str | None = None
     json_out: str | None = None
+    lint_path: str | None = None
     for arg in argv:
         if arg.startswith("--current="):
             currents += [p for p in arg.split("=", 1)[1].split(",") if p]
         elif arg.startswith("--baseline="):
             baseline = arg.split("=", 1)[1]
+        elif arg.startswith("--lint="):
+            lint_path = arg.split("=", 1)[1]
         elif arg.startswith("--threshold="):
             raw = arg.split("=", 1)[1]
             try:
@@ -546,17 +608,22 @@ def report_main(argv: list[str]) -> int:
     if not currents or baseline is None:
         print(
             "usage: qdml-tpu report --current=PATH[,PATH...] --baseline=PATH "
-            "[--threshold=PCT] [--out=FILE.md] [--json=FILE.json]"
+            "[--threshold=PCT] [--out=FILE.md] [--json=FILE.json] "
+            "[--lint=LINT.json]"
         )
         return EXIT_USAGE
     for p in currents + [baseline]:
         if not os.path.exists(p):
             print(f"report: no such file {p!r}")
             return EXIT_USAGE
-    data = build_report_data(currents, baseline, threshold)
+    data = build_report_data(currents, baseline, threshold, lint_path=lint_path)
     md = data["markdown"]
     print(md)
-    rc = EXIT_REGRESSION if (data["regressions"] and data["gate_armed"]) else EXIT_OK
+    rc = (
+        EXIT_REGRESSION
+        if ((data["regressions"] and data["gate_armed"]) or data["lint_failed"])
+        else EXIT_OK
+    )
     if out:
         os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
         with open(out, "w") as fh:

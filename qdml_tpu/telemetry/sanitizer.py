@@ -1,0 +1,146 @@
+"""Runtime numerics sanitizer: ``jax.experimental.checkify`` wiring.
+
+The static side of PR 4 (graftlint, :mod:`qdml_tpu.analysis`) catches hazard
+*shapes*; this module catches hazard *values*: division by zero, NaN/Inf
+production, and out-of-bounds indexing INSIDE the compiled programs, at the
+op where they happen — where the flight recorder's probes only see the
+aggregate damage a step later.
+
+Opt-in by config flag, mirroring the ``probe_every=0`` static-flag pattern:
+
+- ``--train.checkify=true`` threads checkify through the four train-step
+  makers (``train/hdce.py``, ``train/dce.py``, ``train/qsc.py``,
+  ``train/nat_sweep.py``). The checkified step returns its error value in
+  the metrics dict (``checkify_err``); the :class:`~qdml_tpu.telemetry.
+  numerics.FlightRecorder` promotes a tripped check into the existing
+  dump-and-raise path — same post-mortem bundle, same typed
+  :class:`~qdml_tpu.telemetry.numerics.DivergenceError`, same CLI exit 4.
+- ``--serve.checkify=true`` wraps the serve engine's fused forward; a
+  tripped check raises ``DivergenceError`` from ``infer``, which the serve
+  loop forwards into every affected request future (typed failure, no hang).
+
+OFF (the default) is free by construction: the flag never wraps, so the
+traced program is byte-identical to the unflagged build — pinned by
+``tests/test_analysis.py`` against the ``utils/compile_cache`` counters.
+ON costs one functionalized error value through the program plus one
+device->host error fetch per host-visible step (train) / batch (serve);
+checkify's added checks also inhibit some fusions, so it is a debugging
+mode, never the production default.
+
+Scan-fused dispatch (``train.scan_steps > 1``) falls back to per-step
+dispatch under checkify (``train/scan.py::scan_eligible``): the per-step
+error fetch is the point of the mode, and a K-step fused program would
+aggregate K steps' checks into one opaque trip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_COMPAT_DONE = False
+
+
+def _ensure_checkify_compat() -> None:
+    """Backfill the checkify scatter-OOB rule for batched scatters.
+
+    This container's jax (0.4.37) lowers ``take_along_axis`` (the NLL loss's
+    log-prob pick, ``models/losses.py``) to a gather with
+    ``operand_batching_dims``; its gradient is the matching batched
+    scatter-add. ``checkify``'s ``scatter_oob`` predates batching dims:
+    operand dims that are batching dims are neither inserted-window nor
+    update-window dims, so ``update_window_dims[pos]`` indexes past the end
+    — ``IndexError: tuple index out of range`` at trace time the moment
+    index checks are enabled on any classifier train step (caught by driving
+    ``train-sc --train.checkify=true`` on the real backend). The fix is the
+    upstream one: batching dims take slice size 1, exactly like inserted
+    window dims. Structurally gated (source probe), idempotent, and a no-op
+    on jax versions that already handle batching dims — the same
+    backfill-and-degrade contract as ``utils.platform.ensure_jax_compat``.
+    """
+    global _COMPAT_DONE
+    if _COMPAT_DONE:
+        return
+    _COMPAT_DONE = True
+    try:
+        import inspect
+
+        import numpy as np
+        from jax import lax
+        import jax.numpy as jnp
+        from jax._src import checkify as _ck
+
+        if "batching" in inspect.getsource(_ck.scatter_oob):
+            return  # this jax already handles batched scatters
+
+        def scatter_oob(operand, indices, updates, dnums):
+            batching = getattr(dnums, "operand_batching_dims", ())
+            slice_sizes = []
+            pos = 0
+            for i in range(len(operand.shape)):
+                if i in dnums.inserted_window_dims or i in batching:
+                    slice_sizes.append(1)
+                else:
+                    slice_sizes.append(updates.shape[dnums.update_window_dims[pos]])
+                    pos += 1
+
+            upper_bound = np.array(  # lint: disable=host-sync-hot-path(static-shape bounds built host-side at trace time — the upstream rule's own implementation)
+                [operand.shape[i] - slice_sizes[i]
+                 for i in dnums.scatter_dims_to_operand_dims],
+                np.int64,
+            )
+            upper_bound = np.minimum(upper_bound, np.iinfo(indices.dtype).max)
+            upper_bound = lax.broadcast_in_dim(
+                upper_bound, indices.shape, (len(indices.shape) - 1,)
+            )
+            lower_oob = jnp.less(indices, 0)
+            upper_oob = jnp.greater(indices, upper_bound.astype(indices.dtype))
+            oob_mask = jnp.logical_or(lower_oob, upper_oob)
+            payload = _ck.oob_payload(
+                oob_mask, indices, dnums.scatter_dims_to_operand_dims, operand.shape
+            )
+            return jnp.any(oob_mask), payload
+
+        _ck.scatter_oob = scatter_oob
+    except Exception:  # lint: disable=broad-except(compat shim — a moved private API leaves checkify exactly as shipped)
+        pass
+
+
+def checks():
+    """The error set: float (NaN/Inf), index OOB, and div-by-zero checks —
+    the three silent-garbage classes QuantumNAT noise injection and
+    statevector normalization can produce."""
+    from jax.experimental import checkify
+
+    _ensure_checkify_compat()
+    return checkify.float_checks | checkify.index_checks | checkify.div_checks
+
+
+def error_message(err: Any) -> str | None:
+    """First tripped check's message, or None when the step was clean.
+    HOST SYNC: fetches the error flag — callers pay this once per
+    host-visible step, which is the cost of turning the sanitizer on."""
+    msg = err.get()
+    return msg if msg else None
+
+
+def checkify_step(step_fn: Callable, donate: tuple[int, ...] = ()) -> Callable:
+    """Wrap a traceable train step so its checkify error rides the metrics.
+
+    ``step_fn(*args) -> (*state_parts, metrics_dict)`` (the convention all
+    four trainers follow: the metrics dict is the LAST element). The wrapped
+    callable has the identical signature and return shape, with
+    ``metrics["checkify_err"]`` added — so the train loops and the flight
+    recorder need no per-trainer plumbing. ``donate`` follows the same
+    argument indices as the unwrapped jit (checkify preserves the
+    signature)."""
+    import jax
+    from jax.experimental import checkify
+
+    checked = checkify.checkify(step_fn, errors=checks())
+    jitted = jax.jit(checked, donate_argnums=donate)
+
+    def step(*args):
+        err, out = jitted(*args)
+        return (*out[:-1], {**out[-1], "checkify_err": err})
+
+    return step

@@ -51,7 +51,7 @@ def _process_index() -> int | None:
         return None
     try:
         return jax.process_index()
-    except Exception:
+    except Exception:  # lint: disable=broad-except(process_index before distributed init — spans then carry no index)
         return None
 
 
@@ -66,7 +66,7 @@ def span(name: str, sink=None, **tags) -> Iterator[None]:
     if jax is not None:
         try:
             bridge = jax.profiler.TraceAnnotation(name)
-        except Exception:
+        except Exception:  # lint: disable=broad-except(the profiler bridge is optional; spans must work without an active trace)
             pass
     t_wall = time.time()
     t0 = time.perf_counter()

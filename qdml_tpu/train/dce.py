@@ -70,8 +70,20 @@ def _dce_step(
     return state, m
 
 
-def make_dce_train_step(model: DCEP128, probes: bool = True) -> Callable:
+def make_dce_train_step(
+    model: DCEP128, probes: bool = True, checkify_errors: bool = False
+) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
+
+    if checkify_errors:
+        # runtime sanitizer (train.checkify): same signature/returns, with
+        # the checkify error riding the metrics dict for the flight recorder
+        from qdml_tpu.telemetry.sanitizer import checkify_step
+
+        return checkify_step(
+            partial(_dce_step, model, probes=probes),
+            donate=donation_argnums(0),
+        )
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
@@ -136,7 +148,9 @@ def train_dce(
     val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
     model, state = init_dce_state(cfg, train_loader.steps_per_epoch)
     probes_on = cfg.train.probe_every > 0  # 0 compiles the probes out
-    train_step = make_dce_train_step(model, probes=probes_on)
+    train_step = make_dce_train_step(
+        model, probes=probes_on, checkify_errors=cfg.train.checkify
+    )
     eval_step = make_dce_eval_step(model)
 
     start_epoch = 0

@@ -129,8 +129,20 @@ def _fused_step(
     return state, m
 
 
-def make_hdce_train_step(model: HDCE, tx, probes: bool = True) -> Callable:
+def make_hdce_train_step(
+    model: HDCE, tx, probes: bool = True, checkify_errors: bool = False
+) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
+
+    if checkify_errors:
+        # runtime sanitizer (train.checkify): same signature/returns, with
+        # the checkify error riding the metrics dict for the flight recorder
+        from qdml_tpu.telemetry.sanitizer import checkify_step
+
+        return checkify_step(
+            partial(_fused_step, model, probes=probes),
+            donate=donation_argnums(0),
+        )
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
@@ -221,7 +233,9 @@ def train_hdce(
     # probe_every=0 compiles the numerics probes OUT of the step program
     # (static flag); the watchdog's loss checks don't need them
     probes_on = cfg.train.probe_every > 0
-    train_step = make_hdce_train_step(model, state.tx, probes=probes_on)
+    train_step = make_hdce_train_step(
+        model, state.tx, probes=probes_on, checkify_errors=cfg.train.checkify
+    )
     eval_step = make_hdce_eval_step(model)
 
     start_epoch = 0

@@ -106,23 +106,32 @@ def _make_vstep(model: QSCP128, tx, probes: bool = True) -> Callable:
     return jax.vmap(member_step, in_axes=(0, 0, 0, 0, None, None))
 
 
-def make_sweep_train_step(model: QSCP128, tx, probes: bool = True) -> Callable:
+def make_sweep_train_step(
+    model: QSCP128, tx, probes: bool = True, checkify_errors: bool = False
+) -> Callable:
     """jit(vmap(member step)): (E-stacked params/opt/rng/sigma, shared batch)
     -> ``(params, opt_state, metrics)`` with per-member ``loss``/``probe``
-    leaves in the metrics dict."""
+    leaves in the metrics dict. ``checkify_errors`` wraps the whole vmapped
+    ensemble step in the runtime sanitizer — ANY member tripping a check
+    trips the error (the same any-member-poisons-the-dispatch semantics as
+    the watchdog)."""
     vstep = _make_vstep(model, tx, probes=probes)
 
     from functools import partial
 
     from qdml_tpu.utils.platform import donation_argnums
 
-    @partial(jax.jit, donate_argnums=donation_argnums(0, 1))
-    def step(params, opt_state, rngs, sigmas, batch):
+    def step_fn(params, opt_state, rngs, sigmas, batch):
         x = batch["yp_img"].reshape(-1, *batch["yp_img"].shape[3:])
         labels = batch["indicator"].reshape(-1)
         return vstep(params, opt_state, rngs, sigmas, x, labels)
 
-    return step
+    if checkify_errors:
+        from qdml_tpu.telemetry.sanitizer import checkify_step
+
+        return checkify_step(step_fn, donate=donation_argnums(0, 1))
+
+    return partial(jax.jit, donate_argnums=donation_argnums(0, 1))(step_fn)
 
 
 def make_sweep_scan_steps(
@@ -196,7 +205,9 @@ def train_nat_sweep(
         cfg, noise_levels, train_loader.steps_per_epoch
     )
     probes_on = cfg.train.probe_every > 0  # 0 compiles the probes out
-    train_step = make_sweep_train_step(model, tx, probes=probes_on)
+    train_step = make_sweep_train_step(
+        model, tx, probes=probes_on, checkify_errors=cfg.train.checkify
+    )
     eval_step = make_sweep_eval_step(model)
     n_members = len(noise_levels)
     # Same architecture-fact record the QSC trainer writes (train/qsc.py):

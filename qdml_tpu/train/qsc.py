@@ -86,8 +86,23 @@ def _sc_step(
     return state, m
 
 
-def make_sc_train_step(model: nn.Module, needs_rng: bool, probes: bool = True) -> Callable:
+def make_sc_train_step(
+    model: nn.Module,
+    needs_rng: bool,
+    probes: bool = True,
+    checkify_errors: bool = False,
+) -> Callable:
     from qdml_tpu.utils.platform import donation_argnums
+
+    if checkify_errors:
+        # runtime sanitizer (train.checkify): same signature/returns, with
+        # the checkify error riding the metrics dict for the flight recorder
+        from qdml_tpu.telemetry.sanitizer import checkify_step
+
+        return checkify_step(
+            partial(_sc_step, model, needs_rng, probes=probes),
+            donate=donation_argnums(0),
+        )
 
     @partial(jax.jit, donate_argnums=donation_argnums(0))
     def step(state: TrainState, batch: dict, rng: jax.Array):
@@ -169,7 +184,9 @@ def train_classifier(
     model, state = init_sc_state(cfg, quantum, train_loader.steps_per_epoch)
     needs_rng = quantum and cfg.quantum.use_quantumnat
     probes_on = cfg.train.probe_every > 0  # 0 compiles the probes out
-    train_step = make_sc_train_step(model, needs_rng, probes=probes_on)
+    train_step = make_sc_train_step(
+        model, needs_rng, probes=probes_on, checkify_errors=cfg.train.checkify
+    )
     eval_step = make_sc_eval_step(model)
     tag = "qsc" if quantum else "sc"
 

@@ -116,6 +116,14 @@ def scan_eligible(cfg, mesh, loader, logger) -> bool:
     path; logs the fallback when scan_steps was requested but ineligible."""
     if cfg.train.scan_steps <= 1:
         return False
+    if cfg.train.checkify:
+        # the sanitizer's contract is a per-step error fetch; a K-step fused
+        # program would aggregate K steps' checks into one opaque trip
+        logger.log(
+            warning=f"scan_steps={cfg.train.scan_steps} ignored: "
+            "train.checkify forces per-step dispatch"
+        )
+        return False
     if mesh is None:
         return True
     if jax.process_count() == 1 and loader.batch_size % mesh.shape["data"] == 0:

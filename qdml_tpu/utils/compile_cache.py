@@ -64,7 +64,7 @@ def _install_listener() -> None:
 
         monitoring.register_event_listener(_on_event)
         _LISTENING = True
-    except Exception:
+    except Exception:  # lint: disable=broad-except(jax.monitoring moved or absent — the cache still works; counters stay 0)
         # jax.monitoring moved/absent: the cache still works, counters stay 0.
         pass
 

@@ -31,7 +31,7 @@ def backend_initialized() -> bool:
         from jax._src import xla_bridge
 
         return bool(xla_bridge._backends)
-    except Exception:
+    except Exception:  # lint: disable=broad-except(private-API probe — moved API reads as not-initialized; callers fail loudly later)
         # Private API moved: report "not initialized" so callers still
         # attempt the pin. The site hook pre-imports jax in every process, so
         # any sys.modules-based fallback would be always-True and turn
@@ -76,7 +76,7 @@ def ensure_jax_compat() -> None:
     try:
         from jax._src.interpreters import ad, batching
         from jax._src.lax.lax import optimization_barrier_p as p
-    except Exception:
+    except Exception:  # lint: disable=broad-except(compat shim for absent private APIs — nothing to patch means nothing to do)
         return
     try:
         if p not in batching.primitive_batchers:
@@ -98,7 +98,7 @@ def ensure_jax_compat() -> None:
                 return p.bind(*[ad.instantiate_zeros(ct) for ct in cts])
 
             ad.primitive_transposes[p] = _transpose_rule
-    except Exception:
+    except Exception:  # lint: disable=broad-except(best-effort compat registration; newer jax works unpatched)
         pass
 
 

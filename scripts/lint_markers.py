@@ -1,18 +1,11 @@
 #!/usr/bin/env python
-"""Slow-marker lint: every test over the wall-clock threshold in a sample run
-must carry ``@pytest.mark.slow`` — or be explicitly grandfathered.
+"""Slow-marker lint — thin shim over the graftlint engine.
 
-The tier-1 suite has a hard wall budget (ROADMAP.md: 870 s); tests that creep
-past a few seconds each are how a suite silently eats it. This linter closes
-the loop: feed it a ``--durations=0`` report from a real run and it checks
-that every offender either carries the ``slow`` marker (deselected from
-tier-1) or appears in the committed allowlist with a reason.
-
-The allowlist exists because "slow" is not the same as "optional": the
-XLA-compile-dominated training e2e tests exceed any per-test threshold on the
-1-core builder host yet ARE the tier-1 acceptance coverage — marking them
-``slow`` would deselect the gate itself. New offenders outside that committed
-set fail the lint, so unbudgeted slowness cannot land silently.
+The logic moved to :mod:`qdml_tpu.analysis.slowmarkers` (PR 4) so the repo
+has ONE lint entry point: ``qdml-tpu lint --durations=FILE`` runs the same
+check as part of the full static-analysis gate. This script keeps the
+original standalone CLI (same flags, same exit codes) for existing callers
+and docs.
 
 Usage:
     pytest tests/ -q -m 'not slow' --durations=0 > /tmp/durations.log
@@ -23,92 +16,19 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import ast
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-# "12.34s call     tests/test_x.py::test_y[param]" — only the call phase
-# counts (setup/teardown time belongs to fixtures, which the marker on the
-# test cannot deselect on its own).
-_DURATION_RE = re.compile(
-    r"^\s*(?P<secs>\d+(?:\.\d+)?)s\s+call\s+(?P<nodeid>\S+)\s*$"
+from qdml_tpu.analysis.slowmarkers import (  # noqa: E402
+    DEFAULT_ALLOWLIST,
+    check_durations,
+    has_slow_marker,  # noqa: F401 — re-exported for the existing self-test
+    load_allowlist,  # noqa: F401
+    parse_durations,  # noqa: F401
 )
-
-
-def parse_durations(text: str) -> dict[str, float]:
-    """nodeid -> call seconds, max over parametrizations."""
-    out: dict[str, float] = {}
-    for line in text.splitlines():
-        m = _DURATION_RE.match(line)
-        if not m:
-            continue
-        nodeid = m.group("nodeid").split("[", 1)[0]  # fold parametrizations
-        secs = float(m.group("secs"))
-        out[nodeid] = max(secs, out.get(nodeid, 0.0))
-    return out
-
-
-def _decorators_mark_slow(dec_list) -> bool:
-    for dec in dec_list:
-        target = dec.func if isinstance(dec, ast.Call) else dec
-        # pytest.mark.slow -> Attribute(attr='slow', value=Attribute(attr='mark'))
-        if isinstance(target, ast.Attribute) and target.attr == "slow":
-            v = target.value
-            if isinstance(v, ast.Attribute) and v.attr == "mark":
-                return True
-    return False
-
-
-def has_slow_marker(path: str, test_name: str) -> bool:
-    """True when the test function (or its class / module pytestmark) carries
-    pytest.mark.slow. Source-level check: no pytest import, no collection."""
-    try:
-        tree = ast.parse(open(path).read())
-    except (OSError, SyntaxError):
-        return False
-
-    def module_marked() -> bool:
-        for node in tree.body:
-            if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "pytestmark" for t in node.targets
-            ):
-                vals = (
-                    node.value.elts if isinstance(node.value, (ast.List, ast.Tuple))
-                    else [node.value]
-                )
-                if _decorators_mark_slow(vals):
-                    return True
-        return False
-
-    def walk(body, inherited: bool) -> bool | None:
-        for node in body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if node.name == test_name:
-                    return inherited or _decorators_mark_slow(node.decorator_list)
-            elif isinstance(node, ast.ClassDef):
-                found = walk(
-                    node.body, inherited or _decorators_mark_slow(node.decorator_list)
-                )
-                if found is not None:
-                    return found
-        return None
-
-    found = walk(tree.body, module_marked())
-    return bool(found)
-
-
-def load_allowlist(path: str | None) -> set[str]:
-    if not path or not os.path.exists(path):
-        return set()
-    out = set()
-    for line in open(path):
-        line = line.split("#", 1)[0].strip()
-        if line:
-            out.add(line)
-    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -117,40 +37,31 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--threshold", type=float, default=5.0, help="seconds of call wall-clock (default 5)")
     ap.add_argument(
         "--allow",
-        default=os.path.join(REPO, "scripts", "tier1_slow_allowlist.txt"),
+        default=os.path.join(REPO, DEFAULT_ALLOWLIST),
         help="grandfathered nodeids (one per line, # comments)",
     )
     args = ap.parse_args(argv)
     text = sys.stdin.read() if args.durations == "-" else open(args.durations).read()
-    durations = parse_durations(text)
-    if not durations:
+    findings = check_durations(
+        REPO, text, threshold_s=args.threshold, allowlist_path=args.allow
+    )
+    empty_report = any(f.path == "(durations report)" for f in findings)
+    if empty_report:
         print("lint_markers: no '<secs>s call <nodeid>' lines found — run pytest with --durations=0")
         return 2
-    allow = load_allowlist(args.allow)
-    offenders = []
-    for nodeid, secs in sorted(durations.items(), key=lambda kv: -kv[1]):
-        if secs <= args.threshold:
-            continue
-        relpath, test_name = nodeid.split("::", 1)
-        test_name = test_name.split("::")[-1]
-        if has_slow_marker(os.path.join(REPO, relpath), test_name):
-            continue
-        if nodeid in allow:
-            continue
-        offenders.append((nodeid, secs))
-    if offenders:
+    if findings:
         print(
-            f"lint_markers: {len(offenders)} test(s) over {args.threshold:g}s "
+            f"lint_markers: {len(findings)} test(s) over {args.threshold:g}s "
             "lack @pytest.mark.slow and are not in the allowlist:"
         )
-        for nodeid, secs in offenders:
-            print(f"  {secs:8.2f}s  {nodeid}")
+        for f in findings:
+            print(f"  {f.message}")
         print(f"(mark them slow, or add to {args.allow} with a reason)")
         return 1
-    n_over = sum(1 for s in durations.values() if s > args.threshold)
+    n = len(parse_durations(text))
     print(
-        f"lint_markers: OK — {len(durations)} timed tests, {n_over} over "
-        f"{args.threshold:g}s, all marked slow or allowlisted"
+        f"lint_markers: OK — {n} timed tests, all over-threshold ones "
+        "marked slow or allowlisted"
     )
     return 0
 

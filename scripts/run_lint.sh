@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# graftlint gate — the exact invocation CI (scripts/run_tier1.sh) runs.
+# Exit 0: every finding fixed, suppressed inline with a reason, or
+# grandfathered in scripts/lint_baseline.json. Exit 1: new findings.
+# Pass extra flags through, e.g.:
+#   scripts/run_lint.sh --durations=/tmp/durations.log   # + slow-marker rule
+#   scripts/run_lint.sh --json=/tmp/lint.json            # machine-readable gate
+cd "$(dirname "$0")/.." || exit 2
+exec python -m qdml_tpu.cli lint --baseline "$@"

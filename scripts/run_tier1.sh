@@ -1,6 +1,12 @@
 #!/usr/bin/env bash
-# Tier-1 verify — the ROADMAP.md command, VERBATIM (this script exists so CI
-# and humans run the exact gate the driver runs, including the DOTS_PASSED
-# accounting; edit ROADMAP.md first if this ever needs to change).
+# Tier-1 verify — lint gate + the ROADMAP.md test command, VERBATIM.
+#
+# Stage 1: graftlint (qdml-tpu lint --baseline; docs/ANALYSIS.md). New static-
+# analysis findings fail fast (exit 5) before any test runs — the lint is
+# pure AST, no jax, sub-second.
+# Stage 2: the ROADMAP.md pytest command, byte-for-byte (this script exists so
+# CI and humans run the exact gate the driver runs, including the DOTS_PASSED
+# accounting; edit ROADMAP.md first if that line ever needs to change).
 cd "$(dirname "$0")/.." || exit 2
+python -m qdml_tpu.cli lint --baseline || exit 5
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,91 @@
+# lint fixture: NEGATIVE cases — the legitimate twin of each violation in
+# violations.py. The analyzer must report NOTHING for this file (the
+# precision half of every rule's contract). Parsed only, never imported.
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_FROZEN = (1, 2, 3)  # immutable module constant: fine to close over
+HOST_TABLE = np.zeros((4,))  # numpy at import time is host-only: fine
+
+
+@jax.jit
+def reads_immutable_global(x):
+    return x + _FROZEN[0]
+
+
+def make_good_train_step(model):
+    # audited jit: donation declared
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, batch):
+        return model(state, batch)
+
+    return step
+
+
+def make_good_eval_step(model):
+    # eval makers are exempt from the audit (nothing to donate)
+    @jax.jit
+    def step(state, batch):
+        return model(state, batch)
+
+    return step
+
+
+@jax.jit
+def static_flag_branch(x, probes: bool = True):
+    # `if` on a static Python flag bound before jit: trace-time dispatch,
+    # the documented probes=False pattern — NOT a tracer branch
+    y = jnp.mean(x)
+    if probes:
+        return y
+    return y * 2.0
+
+
+@jax.jit
+def device_branchless(x):
+    # the jnp.where form the tracer-branch rule asks for
+    loss = jnp.mean(x)
+    return jnp.where(loss > 0, loss, -loss)
+
+
+def host_loop_timer(step, state, batch):
+    # wall-clock + float() OUTSIDE any traced function: plain host timing
+    t0 = time.time()
+    state, m = step(state, batch)
+    return state, float(m["loss"]), time.time() - t0
+
+
+def save_on_all_processes(params, primary):
+    # collective on every process, host-side write guarded: the CORRECT
+    # multihost shape (the inverse of primary-only-collective)
+    save_checkpoint("w", "tag", params, {})  # noqa: F821 — AST fixture
+    if primary:
+        write_bundle_json(params)  # noqa: F821
+
+
+class GoodLoop:
+    def pump(self):
+        # dequeue + guaranteed resolution: failures forward into every future
+        batch, shed = self.batcher.next_batch()
+        try:
+            results = self.engine.infer(batch)
+        except BaseException as e:
+            for r in batch:
+                r.future.set_exception(e)
+            raise
+        for r, res in zip(batch, results):
+            r.future.set_result(res)
+        return True
+
+
+def inspect_and_reraise():
+    # broad catch that unconditionally re-raises: inspect-and-forward, fine
+    try:
+        run_training()  # noqa: F821
+    except Exception as e:
+        log_failure(e)  # noqa: F821
+        raise

@@ -1,0 +1,105 @@
+# lint fixture: POSITIVE cases — one (or two) known violations per rule.
+# Parsed by tests/test_analysis.py, NEVER imported/executed (several names
+# are deliberately undefined; only the AST shape matters). Excluded from the
+# repo gate: qdml-tpu lint scans qdml_tpu/, scripts/, bench.py — not tests/.
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_CACHE = {}  # module-level mutable state
+
+
+@jax.jit
+def reads_mutable_global(x):
+    # jit-mutable-global: traced read of a module dict freezes its contents
+    return x + len(_CACHE)
+
+
+def make_bad_train_step(model):
+    # train-step-jit-audit: maker jits with no donate/static declaration
+    @jax.jit
+    def step(state, batch):
+        return model(state, batch)
+
+    return step
+
+
+def make_bad_scan_step(fn):
+    # train-step-jit-audit: the call form, also unaudited
+    return jax.jit(fn)
+
+
+@jax.jit
+def branches_on_tracer(x):
+    # tracer-branch: Python `if` on a jnp-derived local
+    loss = jnp.mean(x)
+    if loss > 0:
+        return loss
+    return -loss
+
+
+@jax.jit
+def loops_on_tracer(x):
+    # tracer-branch: `while` directly on a jnp call
+    while jnp.sum(x) > 1.0:
+        x = x * 0.5
+    return x
+
+
+@jax.jit
+def host_sync_in_step(x):
+    # host-sync-hot-path: float() materializes the tracer (TypeError at best)
+    return float(jnp.sum(x))
+
+
+@jax.jit
+def wall_clock_in_step(x):
+    # wall-clock-in-jit: compiles to the trace-time timestamp
+    return x * time.time()
+
+
+def primary_guarded_save(params):
+    # primary-only-collective: the orbax save is collective; non-primary
+    # processes never join and the primary deadlocks at the barrier
+    if is_primary():  # noqa: F821 — AST fixture
+        save_checkpoint("w", "tag", params, {})  # noqa: F821
+
+
+def early_return_then_save(params):
+    # primary-only-collective: the early-return form of the same deadlock
+    if not is_primary():  # noqa: F821
+        return None
+    save_checkpoint("w", "tag", params, {})  # noqa: F821
+    return params
+
+
+class BadLoop:
+    def pump(self):
+        # stranded-future: dequeue + future resolution with no try/finally —
+        # an engine exception between the pop and set_result hangs clients
+        batch, shed = self.batcher.next_batch()
+        results = self.engine.infer(batch)
+        for r, res in zip(batch, results):
+            r.future.set_result(res)
+        return True
+
+
+def swallow_everything():
+    # broad-except: DivergenceError (and the run's real failure) vanish here
+    try:
+        run_training()  # noqa: F821
+    except Exception:
+        return None
+
+
+def swallow_interrupts():
+    # broad-except: BaseException additionally eats KeyboardInterrupt
+    try:
+        run_training()  # noqa: F821
+    except BaseException:
+        return None
+
+
+IMPORT_TIME_ARRAY = jnp.zeros((4,))  # import-time-jnp: device alloc on import

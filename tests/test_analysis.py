@@ -1,0 +1,514 @@
+"""graftlint + checkify sanitizer: rule positives/negatives over the
+committed fixture files, suppression/baseline mechanics, the repo's own gate,
+and the checkify-on/off equivalence + compile-identity pins (docs/ANALYSIS.md)."""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from qdml_tpu.analysis import LintEngine, ModuleContext, parse_suppressions
+from qdml_tpu.analysis.cli import lint_main, repo_root
+from qdml_tpu.analysis.engine import load_baseline, save_baseline
+
+REPO = repo_root()
+FIXDIR = "tests/fixtures/lint"
+
+
+def _rules_found(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+def _ctx(source: str, relpath: str = "fixture.py") -> ModuleContext:
+    import ast
+
+    return ModuleContext(relpath, relpath, source, ast.parse(source))
+
+
+# ---------------------------------------------------------------------------
+# Rule positives / negatives over the committed fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_violation_fixture_trips_every_rule():
+    engine = LintEngine(REPO)
+    findings, err = engine.lint_file(f"{FIXDIR}/violations.py")
+    assert err is None
+    rules = _rules_found(findings)
+    assert rules["jit-mutable-global"] == 1
+    assert rules["train-step-jit-audit"] == 2      # decorator + call forms
+    assert rules["tracer-branch"] == 2             # if + while
+    assert rules["host-sync-hot-path"] == 1
+    assert rules["wall-clock-in-jit"] == 1
+    assert rules["primary-only-collective"] == 2   # guarded + early-return
+    assert rules["stranded-future"] == 1
+    assert rules["broad-except"] == 2              # Exception + BaseException
+    assert rules["import-time-jnp"] == 1
+    # every finding carries a usable anchor
+    for f in findings:
+        assert f.path.endswith("violations.py") and f.line > 0 and f.message
+
+
+def test_clean_fixture_is_silent():
+    engine = LintEngine(REPO)
+    findings, err = engine.lint_file(f"{FIXDIR}/clean.py")
+    assert err is None
+    assert findings == [], _rules_found(findings)
+
+
+def test_lock_discipline_rule_uses_project_map():
+    """The lock map keys on real repo paths, so the rule is exercised with an
+    inline module presented under the mapped path."""
+    from qdml_tpu.analysis.rules import rule_serve_lock_discipline
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+        class MicroBatcher:
+            def __init__(self):
+                self._q = []              # __init__ is exempt
+                self._lock = threading.Lock()
+
+            def good(self):
+                with self._lock:
+                    return len(self._q)
+
+            def bad(self):
+                return self._q.pop()      # outside the lock
+        """
+    )
+    ctx = _ctx(src, "qdml_tpu/serve/batcher.py")
+    findings = rule_serve_lock_discipline(ctx)
+    assert len(findings) == 1
+    assert findings[0].context == "MicroBatcher.bad"
+    # the same source under an unmapped path is out of scope
+    assert rule_serve_lock_discipline(_ctx(src, "other/file.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_parsing_reasons_and_top_level_commas():
+    sup = parse_suppressions(
+        "x = 1  # lint: disable=rule-a(reason one (nested, commas)),rule-b\n"
+        "y = 2  # lint: disable=rule-c(simple)\n"
+    )
+    assert sup[1]["rule-a"] == "reason one (nested, commas)"
+    assert sup[1]["rule-b"] is None  # reason-less: recorded but not honored
+    assert sup[2]["rule-c"] == "simple"
+
+
+def test_suppression_requires_reason(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            def f():
+                try:
+                    g()
+                except Exception:  # lint: disable=broad-except(probe may raise anything; result is advisory)
+                    pass
+
+            def h():
+                try:
+                    g()
+                except Exception:  # lint: disable=broad-except
+                    pass
+
+            x = 1  # lint: disable=tracer-branch
+            """
+        )
+    )
+    engine = LintEngine(str(tmp_path))
+    result = engine.run(["mod.py"])
+    # the reasoned suppression holds; the reason-less one does NOT suppress —
+    # the finding stays, annotated with the policy pointer — and a reason-less
+    # comment matching nothing is reported as dead weight
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].reason.startswith("probe may raise")
+    rules = _rules_found(result.new)
+    assert rules == {"broad-except": 1, "bare-suppression": 1}
+    unsuppressed = next(f for f in result.new if f.rule == "broad-except")
+    assert "reasons are mandatory" in unsuppressed.message
+
+
+def test_dead_suppression_and_nested_sync_dedup(tmp_path):
+    """A reasoned suppression matching nothing is stale documentation and is
+    flagged; nested sync calls on one line yield ONE finding (duplicate
+    fingerprints would double-count the gate while one baseline entry
+    silently absorbed both)."""
+    (tmp_path / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(jax.device_get(x))  # two syncs, one line
+
+            y = 1  # lint: disable=broad-except(nothing here ever raised)
+            """
+        )
+    )
+    result = LintEngine(str(tmp_path)).run(["mod.py"])
+    rules = _rules_found(result.new)
+    assert rules["host-sync-hot-path"] == 1  # deduped by (rule, line)
+    assert rules["dead-suppression"] == 1
+
+
+def test_missing_path_fails_the_gate(tmp_path, capsys):
+    """A typo'd --paths (or renamed DEFAULT_PATHS entry) must fail, not scan
+    nothing and report green."""
+    result = LintEngine(str(tmp_path)).run(["no/such/dir"])
+    assert not result.ok and "no such file" in result.errors[0]
+    rc = lint_main(["--paths=qdml_tpu/serv"])
+    capsys.readouterr()
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# Baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip_and_rearm(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("def f():\n    try:\n        g()\n    except Exception:\n        pass\n")
+    engine = LintEngine(str(tmp_path))
+    raw = engine.run(["mod.py"])
+    assert _rules_found(raw.new) == {"broad-except": 1}
+
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(str(bl_path), raw.new)
+    baseline = load_baseline(str(bl_path))
+    assert len(baseline) == 1
+    gated = engine.run(["mod.py"], baseline=baseline)
+    assert gated.new == [] and len(gated.baselined) == 1
+    assert gated.baselined[0].reason  # grandfather reason is written
+
+    # fingerprints are line-number free: shifting the offender down leaves it
+    # baselined; EDITING the offending line re-arms the gate
+    mod.write_text(
+        "import os\n\n\ndef f():\n    try:\n        g()\n    except Exception:\n        pass\n"
+    )
+    assert engine.run(["mod.py"], baseline=baseline).new == []
+    mod.write_text("def f():\n    try:\n        g()\n    except BaseException:\n        pass\n")
+    rearmed = engine.run(["mod.py"], baseline=baseline)
+    assert _rules_found(rearmed.new) == {"broad-except": 1}
+
+    # regenerating preserves a hand-written reason for surviving entries
+    entry = next(iter(baseline.values()))
+    entry["reason"] = "custom triage note"
+    save_baseline(str(bl_path), raw.new, previous=baseline)
+    assert next(iter(load_baseline(str(bl_path)).values()))["reason"] == "custom triage note"
+
+
+def test_write_baseline_excludes_meta_findings(tmp_path, capsys):
+    """--write-baseline must not freeze policy violations (bare-suppression)
+    or data-driven slow-marker findings into the AST baseline."""
+    root = tmp_path / "repo"
+    root.mkdir()
+    (root / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+
+            x = 1  # lint: disable=broad-except
+            """
+        )
+    )
+    import qdml_tpu.analysis.cli as lint_cli
+
+    bl = root / "bl.json"
+    orig = lint_cli.repo_root
+    lint_cli.repo_root = lambda: str(root)
+    try:
+        rc = lint_cli.lint_main(
+            ["--paths=mod.py", f"--baseline={bl}", "--write-baseline"]
+        )
+    finally:
+        lint_cli.repo_root = orig
+    out = capsys.readouterr().out
+    assert rc == 0 and "NOT baselined" in out
+    entries = json.loads(bl.read_text())["entries"]
+    assert [e["rule"] for e in entries] == ["broad-except"]  # no bare-suppression
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, --json artifact, the repo's own gate, slow-marker fold-in
+# ---------------------------------------------------------------------------
+
+
+def test_repo_gate_is_clean(capsys):
+    """THE acceptance gate: qdml-tpu lint --baseline exits 0 on this repo —
+    every finding fixed, suppressed with a written reason, or baselined."""
+    assert lint_main(["--baseline"]) == 0
+    assert "0 new findings" in capsys.readouterr().out
+
+
+def test_lint_cli_fixture_findings_and_json(tmp_path, capsys):
+    json_path = tmp_path / "lint.json"
+    rc = lint_main([f"--paths={FIXDIR}/violations.py", f"--json={json_path}"])
+    capsys.readouterr()
+    assert rc == 1
+    gate = json.loads(json_path.read_text())
+    assert gate["kind"] == "lint_gate" and gate["ok"] is False
+    assert gate["new_findings"] == sum(gate["per_rule"].values()) == len(gate["findings"])
+    assert gate["exit_code"] == 1
+    assert gate["per_rule"]["tracer-branch"] == 2
+
+
+def test_lint_cli_slow_marker_rule(tmp_path, capsys):
+    dur = tmp_path / "d.log"
+    dur.write_text("  30.00s call     tests/test_serve.py::test_empty_queue_flush_is_noop\n")
+    json_path = tmp_path / "lint.json"
+    rc = lint_main(
+        [
+            f"--paths={FIXDIR}/clean.py",
+            f"--durations={dur}",
+            "--allow=/nonexistent",
+            f"--json={json_path}",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 1
+    gate = json.loads(json_path.read_text())
+    assert gate["per_rule"] == {"slow-marker": 1}
+    # the slow-marked soak test and the committed allowlist both satisfy it
+    dur.write_text(
+        "  30.00s call     tests/test_serve.py::test_loadgen_soak_open_loop_with_deadlines\n"
+    )
+    rc = lint_main([f"--paths={FIXDIR}/clean.py", f"--durations={dur}"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_report_folds_lint_gate(tmp_path, capsys):
+    """report --lint: a failing lint artifact forces the regression exit even
+    when the perf side is clean."""
+    from qdml_tpu.telemetry.report import EXIT_REGRESSION, report_main
+
+    bench = {"metric": "sps", "value": 100.0, "platform": "cpu"}
+    base = tmp_path / "b.jsonl"
+    base.write_text(json.dumps(bench) + "\n")
+    cur = tmp_path / "c.jsonl"
+    cur.write_text(json.dumps(bench) + "\n")
+    lint_ok = tmp_path / "ok.json"
+    lint_ok.write_text(json.dumps({"ok": True, "new_findings": 0, "suppressed": 3, "baselined": 1}))
+    lint_bad = tmp_path / "bad.json"
+    lint_bad.write_text(
+        json.dumps({"ok": False, "new_findings": 2, "per_rule": {"tracer-branch": 2}})
+    )
+    assert report_main([f"--current={cur}", f"--baseline={base}", f"--lint={lint_ok}"]) == 0
+    capsys.readouterr()
+    json_out = tmp_path / "gate.json"
+    rc = report_main(
+        [f"--current={cur}", f"--baseline={base}", f"--lint={lint_bad}", f"--json={json_out}"]
+    )
+    capsys.readouterr()
+    assert rc == EXIT_REGRESSION
+    gate = json.loads(json_out.read_text())
+    assert gate["lint_failed"] is True
+    row = next(g for g in gate["gates"] if g["kind"] == "lint")
+    assert row["status"] == "regression" and row["current"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Checkify sanitizer: off == today's program, on == same numerics + typed trip
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg(**train_overrides):
+    from qdml_tpu.config import DataConfig, ExperimentConfig, ModelConfig, TrainConfig
+
+    return ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=80),
+        model=ModelConfig(features=16),
+        train=TrainConfig(batch_size=16, n_epochs=1, **train_overrides),
+    )
+
+
+@pytest.fixture(scope="module")
+def dce_setup():
+    from qdml_tpu.data.datasets import DMLGridLoader
+    from qdml_tpu.train.dce import init_dce_state
+
+    cfg = _tiny_cfg()
+    loader = DMLGridLoader(cfg.data, cfg.train.batch_size)
+    batch = next(iter(loader.epoch(0)))
+    model, state = init_dce_state(cfg, loader.steps_per_epoch)
+    return cfg, loader, batch, model, state
+
+
+def test_checkify_off_is_compile_identical(dce_setup):
+    """checkify_errors=False must build TODAY's program: the maker's lowered
+    HLO is byte-identical to a directly-jitted step, and re-dispatching adds
+    zero compile-cache requests (the probes=False pinning pattern)."""
+    import jax
+    from functools import partial
+
+    from qdml_tpu.train.dce import _dce_step, init_dce_state, make_dce_train_step
+    from qdml_tpu.utils.compile_cache import compile_cache_stats, enable_compile_cache
+    from qdml_tpu.utils.platform import donation_argnums
+
+    cfg, loader, batch, model, state = dce_setup
+    enable_compile_cache()
+
+    maker_step = make_dce_train_step(model, probes=True, checkify_errors=False)
+
+    # the pre-PR-4 maker body, verbatim (same inner name so HLO module names
+    # cannot differ for naming reasons alone)
+    @partial(jax.jit, donate_argnums=donation_argnums(0))
+    def step(state, batch):
+        return _dce_step(model, state, batch, probes=True)
+
+    assert (
+        maker_step.lower(state, batch).as_text()
+        == step.lower(state, batch).as_text()
+    )
+
+    # and the off path never recompiles across dispatches
+    _, st2 = init_dce_state(cfg, loader.steps_per_epoch)
+    st2, m = maker_step(st2, batch)
+    base = compile_cache_stats()["requests"]
+    st2, m = maker_step(st2, batch)
+    assert compile_cache_stats()["requests"] == base
+    assert "checkify_err" not in m
+
+
+def test_checkify_on_matches_off_numerics(dce_setup):
+    """Same params, same metrics: checkify adds error TRACKING, never math."""
+    import jax
+
+    from qdml_tpu.train.dce import init_dce_state, make_dce_train_step
+
+    cfg, loader, batch, model, _ = dce_setup
+    _, s_off = init_dce_state(cfg, loader.steps_per_epoch)
+    _, s_on = init_dce_state(cfg, loader.steps_per_epoch)
+    step_off = make_dce_train_step(model, probes=True, checkify_errors=False)
+    step_on = make_dce_train_step(model, probes=True, checkify_errors=True)
+    for _ in range(2):
+        s_off, m_off = step_off(s_off, batch)
+        s_on, m_on = step_on(s_on, batch)
+    assert "checkify_err" in m_on and m_on["checkify_err"].get() is None
+    np.testing.assert_array_equal(np.asarray(m_off["loss"]), np.asarray(m_on["loss"]))
+    np.testing.assert_array_equal(
+        np.asarray(m_off["probe"]["grad_norm"]), np.asarray(m_on["probe"]["grad_norm"])
+    )
+    for a, b in zip(jax.tree.leaves(s_off.params), jax.tree.leaves(s_on.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkify_trip_raises_through_flight_recorder(dce_setup, tmp_path):
+    """A tripped check surfaces exactly like a watchdog divergence: dump
+    bundle + typed DivergenceError naming the offending primitive."""
+    import dataclasses
+
+    from qdml_tpu.telemetry import DivergenceError, FlightRecorder
+    from qdml_tpu.train.dce import init_dce_state, make_dce_train_step
+
+    cfg, loader, batch, model, _ = dce_setup
+    cfg = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(cfg.train, checkify=True),
+        eval=dataclasses.replace(cfg.eval, results_dir=str(tmp_path)),
+    )
+    _, state = init_dce_state(cfg, loader.steps_per_epoch)
+    step = make_dce_train_step(model, probes=True, checkify_errors=True)
+    bad = dict(batch)
+    yp = np.asarray(bad["yp_img"]).copy()
+    yp[...] = np.inf
+    bad["yp_img"] = yp
+    state, m = step(state, bad)
+    assert m["checkify_err"].get() is not None
+    rec = FlightRecorder("unit", cfg, workdir=None)
+    rec.note_good(state.params)
+    with pytest.raises(DivergenceError, match="checkify") as ei:
+        rec.on_step(0, m, loss=float(np.asarray(m["loss"])), params=state.params)
+    assert ei.value.reason.startswith("checkify:")
+    assert ei.value.dump_dir and os.path.exists(
+        os.path.join(ei.value.dump_dir, "bundle.json")
+    )
+    bundle = json.load(open(os.path.join(ei.value.dump_dir, "bundle.json")))
+    assert bundle["reason"].startswith("checkify:")
+
+
+def test_checkify_classifier_step_batched_scatter_compat(dce_setup):
+    """The classifier NLL loss picks log-probs via take_along_axis, which
+    this jax lowers to a BATCHED gather whose gradient is a batched
+    scatter-add — the shape that crashed checkify's stock scatter-OOB rule
+    at trace time (IndexError, caught driving train-sc --train.checkify on
+    the real backend). Pins the sanitizer's compat backfill: the checkified
+    classifier step must trace, run, and match the unchecked step exactly."""
+    import jax
+
+    from qdml_tpu.train.qsc import init_sc_state, make_sc_train_step
+
+    cfg, loader, batch, _model, _state = dce_setup
+    model, s_on = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    _, s_off = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    rng = jax.random.PRNGKey(0)
+    step_on = make_sc_train_step(model, needs_rng=False, probes=True, checkify_errors=True)
+    step_off = make_sc_train_step(model, needs_rng=False, probes=True, checkify_errors=False)
+    s_on, m_on = step_on(s_on, batch, rng)
+    s_off, m_off = step_off(s_off, batch, rng)
+    assert m_on["checkify_err"].get() is None
+    np.testing.assert_array_equal(np.asarray(m_on["loss"]), np.asarray(m_off["loss"]))
+    for a, b in zip(jax.tree.leaves(s_on.params), jax.tree.leaves(s_off.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_serve_checkify_parity_and_trip():
+    """serve.checkify: warmed checkified buckets reproduce the offline
+    forward, keep the zero-request-path-compiles gate, and convert a
+    poisoned batch into a typed DivergenceError (no hang, no garbage)."""
+    from qdml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        ServeConfig,
+        TrainConfig,
+    )
+    from qdml_tpu.serve import ServeEngine
+    from qdml_tpu.serve.loadgen import make_request_samples
+    from qdml_tpu.telemetry import DivergenceError
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        serve=ServeConfig(max_batch=4, buckets=(4,), checkify=True),
+    )
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    engine = ServeEngine(cfg, hdce_vars, {"params": sc_state.params})
+    samples = make_request_samples(cfg, 8)
+    offline_h, offline_pred = engine.offline_forward(samples["x"])
+    engine.warmup()
+    h, pred, bucket = engine.infer(samples["x"][:3])
+    np.testing.assert_allclose(h, offline_h[:3], rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(pred, offline_pred[:3])
+    assert all(v == 0 for v in engine.request_path_compiles().values())
+    bad = samples["x"][:2].copy()
+    bad[...] = np.inf
+    with pytest.raises(DivergenceError, match="serve checkify"):
+        engine.infer(bad)
+    # the engine survives the trip: the next clean batch still serves
+    h2, _, _ = engine.infer(samples["x"][:2])
+    np.testing.assert_allclose(h2, offline_h[:2], rtol=1e-5, atol=1e-5)
