@@ -304,8 +304,19 @@ def _bench_hdce_scan(
 
 
 def _bench_qsc(
-    backend: str, max_steps: int, budget_s: float, n_qubits: int = 6
+    backend: str,
+    max_steps: int,
+    budget_s: float,
+    n_qubits: int = 6,
+    tune: bool = False,
 ) -> dict:
+    """One QSC train-step measurement on a FIXED circuit impl (``backend``)
+    or, with ``tune=True`` and ``backend="auto"``, on the autotuned
+    dispatcher path — the tuner runs first (its compiles land outside the
+    timed loop) and the record carries the chosen impl plus every
+    candidate's micro-bench timings, so the artifact can say what the
+    winner beat. Every record names the impl that actually ran
+    (``quantum_impl``)."""
     import jax
 
     from qdml_tpu.config import (
@@ -318,9 +329,21 @@ def _bench_qsc(
 
     cfg = ExperimentConfig(
         data=DataConfig(),
-        quantum=QuantumConfig(backend=backend, n_qubits=n_qubits),
+        # fixed-impl benches must never consult (or write) the table; the
+        # auto bench always tunes, on every platform — the candidates ARE
+        # the artifact
+        quantum=QuantumConfig(
+            backend=backend, n_qubits=n_qubits, autotune="on" if tune else "off"
+        ),
         train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
     )
+    from qdml_tpu.quantum import autotune as _at
+    from qdml_tpu.quantum.circuits import resolve_impl
+
+    circuit_batch = _GRID[0] * _GRID[1] * _CELL_BS
+    # force=True: the artifact's candidate timings must come from THIS
+    # bench window, never a previous session's persisted entry
+    at_entry = _at.prewarm(cfg, batch=circuit_batch, force=True) if tune else None
     batch = _make_grid_batch(cfg)
     batch = {k: batch[k] for k in ("yp_img", "indicator")}
     model, state = init_sc_state(cfg, quantum=True, steps_per_epoch=100)
@@ -338,13 +361,30 @@ def _bench_qsc(
     )
     samples = t["sps"] * _GRID[0] * _GRID[1] * _CELL_BS
     tflops = samples * 3.0 * qsc_fwd_flops_per_sample(cfg) / 1e12
-    return {
+    out = {
         "samples_per_sec": round(samples, 1),
         "model_tflops": round(tflops, 3),
         "compile_s": t["compile_s"],
         "dispatch_ms": t["dispatch_ms"],
         "cost": cost_rec,
+        # the circuit implementation this measurement actually dispatched
+        "quantum_impl": resolve_impl(
+            cfg.quantum.impl,
+            cfg.quantum.backend,
+            n_qubits,
+            cfg.quantum.n_layers,
+            circuit_batch,
+            mode="train",
+        ),
     }
+    if at_entry is not None:
+        out["autotune"] = {
+            "key": at_entry["key"],
+            "best_train": at_entry["best_train"],
+            "best_fwd": at_entry["best_fwd"],
+            "candidates": at_entry["candidates"],
+        }
+    return out
 
 
 def _bench_qsc_scan(
@@ -560,6 +600,11 @@ def run_child(platform: str) -> int:
     benches += [
         ("qsc_dense", lambda: _bench_qsc("dense", max_steps, budget / 2)),
         ("qsc_pallas", lambda: _bench_qsc("pallas", max_steps, budget / 2)),
+        # the autotuned dispatcher path (quantum.impl=auto): tunes first,
+        # then measures the step the table winner compiles into — the
+        # acceptance gate is qsc_auto >= best fixed qsc_* (within noise),
+        # and the record carries the winner + candidate timings
+        ("qsc_auto", lambda: _bench_qsc("auto", max_steps, budget / 2, tune=True)),
         # online-serving request path (inference only: cheap on both
         # platforms) — the steady-state rate `qdml-tpu serve` sustains with
         # a saturated batcher, plus its zero-compile gate

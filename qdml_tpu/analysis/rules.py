@@ -557,6 +557,78 @@ def rule_import_time_jnp(ctx: ModuleContext) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 11/12. Pallas kernel discipline — host-loop launches, interpret left on
+# ---------------------------------------------------------------------------
+
+
+def _pallas_call_sites(ctx: ModuleContext) -> list[ast.Call]:
+    return [
+        node
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.Call)
+        and (ctx.canonical(node.func) or "").rsplit(".", 1)[-1] == "pallas_call"
+    ]
+
+
+def rule_pallas_host_loop(ctx: ModuleContext) -> list[Finding]:
+    """``pallas_call`` inside a host-side Python ``for``/``while`` (the v1
+    per-layer circuit shape: one kernel launch per gate/layer, bouncing the
+    operand through HBM between iterations) — the loop belongs INSIDE the
+    kernel (``jax.lax.fori_loop`` with the state pinned in VMEM) or inside
+    one ``lax.scan``. Loops inside a nested function (a kernel body, a scan
+    body) are not host loops and are not flagged."""
+    out: list[Finding] = []
+    for call in _pallas_call_sites(ctx):
+        cur = ctx.parent.get(call)
+        while cur is not None and not isinstance(cur, _FuncNode):
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                out.append(
+                    ctx.finding(
+                        "pallas-host-loop",
+                        call,
+                        "pallas_call launched from a host-side Python loop — "
+                        "each iteration is a separate kernel launch with an "
+                        "HBM round-trip between them; move the loop into the "
+                        "kernel (fori_loop over VMEM-resident state, see "
+                        "quantum/pallas_kernels.fused_circuit_expvals) or "
+                        "under one lax.scan",
+                    )
+                )
+                break
+            cur = ctx.parent.get(cur)
+    return out
+
+
+def rule_pallas_interpret_literal(ctx: ModuleContext) -> list[Finding]:
+    """``interpret=True`` hardcoded in a ``pallas_call``: the kernel silently
+    runs on the Pallas interpreter EVERYWHERE — including on a real TPU —
+    turning a production kernel into an emulation benchmark. Production code
+    must route the decision through the one config-driven knob
+    (``utils.platform.pallas_interpret``); test/fixture paths are outside the
+    gate's scan roots by design."""
+    out: list[Finding] = []
+    for call in _pallas_call_sites(ctx):
+        for kw in call.keywords:
+            if (
+                kw.arg == "interpret"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+            ):
+                out.append(
+                    ctx.finding(
+                        "pallas-interpret-literal",
+                        call,
+                        "pallas_call(interpret=True) left enabled outside "
+                        "test/fixture paths — this compiles the interpreter "
+                        "in unconditionally (TPU included); pass "
+                        "interpret=utils.platform.pallas_interpret() so the "
+                        "eager/jit/interpret choice stays config-driven",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -600,6 +672,14 @@ RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
     "import-time-jnp": (
         rule_import_time_jnp,
         "jnp ops at module import time",
+    ),
+    "pallas-host-loop": (
+        rule_pallas_host_loop,
+        "pallas_call launched from a host-side Python loop over gates/layers",
+    ),
+    "pallas-interpret-literal": (
+        rule_pallas_interpret_literal,
+        "pallas_call(interpret=True) hardcoded outside test/fixture paths",
     ),
     # "slow-marker" is data-driven (needs a --durations report) and lives in
     # qdml_tpu.analysis.slowmarkers; the CLI folds it in when given the data.

@@ -109,14 +109,26 @@ class QuantumConfig:
     gradient_prune_mode: str = "absolute"
     # QuantumNAT sigma grid for the vmapped noise-sweep ensemble (config 5)
     noise_sweep: tuple[float, ...] = (0.0, 0.01, 0.05, 0.1)
-    # simulator backend: "auto" (default) resolves by platform and qubit
-    # count — the whole-circuit Pallas kernel on TPU for n<=8 (measured
-    # fastest on-chip, results/bench_tpu_v5e_r3.json), XLA "dense" per-ansatz
-    # unitaries otherwise up to n<=10, gate-wise "tensor" above that;
-    # "sharded" (explicit) partitions the statevector over the mesh (n>=14);
-    # plus explicit "pallas"/"pallas_tensor" kernel paths
-    # (see qdml_tpu.quantum.circuits.resolve_backend / VALID_BACKENDS).
+    # Legacy simulator-backend knob: "auto" (default) defers to the
+    # autotuned dispatcher below; an explicit value ("dense"/"tensor"/
+    # "pallas"/"pallas_circuit"/"sharded") forces that path everywhere
+    # (see qdml_tpu.quantum.circuits.resolve_impl / VALID_BACKENDS).
     backend: str = "auto"
+    # Autotuned implementation dispatch (qdml_tpu.quantum.autotune,
+    # docs/QUANTUM.md). impl: "auto" routes every circuit shape through the
+    # measured selection table (falling back to XLA dense when no table
+    # entry exists — the losing-kernel-on-the-hot-path failure BENCH_r05
+    # exposed cannot recur); an explicit impl wins over BOTH the table and
+    # the legacy backend knob.
+    impl: str = "auto"
+    # When the tuner itself may run (train-loop startup, serve warmup,
+    # bench — never the request path): "auto" = only on a real accelerator
+    # (the CPU test/fallback backend keeps the dense fallback and pays zero
+    # tuning compiles), "on"/"off" force it.
+    autotune: str = "auto"
+    # Selection-table location; "" = results/autotune/qsc_impl.json
+    # (QDML_QSC_AUTOTUNE_TABLE env overrides the default).
+    autotune_table: str = ""
     # Per-sample RMS input normalization (scale-invariant angle encoding;
     # fixes low-SNR collapse of the raw-pilot QSC). OFF = reference parity.
     input_norm: bool = False

@@ -87,6 +87,7 @@ def make_sweep_step(
             n_layers=cfg.quantum.n_layers,
             n_classes=cfg.quantum.n_classes,
             backend=cfg.quantum.backend,
+            impl=cfg.quantum.impl,
             input_norm=cfg.quantum.input_norm,
         )
         if qsc_vars is not None

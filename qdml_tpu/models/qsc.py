@@ -41,7 +41,11 @@ class QSCP128(nn.Module):
     n_classes: int = 3
     use_quantumnat: bool = False   # reference ships with this OFF (Runner...py:313-316)
     noise_level: float = 0.01      # QuantumNAT sigma (Estimators...py:118)
-    backend: str = "auto"  # platform-aware resolution (circuits.resolve_backend)
+    backend: str = "auto"  # legacy forced path (circuits.resolve_impl precedence)
+    # autotuned dispatcher override (quantum.impl): "auto" consults the
+    # measured selection table per shape/platform, falling back to dense;
+    # an explicit impl wins over the table AND the legacy backend knob
+    impl: str = "auto"
     # Per-sample RMS normalization of the pilot image before the CNN. OFF by
     # default (reference parity: QSC_P128 consumes raw pilots). The raw-pilot
     # angle encoding is scale-sensitive — a classifier trained at SNR 10
@@ -80,7 +84,13 @@ class QSCP128(nn.Module):
             weights = weights + noise  # gradient at the noisy point (C7 semantics)
 
         if self.depolarizing_p > 0.0:
-            if self.backend not in ("auto", "tensor"):
+            # honor resolve_impl precedence: an explicit impl wins outright,
+            # so impl='tensor' is fine whatever the legacy backend says; with
+            # impl auto/unset the legacy backend must be tensor-compatible
+            forced_ok = self.impl == "tensor" or (
+                self.impl in ("", "auto") and self.backend in ("auto", "tensor")
+            )
+            if not forced_ok:
                 # the trajectory simulator only has the gate-wise tensor
                 # formulation; silently ignoring an explicit dense/pallas/
                 # sharded choice would e.g. drop a sharded high-qubit model
@@ -88,8 +98,8 @@ class QSCP128(nn.Module):
                 raise ValueError(
                     f"depolarizing_p={self.depolarizing_p} uses the trajectory "
                     f"simulator (tensor formulation only); backend="
-                    f"{self.backend!r} cannot be honored — configure "
-                    "backend='tensor' (or leave 'auto') for noisy evaluation"
+                    f"{self.backend!r}/impl={self.impl!r} cannot be honored — "
+                    "configure 'tensor' (or leave 'auto') for noisy evaluation"
                 )
             expz = run_circuit_trajectories(
                 angles,
@@ -101,6 +111,16 @@ class QSCP128(nn.Module):
                 self.n_trajectories,
             )
         else:
-            expz = run_circuit(angles, weights, self.n_qubits, self.n_layers, self.backend)
+            # mode picks the autotune winner: the train step cares about
+            # forward+backward, eval/serving about the forward alone
+            expz = run_circuit(
+                angles,
+                weights,
+                self.n_qubits,
+                self.n_layers,
+                self.backend,
+                impl=self.impl,
+                mode="train" if train else "infer",
+            )
         logits = nn.Dense(self.n_classes)(expz)
         return nn.log_softmax(logits, axis=-1)

@@ -25,10 +25,18 @@ Execution paths:
 - ``pallas``: the dense math as ONE fused TPU kernel per batch tile —
   in-kernel embedding, blockdiag unitary matmul, <Z> contraction
   (:mod:`qdml_tpu.quantum.pallas_kernels`).
+- ``pallas_circuit``: the gate-chain math as ONE VMEM-resident kernel per
+  batch tile — in-kernel embedding, all L layers walked by an in-kernel loop
+  with the statevector pinned in VMEM, adjoint backward
+  (:func:`qdml_tpu.quantum.pallas_kernels.fused_circuit_expvals`). Scales
+  past the dense unitary build (n ~ 7-12). ``pallas_tensor`` is the
+  deprecated pre-v2 alias.
 
-Both paths are pure jittable functions of ``(angles, weights)`` and
+All paths are pure jittable functions of ``(angles, weights)`` and
 differentiable by JAX AD; they agree to float32 precision (tested against an
-independent numpy simulator in ``tests/test_quantum.py``).
+independent numpy simulator in ``tests/test_quantum.py``). Which one runs is
+the dispatcher's job: :func:`resolve_impl` consults the measured autotune
+table (:mod:`qdml_tpu.quantum.autotune`) before the static heuristic.
 """
 
 from __future__ import annotations
@@ -38,7 +46,15 @@ import jax.numpy as jnp
 from qdml_tpu.quantum import statevector as sv
 from qdml_tpu.utils.complexops import CArr, ceinsum, ckron
 
-VALID_BACKENDS = ("auto", "tensor", "dense", "sharded", "pallas", "pallas_tensor")
+VALID_BACKENDS = (
+    "auto",
+    "tensor",
+    "dense",
+    "sharded",
+    "pallas",
+    "pallas_circuit",
+    "pallas_tensor",  # deprecated alias for pallas_circuit (pre-v2 name)
+)
 
 
 def rot_gate(w_ry: jnp.ndarray, w_rz: jnp.ndarray) -> CArr:
@@ -93,32 +109,55 @@ def ansatz_unitary(weights: jnp.ndarray, n: int, n_layers: int) -> CArr:
 
 
 def resolve_backend(backend: str, n_qubits: int) -> str:
-    """Resolve ``auto`` to a concrete execution path.
+    """Resolve ``auto`` to a concrete execution path WITHOUT measurements.
 
-    Qubit count picks the formulation: the dense per-ansatz unitary (MXU
-    matmuls) wins up to ~10 qubits; past that its 2^n x 2^n build dominates
-    and the gate-wise tensor path wins; from ~14 qubits the statevector
-    should be mesh-sharded instead (select "sharded" explicitly — it needs a
-    multi-device mesh this helper cannot assume). Within the dense regime,
-    on a real TPU the whole-circuit Pallas kernel wins the FULL TRAIN STEP
-    in the controlled alternating A/B — 4/4 rounds, median 826k vs 647k
-    sps (``results/perf_r3/r3_qsc_ab.json``) — which is the evidence this
-    auto-choice rests on. Single wall captures at this dispatch-bound size
-    land on both sides, and the kernel's standalone forward measures
-    SLOWER at wall (``r3_quantum_microbench.json``); the device-time
-    decomposition that attributes the step win is the round-4 perf
-    session's job. On non-TPU backends the kernel only has interpret mode,
-    so XLA dense wins.
+    This is the static fallback: the dense per-ansatz unitary (MXU matmuls)
+    up to ~10 qubits, the gate-wise tensor path past that (its 2^n x 2^n
+    unitary build dominates); from ~14 qubits the statevector should be
+    mesh-sharded instead (select "sharded" explicitly — it needs a
+    multi-device mesh this helper cannot assume).
+
+    The kernel-vs-XLA choice is deliberately NOT made here anymore. The old
+    static TPU promotion of the whole-circuit Pallas kernel rested on one
+    round's A/B while the committed bench showed the same kernel LOSING the
+    train step (BENCH_r05: qsc_pallas 9.76k vs qsc_dense 10.4k sps) — a
+    fixed claim cannot arbitrate a shape/platform-dependent race. Measured
+    dispatch lives in :mod:`qdml_tpu.quantum.autotune`; ``auto`` here means
+    "the safe XLA formulation for this qubit count", and
+    :func:`resolve_impl` consults the autotune table before falling back to
+    this heuristic.
     """
     if backend != "auto":
         return backend
-    if n_qubits > 10:
-        return "tensor"
-    import jax
+    return "dense" if n_qubits <= 10 else "tensor"
 
-    if n_qubits <= 8 and jax.default_backend() == "tpu":
-        return "pallas"
-    return "dense"
+
+def resolve_impl(
+    impl: str,
+    backend: str,
+    n_qubits: int,
+    n_layers: int,
+    batch: int,
+    mode: str = "train",
+) -> str:
+    """Full dispatch resolution for one concrete circuit shape.
+
+    Precedence: an explicit ``impl`` (the ``quantum.impl`` config override)
+    wins outright; then an explicit legacy ``backend``; then the autotuned
+    selection table for this exact ``(platform, n_qubits, n_layers,
+    batch-bucket, mode)``; then :func:`resolve_backend`'s static heuristic.
+    A missing/corrupt/unpopulated table degrades to the heuristic (which
+    bottoms out at XLA dense in the small-n regime) — never an exception and
+    never an unmeasured kernel promotion.
+    """
+    if impl not in ("", "auto"):
+        return "pallas_circuit" if impl == "pallas_tensor" else impl
+    if backend != "auto":
+        return "pallas_circuit" if backend == "pallas_tensor" else backend
+    from qdml_tpu.quantum import autotune
+
+    sel = autotune.lookup(n_qubits, n_layers, batch, mode=mode)
+    return sel if sel is not None else resolve_backend("auto", n_qubits)
 
 
 def run_circuit(
@@ -127,9 +166,22 @@ def run_circuit(
     n_qubits: int,
     n_layers: int,
     backend: str = "dense",
+    impl: str = "auto",
+    mode: str = "train",
 ) -> jnp.ndarray:
-    """Full reference circuit: angles (..., n) -> per-wire <Z> (..., n)."""
-    backend = resolve_backend(backend, n_qubits)
+    """Full reference circuit: angles (..., n) -> per-wire <Z> (..., n).
+
+    ``impl`` is the autotune-aware dispatcher override (``quantum.impl``);
+    with both ``impl`` and ``backend`` at ``"auto"`` the measured selection
+    table picks the implementation for this exact shape (``mode`` selects
+    the forward-only vs forward+backward winner). Shapes are static under
+    jit, so the lookup is a trace-time decision baked into the compiled
+    program — exactly once per (shape, impl) compilation.
+    """
+    import numpy as _np
+
+    batch = int(_np.prod(angles.shape[:-1])) if angles.ndim > 1 else 1
+    backend = resolve_impl(impl, backend, n_qubits, n_layers, batch, mode=mode)
     if backend == "dense":
         # Closed-form embedding: the RY-embedded state is a REAL product
         # state (sv.ry_product_state), so the whole circuit is two real
@@ -154,19 +206,19 @@ def run_circuit(
         from qdml_tpu.quantum.sharded import run_circuit_sharded
 
         return run_circuit_sharded(angles, weights, n_qubits, n_layers)
+    if backend in ("pallas_circuit", "pallas_tensor"):
+        # Whole-circuit VMEM-resident kernel: in-kernel embedding + L-layer
+        # rotation/entangler chain in ONE pallas_call per batch tile, adjoint
+        # backward (pallas_kernels.fused_circuit_expvals). Replaces the v1
+        # per-layer kernel loop, which launched 2L pallas_calls per circuit
+        # and bounced the statevector through HBM between every layer.
+        from qdml_tpu.quantum.pallas_kernels import fused_circuit_expvals
+
+        return fused_circuit_expvals(angles, weights, n_qubits, n_layers)
     psi = sv.zero_state(n_qubits, angles.shape[:-1])
     psi = angle_embed(psi, angles, n_qubits)
     if backend == "tensor":
         psi = apply_ansatz_tensor(psi, weights, n_qubits, n_layers)
-    elif backend == "pallas_tensor":
-        # Per-layer fused rotation kernel + ring permutation; scales past the
-        # dense path's 2^n x 2^n unitary (n ~ 10-14 single-chip).
-        from qdml_tpu.quantum.pallas_kernels import apply_rotation_layer
-
-        ring = jnp.asarray(sv.ring_cnot_perm(n_qubits))
-        for l in range(n_layers):
-            psi = apply_rotation_layer(psi, weights[l], n_qubits)
-            psi = sv.apply_perm(psi, ring)
     else:
         raise ValueError(f"unknown backend {backend!r}; want one of {VALID_BACKENDS}")
     return sv.expvals_z(psi, n_qubits)

@@ -14,10 +14,17 @@ bit masks — the embedded statevector never exists in HBM, the duplicated
 layout fills all 128 lanes at the shipped 6-qubit shape (no padding waste),
 and the real LHS needs two matmuls' work, not a complex product's four.
 
+The v2 engine adds ``fused_circuit_expvals``: the ENTIRE L-layer circuit
+(embedding, rotations, ring CNOTs, <Z>) in one VMEM-resident kernel with an
+in-kernel ``fori_loop`` over layers — one launch instead of the per-layer
+path's 2L, no HBM statevector round-trips between layers, optional bf16
+amplitudes, and an adjoint-style backward that re-materializes each layer's
+input by reverse rotation from the saved final state (O(1)-in-L memory).
 Two further kernels are retained: ``fused_unitary_expvals`` (the round-2
-psi-input formulation, kept as the benchmarking baseline the whole-circuit
-kernel is measured against) and ``apply_rotation_layer`` (per-layer fusion
-for the larger-n ``pallas_tensor`` path).
+psi-input formulation, kept as the benchmarking baseline) and
+``apply_rotation_layer`` (the v1 per-layer fusion, kept as a tested
+primitive; production dispatch goes through the whole-circuit kernels via
+the autotuner — ``qdml_tpu.quantum.autotune``, docs/QUANTUM.md).
 
 Gradients are provided by ``jax.custom_vjp``s whose backward passes are plain
 XLA matmul/gate algebra (matmuls are what the MXU does best either way; the
@@ -57,7 +64,12 @@ def _pad_to(x: jnp.ndarray, axis: int, size: int) -> jnp.ndarray:
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # one config-driven knob for every kernel (QDML_PALLAS_INTERPRET) — the
+    # per-module backend sniffing this used to be is consolidated in
+    # utils.platform so eager/jit/interpret selection stays uniform
+    from qdml_tpu.utils.platform import pallas_interpret
+
+    return pallas_interpret()
 
 
 def _fused_kernel(ar_ref, ai_ref, br_ref, bi_ref, z_ref, out_ref):
@@ -289,6 +301,281 @@ def fused_qsc_expvals(angles: jnp.ndarray, u: CArr, n_qubits: int) -> jnp.ndarra
     a2 = angles.reshape(-1, n_qubits)
     z = jnp.asarray(sv.z_signs(n_qubits))
     ev = _qsc_expvals(a2, u.re.T, u.im.T, z, n_qubits)
+    return ev.reshape(lead + (n_qubits,))
+
+
+# ---------------------------------------------------------------------------
+# Whole-circuit multi-layer kernel: the VMEM-resident L-layer chain
+# ---------------------------------------------------------------------------
+# The per-layer fusion below (apply_rotation_layer) still round-trips the
+# (B, 2^n) statevector through HBM once per layer — 2L pallas_call launches
+# per circuit plus the XLA ring-permutation gathers between them. This kernel
+# runs the ENTIRE circuit in one pallas_call per batch tile: the RY product-
+# state embedding is built in kernel from the (tile, n) angles, an in-kernel
+# ``fori_loop`` walks all L layers (roll-based RY/RZ rotations + the ring
+# CNOTs as XOR-partner selects) with the statevector tile pinned in VMEM the
+# whole way, and the <Z> contraction happens before anything leaves the chip.
+# HBM traffic per tile drops from ~2L statevector round-trips to one angles
+# read + one (state, expvals) write; Mosaic's grid pipeline double-buffers the
+# tile DMA (batch is padded ONCE, up front, to the tile multiple).
+#
+# Amplitudes may optionally be carried in bfloat16 (halved VMEM residency and
+# vector-op width at ~2x the per-gate rounding); the final |.|^2 <Z>
+# contraction always accumulates in float32 on the MXU.
+#
+# The backward is adjoint-style (Qandle's reversibility argument applied to
+# AD): the forward saves ONLY the final statevector, and the backward
+# re-materializes each layer's input by applying the INVERSE gates to it
+# (RZ(-w), RY(-w), inverse ring permutation) while propagating the cotangent
+# through the per-layer vjp — O(1)-in-L memory instead of the L statevector
+# residuals per-layer AD would store.
+
+# Amplitude-axis bounds for the kernel: the XOR-partner rolls need the
+# amplitude axis to BE the lane axis (>= one 128-lane tile, n >= 7); past
+# dim=4096 (n=12) the (dim, 128) sign matrix plus double-buffered state tiles
+# crowd the ~16 MB VMEM budget — and from ~14 qubits the statevector should be
+# mesh-sharded anyway (quantum/sharded.py).
+_CIRCUIT_MIN_DIM = _LANES
+_CIRCUIT_MAX_DIM = 4096
+# VMEM budget steering the batch-tile size: re+im tiles (amp dtype) plus the
+# pipeline's double buffering must fit comfortably under the per-core budget.
+_CIRCUIT_VMEM_TILE_BYTES = 2 * 1024 * 1024
+
+
+def _circuit_tile_b(batch: int, dim: int, amp_bytes: int) -> int:
+    """Batch-tile height: sublane-aligned (16 for bf16 amplitudes, 8 for
+    f32 — the dtype's min tile), VMEM-budgeted, batch-bounded."""
+    sub = 16 if amp_bytes == 2 else 8
+    cap = max(sub, _CIRCUIT_VMEM_TILE_BYTES // (2 * dim * amp_bytes))
+    cap = min(128, (cap // sub) * sub)
+    return min(cap, max(sub, ((batch + sub - 1) // sub) * sub))
+
+
+def _circuit_kernel(
+    ang_ref, cs_ref, z2_ref, out_ref, re_ref, im_ref, *, n: int, layers: int, bf16: bool
+):
+    """One batch tile, full circuit: embed -> L x (rotations + ring) -> <Z>.
+
+    ``cs_ref`` (SMEM, (layers, n, 4)): per-gate (cos, sin) of the RY and RZ
+    half-angles, precomputed on host — the kernel reads scalars, never
+    recomputes weight trig per tile. The layer walk is a ``fori_loop`` so the
+    program is O(1) in L; the per-qubit gate chain inside one layer is a
+    static Python loop (n is a compile-time constant).
+    """
+    dim = 1 << n
+    amp_dtype = jnp.bfloat16 if bf16 else jnp.float32
+    half = 0.5 * ang_ref[:]
+    c = jnp.cos(half)
+    s = jnp.sin(half)
+    tile_b = out_ref.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tile_b, dim), 1)
+    # RY product-state embedding from lane-iota bit masks (real, in VMEM —
+    # the embedded statevector never exists in HBM)
+    amp = jnp.ones((tile_b, dim), jnp.float32)
+    for q in range(n):
+        bit = (lane >> (n - 1 - q)) & 1
+        amp = amp * jnp.where(bit == 1, s[:, q : q + 1], c[:, q : q + 1])
+    ar = amp.astype(amp_dtype)
+    ai = jnp.zeros((tile_b, dim), amp_dtype)
+
+    def one_layer(l, carry):
+        ar, ai = carry
+        for q in range(n):
+            m = 1 << (n - 1 - q)
+            bit = (lane >> (n - 1 - q)) & 1
+            sgn = jnp.where(bit == 1, 1.0, -1.0).astype(amp_dtype)
+            # XOR-partner exchange: two lane rolls + iota-mask select (the
+            # Mosaic-friendly formulation; wrap-around only ever lands on
+            # positions of the opposite bit, which take the other branch)
+            pr = jnp.where(bit == 0, pltpu.roll(ar, dim - m, 1), pltpu.roll(ar, m, 1))
+            pi = jnp.where(bit == 0, pltpu.roll(ai, dim - m, 1), pltpu.roll(ai, m, 1))
+            cy = cs_ref[l, q, 0].astype(amp_dtype)
+            sy = cs_ref[l, q, 1].astype(amp_dtype)
+            br = cy * ar + sgn * sy * pr
+            bi = cy * ai + sgn * sy * pi
+            cz = cs_ref[l, q, 2].astype(amp_dtype)
+            sz = cs_ref[l, q, 3].astype(amp_dtype)
+            ar = cz * br - sgn * sz * bi
+            ai = cz * bi + sgn * sz * br
+        # entangling ring: CNOT(i, i+1) for i < n-1, then CNOT(n-1, 0) —
+        # each as a control-masked XOR-partner select on the target bit
+        for ctl in range(n):
+            tgt = (ctl + 1) % n
+            mt = 1 << (n - 1 - tgt)
+            cbit = (lane >> (n - 1 - ctl)) & 1
+            tbit = (lane >> (n - 1 - tgt)) & 1
+            pr = jnp.where(tbit == 0, pltpu.roll(ar, dim - mt, 1), pltpu.roll(ar, mt, 1))
+            pi = jnp.where(tbit == 0, pltpu.roll(ai, dim - mt, 1), pltpu.roll(ai, mt, 1))
+            ar = jnp.where(cbit == 1, pr, ar)
+            ai = jnp.where(cbit == 1, pi, ai)
+        return ar, ai
+
+    ar, ai = jax.lax.fori_loop(0, layers, one_layer, (ar, ai))
+    arf = ar.astype(jnp.float32)
+    aif = ai.astype(jnp.float32)
+    re_ref[:] = arf
+    im_ref[:] = aif
+    # f32 MXU accumulation regardless of the amplitude dtype
+    out_ref[:] = jnp.dot(arf * arf + aif * aif, z2_ref[:], preferred_element_type=jnp.float32)
+
+
+def _xla_circuit(angles: jnp.ndarray, weights: jnp.ndarray, n: int, layers: int):
+    """XLA twin with identical math (embed -> gates -> ring -> <Z>), returning
+    ``(expvals, final_re, final_im)`` like the kernel path. Small/huge dims
+    fall back here, and the adjoint backward's per-layer vjp reuses its
+    building blocks."""
+    amp = sv.ry_product_state(angles, n)
+    psi = CArr(amp, jnp.zeros_like(amp))
+    ring = jnp.asarray(sv.ring_cnot_perm(n))
+    for l in range(layers):
+        for q in range(n):
+            psi = sv.apply_ry(psi, n, q, weights[l, q, 0])
+            psi = sv.apply_rz(psi, n, q, weights[l, q, 1])
+        psi = sv.apply_perm(psi, ring)
+    return sv.expvals_z(psi, n), psi.re, psi.im
+
+
+def _circuit_forward(angles: jnp.ndarray, weights: jnp.ndarray, n: int, layers: int, bf16: bool):
+    """angles (B, n), weights (layers, n, 2) -> (expvals (B, n), final state)."""
+    dim = 1 << n
+    if not (_CIRCUIT_MIN_DIM <= dim <= _CIRCUIT_MAX_DIM) or layers < 1:
+        return _xla_circuit(angles, weights, n, layers)
+    batch = angles.shape[0]
+    amp_bytes = 2 if bf16 else 4
+    tile_b = _circuit_tile_b(batch, dim, amp_bytes)
+    batch_p = ((batch + tile_b - 1) // tile_b) * tile_b  # pad ONCE, up front
+    ang = _pad_to(angles, 0, batch_p)
+
+    half = weights / 2.0
+    cs = jnp.stack(
+        [
+            jnp.cos(half[..., 0]),
+            jnp.sin(half[..., 0]),
+            jnp.cos(half[..., 1]),
+            jnp.sin(half[..., 1]),
+        ],
+        axis=-1,
+    )  # (layers, n, 4) f32 scalars for SMEM
+    n_p = ((n + _LANES - 1) // _LANES) * _LANES
+    z2 = jnp.zeros((dim, n_p), jnp.float32)
+    z2 = jax.lax.dynamic_update_slice(z2, jnp.asarray(sv.z_signs(n)), (0, 0))
+
+    state_spec = pl.BlockSpec((tile_b, dim), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    ev, fre, fim = pl.pallas_call(
+        partial(_circuit_kernel, n=n, layers=layers, bf16=bf16),
+        grid=(batch_p // tile_b,),
+        in_specs=[
+            pl.BlockSpec((tile_b, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((layers, n, 4), lambda i: (0, 0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((dim, n_p), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_b, n_p), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            state_spec,
+            state_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch_p, n_p), jnp.float32),
+            jax.ShapeDtypeStruct((batch_p, dim), jnp.float32),
+            jax.ShapeDtypeStruct((batch_p, dim), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(ang, cs, z2)
+    return ev[:batch, :n], fre[:batch], fim[:batch]
+
+
+def _apply_layer_fwd(pre, pim, w_l, n: int, ring):
+    """One layer's forward on a (B, dim) real pair — the function whose vjp
+    the adjoint backward evaluates at the re-materialized layer input."""
+    psi = CArr(pre, pim)
+    for q in range(n):
+        psi = sv.apply_ry(psi, n, q, w_l[q, 0])
+        psi = sv.apply_rz(psi, n, q, w_l[q, 1])
+    psi = sv.apply_perm(psi, ring)
+    return psi.re, psi.im
+
+
+def _undo_layer(psi: CArr, w_l: jnp.ndarray, n: int, inv_ring) -> CArr:
+    """Exact inverse of :func:`_apply_layer_fwd`: inverse ring permutation,
+    then RZ(-w)/RY(-w) in reverse gate order — the reverse rotation that
+    re-materializes the layer's input from its output."""
+    psi = sv.apply_perm(psi, inv_ring)
+    for q in reversed(range(n)):
+        psi = sv.apply_rz(psi, n, q, -w_l[q, 1])
+        psi = sv.apply_ry(psi, n, q, -w_l[q, 0])
+    return psi
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _circuit_expvals(angles, weights, n, layers, bf16):
+    ev, _fre, _fim = _circuit_forward(angles, weights, n, layers, bf16)
+    return ev
+
+
+def _circuit_fwd(angles, weights, n, layers, bf16):
+    ev, fre, fim = _circuit_forward(angles, weights, n, layers, bf16)
+    # residuals: inputs + FINAL statevector only — never the per-layer chain
+    return ev, (angles, weights, fre, fim)
+
+
+def _circuit_bwd(n, layers, bf16, res, g):
+    """Adjoint backward: walk the layers in reverse, re-materializing each
+    layer's input statevector by reverse rotation from the saved final state
+    and pushing the cotangent through the per-layer vjp. Memory is O(2^n)
+    regardless of L (vs the L+1 statevectors plain AD would hold)."""
+    angles, weights, fre, fim = res
+    z = jnp.asarray(sv.z_signs(n))
+    dprobs = g @ z.T
+    lam = CArr(2.0 * fre * dprobs, 2.0 * fim * dprobs)
+    psi = CArr(fre, fim)
+    ring_np = sv.ring_cnot_perm(n)
+    ring = jnp.asarray(ring_np)
+    inv_ring = jnp.asarray(np.argsort(ring_np))
+    dws = []
+    for l in reversed(range(layers)):
+        psi_in = _undo_layer(psi, weights[l], n, inv_ring)
+        _, layer_vjp = jax.vjp(
+            lambda pre, pim, w_l: _apply_layer_fwd(pre, pim, w_l, n, ring),
+            psi_in.re,
+            psi_in.im,
+            weights[l],
+        )
+        lre, lim, dw_l = layer_vjp((lam.re, lam.im))
+        lam = CArr(lre, lim)
+        dws.append(dw_l)
+        psi = psi_in
+    dweights = jnp.stack(dws[::-1]) if dws else jnp.zeros_like(weights)
+    # embedding cotangent: the embedded state is REAL and its imaginary part
+    # is identically zero independent of the angles, so only lam.re flows
+    _, embed_vjp = jax.vjp(lambda a: sv.ry_product_state(a, n), angles)
+    (dangles,) = embed_vjp(lam.re)
+    return dangles, dweights
+
+
+_circuit_expvals.defvjp(_circuit_fwd, _circuit_bwd)
+
+
+def fused_circuit_expvals(
+    angles: jnp.ndarray,
+    weights: jnp.ndarray,
+    n_qubits: int,
+    n_layers: int,
+    bf16_amps: bool = False,
+) -> jnp.ndarray:
+    """Full reference circuit — AngleEmbedding + L x (RY/RZ rotations + ring
+    CNOTs) + per-wire <Z> — as ONE VMEM-resident pallas_call per batch tile.
+
+    Unlike :func:`fused_qsc_expvals` (which needs the precompiled
+    ``(2^n, 2^n)`` ansatz unitary and tops out around n=8), this path never
+    builds the dense unitary: it walks the gate chain in kernel, so it scales
+    with the per-layer tensor path (n ~ 7-12 single-chip) while paying ONE
+    launch instead of 2L. Outside the kernel's lane/VMEM window it falls back
+    to the mathematically identical XLA twin. ``bf16_amps`` carries the
+    statevector in bfloat16 (f32 accumulation for the <Z> contraction).
+    """
+    lead = angles.shape[:-1]
+    a2 = angles.reshape(-1, n_qubits)
+    ev = _circuit_expvals(a2, weights, n_qubits, n_layers, bool(bf16_amps))
     return ev.reshape(lead + (n_qubits,))
 
 

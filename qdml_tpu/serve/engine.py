@@ -72,6 +72,7 @@ class ServeEngine:
                 n_layers=cfg.quantum.n_layers,
                 n_classes=cfg.quantum.n_classes,
                 backend=cfg.quantum.backend,
+                impl=cfg.quantum.impl,
                 input_norm=cfg.quantum.input_norm,
             )
         else:
@@ -90,6 +91,10 @@ class ServeEngine:
         # per-bucket XLA cost records (flops/bytes/peak memory/roofline),
         # filled by warmup from each AOT-compiled executable
         self.bucket_cost: dict[str, dict] = {}
+        # quantum classifier only: the circuit implementation each bucket's
+        # AOT executable dispatches (autotuned at warmup — docs/QUANTUM.md),
+        # plus the candidate timings when the tuner actually ran
+        self.quantum_impl: dict[str, Any] = {}
 
     # -- construction -------------------------------------------------------
 
@@ -187,6 +192,26 @@ class ServeEngine:
         hw = self.cfg.image_hw
         for b in self.buckets:
             with span("serve_warmup_bucket", bucket=b):
+                if self.quantum:
+                    # Autotune at AOT-bucket compile time, NEVER on the
+                    # request path: the tuner's own jits land inside the
+                    # warmup window (the compile-gate snapshot is taken after
+                    # this loop), and the lower() below bakes the measured
+                    # winner into the bucket's executable.
+                    from qdml_tpu.quantum import autotune
+                    from qdml_tpu.quantum.circuits import resolve_impl
+
+                    q = self.cfg.quantum
+                    entry = autotune.prewarm(self.cfg, batch=b)
+                    rec_impl: dict[str, Any] = {
+                        "impl": resolve_impl(
+                            q.impl, q.backend, q.n_qubits, q.n_layers, b, mode="infer"
+                        )
+                    }
+                    if entry is not None:
+                        rec_impl["autotuned"] = True
+                        rec_impl["candidates"] = entry["candidates"]
+                    self.quantum_impl[str(b)] = rec_impl
                 x_spec = jax.ShapeDtypeStruct((b, *hw, 2), jnp.float32)
                 compiled = jax.jit(fwd).lower(*var_specs, x_spec).compile()
                 # first execute outside the request path (XLA may lazily
@@ -212,11 +237,14 @@ class ServeEngine:
         # their true run totals). request_path_compiles() diffs against this.
         self._stats0 = post
         self._warm = True
-        return {
+        out = {
             "buckets": self.buckets,
             "compile": {k: post[k] - pre.get(k, 0) for k in post},
             "cost": self.bucket_cost,
         }
+        if self.quantum_impl:
+            out["quantum_impl"] = self.quantum_impl
+        return out
 
     def request_path_compiles(self) -> dict:
         """Compile-cache counter deltas since warmup ended — all-zero iff

@@ -45,6 +45,8 @@ def effective_knobs(cfg: Any) -> dict:
         "model_dtype": cfg.model.dtype,
         "conv_impl": cfg.model.conv_impl,
         "quantum_backend": cfg.quantum.backend,
+        "quantum_impl": cfg.quantum.impl,
+        "quantum_autotune": cfg.quantum.autotune,
         "mesh": {
             "data_axis": cfg.mesh.data_axis,
             "model_axis": cfg.mesh.model_axis,

@@ -23,7 +23,22 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any
+
+# Per-implementation QSC sub-benches (qsc_dense, qsc_pallas, ... — NOT the
+# scan-fused variants, which measure a different program). These are
+# implementation-race entrants, not independent workloads: the gate compares
+# best-of-impls on each side, so a fixed impl losing ground (or being
+# retired) cannot fail CI while a faster dispatch is available — the exact
+# "gating on a losing fixed impl" failure the autotuned dispatcher removes.
+# qsc_auto is deliberately NOT demoted: the auto-dispatched path IS the
+# train/serve hot path, so a qsc_auto regression (e.g. a stale table
+# dispatching a loser while a fixed impl still measures fast) must fail the
+# gate like any other hot-path metric — it still feeds best-of-impls too.
+_QSC_IMPL_RE = re.compile(r"^qsc_(?!auto\.)(?!.*scan)[^.]+\.samples_per_sec$")
+_QSC_BEST_RE = re.compile(r"^qsc_(?!.*scan)[^.]+\.samples_per_sec$")
+QSC_BEST_KEY = "qsc.best_of_impls"
 
 EXIT_OK = 0
 EXIT_USAGE = 2
@@ -146,6 +161,12 @@ def extract(path: str) -> dict:
                 src["throughput"][f"{key}.samples_per_sec"] = float(d["samples_per_sec"])
             if isinstance(d.get("cost"), dict):
                 src["cost"][key] = d["cost"]
+    # Synthesized best-of-impls QSC metric: the regression gate for the
+    # quantum classifier compares the fastest implementation measured on each
+    # side (the per-impl rows stay in the table, informational).
+    impl_vals = [v for k, v in src["throughput"].items() if _QSC_BEST_RE.match(k)]
+    if impl_vals:
+        src["throughput"][QSC_BEST_KEY] = max(impl_vals)
     return src
 
 
@@ -403,6 +424,20 @@ def build_report_data(
             lines.append(f"| {key} | {b:g} | {c:g} | — | zero-baseline |")
             continue
         if delta_pct < -threshold_pct:
+            if _QSC_IMPL_RE.match(key):
+                # one entrant of the QSC implementation race slowed down;
+                # the gate judges the race's winner (qsc.best_of_impls), so
+                # a losing fixed impl can no longer fail CI by itself
+                gates.append(
+                    {"metric": key, "kind": "throughput", "baseline": b,
+                     "current": c, "delta_pct": round(delta_pct, 2),
+                     "status": "informational"}
+                )
+                lines.append(
+                    f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | "
+                    "informational (best-of-impls gates QSC) |"
+                )
+                continue
             status_key, status_md = "regression", "**REGRESSION**"
             # Perf regression vs program change: when the regressed
             # sub-bench's own XLA cost moved too, the slowdown is (at least

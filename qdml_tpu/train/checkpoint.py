@@ -45,6 +45,9 @@ def reconcile_quantum_cfg(cfg, meta: dict):
         return cfg
     stored = dict(stored)
     trained_backend = stored.pop("backend", None)
+    # like backend, the dispatcher override is an execution strategy, not an
+    # architecture fact — provenance only, never folded into the eval config
+    stored.pop("impl", None)
     n_q = stored.get("n_qubits", cfg.quantum.n_qubits)
     if trained_backend is not None:
         # Compare RESOLVED execution paths: with "auto" in play, the stored

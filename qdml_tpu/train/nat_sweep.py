@@ -56,6 +56,7 @@ def build_sweep_model(cfg: ExperimentConfig) -> QSCP128:
         n_classes=cfg.quantum.n_classes,
         use_quantumnat=False,
         backend=cfg.quantum.backend,
+        impl=cfg.quantum.impl,
         input_norm=cfg.quantum.input_norm,
     )
 
@@ -204,6 +205,23 @@ def train_nat_sweep(
     model, tx, params, opt_state, sigmas = init_sweep(
         cfg, noise_levels, train_loader.steps_per_epoch
     )
+    # Autotuned circuit dispatch, same contract as train_classifier: tune at
+    # this run's flattened-grid circuit batch BEFORE the vmapped step traces
+    # (the ensemble axis batches the same per-member shape; the table keys on
+    # the member shape the dispatcher resolves at trace time).
+    from qdml_tpu.quantum import autotune
+
+    at_entry = autotune.prewarm(
+        cfg, batch=cfg.data.n_scenarios * cfg.data.n_users * cfg.train.batch_size
+    )
+    if at_entry is not None:
+        logger.log(
+            kind="quantum_autotune",
+            key=at_entry["key"],
+            impl=at_entry["best_train"],
+            impl_infer=at_entry["best_fwd"],
+            candidates=at_entry["candidates"],
+        )
     probes_on = cfg.train.probe_every > 0  # 0 compiles the probes out
     train_step = make_sweep_train_step(
         model, tx, probes=probes_on, checkify_errors=cfg.train.checkify

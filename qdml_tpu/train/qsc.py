@@ -48,6 +48,7 @@ def build_classifier(cfg: ExperimentConfig, quantum: bool) -> nn.Module:
             use_quantumnat=cfg.quantum.use_quantumnat,
             noise_level=cfg.quantum.noise_level,
             backend=cfg.quantum.backend,
+            impl=cfg.quantum.impl,
             input_norm=cfg.quantum.input_norm,
         )
     return SCP128(n_classes=cfg.quantum.n_classes)
@@ -182,6 +183,26 @@ def train_classifier(
     train_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "train", geom)
     val_loader = DMLGridLoader(cfg.data, cfg.train.batch_size, "val", geom)
     model, state = init_sc_state(cfg, quantum, train_loader.steps_per_epoch)
+    if quantum:
+        # Autotuned circuit dispatch (docs/QUANTUM.md): time the eligible
+        # implementations at THIS run's exact circuit shape before the step
+        # compiles, so the trace below bakes in the measured winner instead
+        # of a static guess. The grid flattens into one batch inside the
+        # step, so the circuit batch is the whole grid. No-op when the
+        # dispatcher is overridden or tuning is off for this platform.
+        from qdml_tpu.quantum import autotune
+
+        entry = autotune.prewarm(
+            cfg, batch=cfg.data.n_scenarios * cfg.data.n_users * cfg.train.batch_size
+        )
+        if entry is not None:
+            logger.log(
+                kind="quantum_autotune",
+                key=entry["key"],
+                impl=entry["best_train"],
+                impl_infer=entry["best_fwd"],
+                candidates=entry["candidates"],
+            )
     needs_rng = quantum and cfg.quantum.use_quantumnat
     probes_on = cfg.train.probe_every > 0  # 0 compiles the probes out
     train_step = make_sc_train_step(
@@ -302,6 +323,9 @@ def train_classifier(
                     "backend": resolve_backend(
                         cfg.quantum.backend, cfg.quantum.n_qubits
                     ),
+                    # dispatcher provenance (execution strategy, reconcile
+                    # pops it like backend): "auto" = autotuned per shape
+                    "impl": cfg.quantum.impl,
                     "input_norm": cfg.quantum.input_norm,
                 }
                 # provenance, not architecture (reconcile ignores it): which

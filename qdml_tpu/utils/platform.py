@@ -40,6 +40,30 @@ def backend_initialized() -> bool:
         return False
 
 
+def pallas_interpret() -> bool:
+    """One knob for Pallas interpret-mode selection across every kernel.
+
+    Each kernel module used to sniff the backend for itself; this is the
+    single config-driven home for that decision so eager/jit/interpret
+    selection cannot drift between kernels. ``QDML_PALLAS_INTERPRET``:
+
+    - ``auto`` (default/unset): interpret off-TPU (the CPU test suite runs
+      the kernels through the Pallas interpreter), compiled Mosaic on TPU;
+    - ``1``/``true``/``on``: force interpret everywhere (kernel debugging on
+      a real TPU without losing the device);
+    - ``0``/``false``/``off``: never interpret (fail loudly off-TPU instead
+      of silently benchmarking the interpreter).
+    """
+    mode = os.environ.get("QDML_PALLAS_INTERPRET", "auto").strip().lower()
+    if mode in ("1", "true", "on", "yes"):
+        return True
+    if mode in ("0", "false", "off", "no"):
+        return False
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
 def donation_argnums(*argnums: int) -> tuple[int, ...]:
     """``donate_argnums`` for a train step, or ``()`` where donation is a
     no-op. Donating the train state lets XLA update params/optimizer

@@ -7,6 +7,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
 
 _FROZEN = (1, 2, 3)  # immutable module constant: fine to close over
 HOST_TABLE = np.zeros((4,))  # numpy at import time is host-only: fine
@@ -80,6 +81,18 @@ class GoodLoop:
         for r, res in zip(batch, results):
             r.future.set_result(res)
         return True
+
+
+def pallas_single_launch(x, kernel):
+    # ONE pallas_call, interpret routed through the shared config knob, the
+    # layer loop INSIDE the kernel (fori_loop): the pallas-host-loop /
+    # pallas-interpret-literal rules' legitimate twin
+    from qdml_tpu.utils.platform import pallas_interpret
+
+    def body(ref, out_ref):
+        out_ref[:] = jax.lax.fori_loop(0, 4, lambda i, a: a * 2.0, ref[:])
+
+    return pl.pallas_call(body, out_shape=x, interpret=pallas_interpret())(x)
 
 
 def inspect_and_reraise():

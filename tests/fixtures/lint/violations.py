@@ -7,6 +7,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 _CACHE = {}  # module-level mutable state
 
@@ -100,6 +101,19 @@ def swallow_interrupts():
         run_training()  # noqa: F821
     except BaseException:
         return None
+
+
+def pallas_loop_over_layers(x, kernel, n_layers):
+    # pallas-host-loop: one kernel launch per layer, HBM round-trip between —
+    # the v1 per-layer circuit shape the VMEM-resident kernel replaced
+    for _ in range(n_layers):
+        x = pl.pallas_call(kernel, out_shape=x)(x)
+    return x
+
+
+def pallas_interpret_left_on(x, kernel):
+    # pallas-interpret-literal: hardcoded interpreter, TPU included
+    return pl.pallas_call(kernel, out_shape=x, interpret=True)(x)
 
 
 IMPORT_TIME_ARRAY = jnp.zeros((4,))  # import-time-jnp: device alloc on import

@@ -49,6 +49,8 @@ def test_violation_fixture_trips_every_rule():
     assert rules["stranded-future"] == 1
     assert rules["broad-except"] == 2              # Exception + BaseException
     assert rules["import-time-jnp"] == 1
+    assert rules["pallas-host-loop"] == 1          # per-layer launch loop
+    assert rules["pallas-interpret-literal"] == 1  # hardcoded interpret=True
     # every finding carries a usable anchor
     for f in findings:
         assert f.path.endswith("violations.py") and f.line > 0 and f.message

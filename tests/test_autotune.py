@@ -1,0 +1,244 @@
+"""Autotuned quantum-impl dispatcher (qdml_tpu/quantum/autotune.py):
+selection-table round-trip, corrupt/missing-table dense fallback, override
+precedence, tuner gating, and the serve-warmup zero-request-path-compiles
+guarantee with autotuning enabled (compile-cache counters, as in PR 2)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from qdml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    QuantumConfig,
+    ServeConfig,
+    TrainConfig,
+)
+from qdml_tpu.quantum import autotune
+from qdml_tpu.quantum.circuits import resolve_impl
+
+
+@pytest.fixture(autouse=True)
+def _isolated_table(tmp_path, monkeypatch):
+    """Every test gets its own table file and a cold in-process cache."""
+    monkeypatch.setenv(autotune.ENV_TABLE, str(tmp_path / "qsc_impl.json"))
+    autotune.invalidate_cache()
+    yield
+    autotune.invalidate_cache()
+
+
+# ---------------------------------------------------------------------------
+# Table round-trip / fallback
+# ---------------------------------------------------------------------------
+
+
+def test_ensure_round_trips_manifest_headed_table():
+    entry = autotune.ensure(3, 2, 7, budget_s=0.05)
+    # bucketing: batch 7 -> bucket 8; the entry names what was measured
+    assert entry["batch_bucket"] == 8 and entry["n_qubits"] == 3
+    assert entry["best_train"] in entry["candidates"]
+    assert entry["best_fwd"] in entry["candidates"]
+    for rec in entry["candidates"].values():
+        assert ("fwd_ms" in rec and "train_ms" in rec) or "error" in rec
+    # persisted file is manifest-headed and reloads to the same selection
+    path = autotune.table_path()
+    with open(path) as fh:
+        data = json.load(fh)
+    assert data["kind"] == "qsc_autotune_table"
+    assert data["manifest"]["kind"] == "manifest"
+    autotune.invalidate_cache()
+    assert autotune.lookup(3, 2, 7) == entry["best_train"]
+    assert autotune.lookup(3, 2, 7, mode="infer") == entry["best_fwd"]
+    # a second ensure() is a cache hit, not a re-measurement
+    again = autotune.ensure(3, 2, 7, budget_s=0.05)
+    assert again["ts"] == entry["ts"]
+
+
+def test_missing_and_corrupt_table_fall_back_to_dense():
+    """lookup never raises; resolve_impl degrades to the dense fallback."""
+    # missing file
+    assert autotune.lookup(6, 3, 256) is None
+    assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"
+    # corrupt JSON
+    path = autotune.table_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("{definitely not json")
+    autotune.invalidate_cache()
+    assert autotune.lookup(6, 3, 256) is None
+    assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"
+    # structurally alien payloads and garbage winners are rejected too —
+    # including winners that are valid BACKEND strings but not dispatchable
+    # selections ("auto" would recurse; "sharded" needs a mesh the tuner
+    # never assumes)
+    for bad in ("not-a-backend", "auto", "sharded"):
+        with open(path, "w") as fh:
+            json.dump({"entries": {"cpu/n6/L3/b256/float32": {"best_train": bad}}}, fh)
+        autotune.invalidate_cache()
+        assert autotune.lookup(6, 3, 256) is None
+        assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"
+
+
+def test_impl_override_wins_over_table():
+    """quantum.impl (and the legacy backend) beat any table entry."""
+    import jax
+
+    key = autotune.table_key(jax.default_backend(), 6, 3, 256)
+    autotune.save_table({key: {"best_train": "pallas", "best_fwd": "pallas"}})
+    assert resolve_impl("auto", "auto", 6, 3, 256) == "pallas"  # table engaged
+    assert resolve_impl("tensor", "auto", 6, 3, 256) == "tensor"
+    assert resolve_impl("dense", "pallas", 6, 3, 256) == "dense"
+    assert resolve_impl("auto", "tensor", 6, 3, 256) == "tensor"
+
+
+def test_eligible_impls_by_shape():
+    assert autotune.eligible_impls(4, "cpu") == ["dense", "pallas"]
+    assert autotune.eligible_impls(7, "tpu") == ["dense", "pallas", "pallas_circuit"]
+    assert autotune.eligible_impls(10, "tpu") == ["dense", "pallas_circuit", "tensor"]
+    assert "sharded" not in autotune.eligible_impls(14, "tpu")
+
+
+def test_prewarm_gating():
+    """prewarm only tunes when the dispatcher is actually in play: impl and
+    backend both auto AND autotune enabled for this platform ("auto" means
+    off on the CPU test backend — tier-1 pays zero tuning compiles)."""
+    cfg = ExperimentConfig(quantum=QuantumConfig(n_qubits=3, n_layers=1))
+    assert autotune.prewarm(cfg, batch=8) is None  # autotune="auto" on cpu
+    cfg = ExperimentConfig(
+        quantum=QuantumConfig(n_qubits=3, n_layers=1, impl="dense", autotune="on")
+    )
+    assert autotune.prewarm(cfg, batch=8) is None  # impl forced
+    cfg = ExperimentConfig(
+        quantum=QuantumConfig(n_qubits=3, n_layers=1, backend="tensor", autotune="on")
+    )
+    assert autotune.prewarm(cfg, batch=8) is None  # legacy backend forced
+    cfg = ExperimentConfig(
+        quantum=QuantumConfig(n_qubits=3, n_layers=1, autotune="on")
+    )
+    entry = autotune.prewarm(cfg, batch=8)
+    assert entry is not None and entry["best_train"] in entry["candidates"]
+    # force=True re-measures even over the fresh entry (the bench contract:
+    # candidate timings must come from THIS window)
+    entry2 = autotune.prewarm(cfg, batch=8, force=True)
+    assert entry2["ts"] != entry["ts"]
+
+
+def test_prewarm_installs_configured_table_path(tmp_path):
+    """quantum.autotune_table must become the table the TRACE-TIME lookup
+    reads: the tuner writing one file while dispatch reads another would
+    silently pin the dense fallback after paying the full tuning cost."""
+    custom = str(tmp_path / "custom" / "table.json")
+    cfg = ExperimentConfig(
+        quantum=QuantumConfig(
+            n_qubits=3, n_layers=1, autotune="on", autotune_table=custom
+        )
+    )
+    entry = autotune.prewarm(cfg, batch=8)
+    assert os.path.exists(custom)
+    # the plain lookup (no path — exactly what circuits.resolve_impl does)
+    # now resolves against the configured table
+    assert autotune.lookup(3, 1, 8) == entry["best_train"]
+    assert resolve_impl("auto", "auto", 3, 1, 8) == entry["best_train"]
+
+
+# ---------------------------------------------------------------------------
+# Report gate: QSC compares best-of-impls, not a losing fixed impl
+# ---------------------------------------------------------------------------
+
+
+def _bench_artifact(path, **impl_sps):
+    rec = {
+        "metric": "hdce_train_samples_per_sec_per_chip",
+        "value": 100.0,
+        "platform": "cpu_fallback",
+        "details": {k: {"samples_per_sec": v} for k, v in impl_sps.items()},
+    }
+    path.write_text(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def test_report_qsc_gates_on_best_of_impls(tmp_path):
+    """A fixed impl losing ground (or a regressed loser) must not fail the
+    gate while the best-of-impls throughput held or improved — and the
+    synthesized qsc.best_of_impls row must itself gate."""
+    from qdml_tpu.telemetry.report import build_report_data
+
+    base = _bench_artifact(tmp_path / "base.json", qsc_dense=12.0, qsc_pallas=10.0)
+    # pallas collapsed, but the auto-dispatched path beats the old best
+    cur = _bench_artifact(
+        tmp_path / "cur.json", qsc_dense=12.0, qsc_pallas=5.0, qsc_auto=13.0
+    )
+    data = build_report_data([cur], base, threshold_pct=10.0)
+    by_metric = {g["metric"]: g for g in data["gates"]}
+    assert by_metric["qsc_pallas.samples_per_sec"]["status"] == "informational"
+    assert by_metric["qsc.best_of_impls"]["status"] == "ok"
+    assert not data["regressions"]
+
+    # every impl regressing DOES fail: best-of-impls is a real gate
+    cur2 = _bench_artifact(tmp_path / "cur2.json", qsc_dense=6.0, qsc_pallas=5.0)
+    data2 = build_report_data([cur2], base, threshold_pct=10.0)
+    assert any(r["metric"] == "qsc.best_of_impls" for r in data2["regressions"])
+    # the per-impl rows still never feed the regression list
+    assert not any("qsc_" in r["metric"] for r in data2["regressions"])
+
+
+def test_report_qsc_auto_regression_is_not_demoted(tmp_path):
+    """qsc_auto IS the hot path: a mis-dispatching autotuner (auto slow while
+    a fixed impl still measures fast, so best-of-impls stays green) must
+    fail the gate on the qsc_auto row itself."""
+    from qdml_tpu.telemetry.report import build_report_data
+
+    base = _bench_artifact(tmp_path / "base.json", qsc_dense=12.0, qsc_auto=12.5)
+    cur = _bench_artifact(tmp_path / "cur.json", qsc_dense=12.0, qsc_auto=7.0)
+    data = build_report_data([cur], base, threshold_pct=10.0)
+    assert any(r["metric"] == "qsc_auto.samples_per_sec" for r in data["regressions"])
+    by_metric = {g["metric"]: g for g in data["gates"]}
+    assert by_metric["qsc_auto.samples_per_sec"]["status"] == "regression"
+    # best-of still carried by the healthy fixed impl — and that is exactly
+    # why qsc_auto needs its own armed row
+    assert by_metric["qsc.best_of_impls"]["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Serve warmup: autotune at AOT-bucket compile time, zero request-path compiles
+# ---------------------------------------------------------------------------
+
+
+def test_serve_warmup_autotunes_with_zero_request_path_compiles():
+    """With quantum.impl=auto and the tuner forced ON, warmup runs the
+    micro-benchmark and AOT-compiles the winner per bucket — and the request
+    path still provably never compiles (the engine's own post-warmup
+    compile-cache snapshot, the PR-2 gate)."""
+    from qdml_tpu.serve import ServeEngine
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        quantum=QuantumConfig(n_qubits=3, n_layers=1, autotune="on"),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        serve=ServeConfig(max_batch=4, buckets=(4,), max_wait_ms=1.0, max_queue=32),
+    )
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, qsc_state = init_sc_state(cfg, quantum=True, steps_per_epoch=4)
+    engine = ServeEngine(cfg, hdce_vars, {"params": qsc_state.params}, quantum=True)
+    warm = engine.warmup()
+    # the warmup artifact names the impl each bucket's executable dispatches,
+    # with the tuner's candidate timings attached
+    assert warm["quantum_impl"]["4"]["impl"] in ("dense", "pallas", "tensor")
+    assert warm["quantum_impl"]["4"].get("autotuned") is True
+    assert "dense" in warm["quantum_impl"]["4"]["candidates"]
+    # the winner is the persisted table's infer-mode selection
+    assert warm["quantum_impl"]["4"]["impl"] == (
+        autotune.lookup(3, 1, 4, mode="infer") or "dense"
+    )
+    x = np.random.default_rng(0).standard_normal((3, *cfg.image_hw, 2)).astype(np.float32)
+    for _ in range(3):
+        h, pred, bucket = engine.infer(x)
+        assert h.shape[0] == 3 and bucket == 4
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}

@@ -147,6 +147,14 @@ def test_qsc_depolarizing_rejects_non_tensor_backend():
     model = QSCP128(n_qubits=4, n_layers=1, backend="dense", depolarizing_p=0.1)
     with pytest.raises(ValueError, match="cannot be honored"):
         model.init(jax.random.PRNGKey(0), x, train=False)
+    # an explicit impl='dense' is likewise unhonorable
+    model = QSCP128(n_qubits=4, n_layers=1, impl="dense", depolarizing_p=0.1)
+    with pytest.raises(ValueError, match="cannot be honored"):
+        model.init(jax.random.PRNGKey(0), x, train=False)
+    # but impl='tensor' WINS over a non-tensor legacy backend (resolve_impl
+    # precedence) — the trajectory simulator honors it, no error
+    model = QSCP128(n_qubits=4, n_layers=1, impl="tensor", backend="pallas", depolarizing_p=0.1)
+    model.init(jax.random.PRNGKey(0), x, train=False)
 
 
 def test_conv_impls_agree():

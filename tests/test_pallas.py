@@ -124,6 +124,111 @@ def test_ry_product_state_matches_angle_embed(n):
     np.testing.assert_allclose(np.asarray(want.im), 0.0, atol=1e-7)
 
 
+# ---------------------------------------------------------------------------
+# Whole-circuit multi-layer VMEM-resident kernel (v2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n,layers,batch",
+    [
+        (4, 2, 6),    # dim < 128: the XLA-twin fallback branch
+        (7, 3, 11),   # dim == 128: smallest shape engaging the Mosaic kernel,
+                      # batch 11 forces sublane padding (pad-once tiling)
+        (7, 1, 16),   # single layer: fori_loop boundary
+    ],
+)
+def test_fused_circuit_matches_tensor(n, layers, batch):
+    """Values: one-pallas_call L-layer kernel == gate-wise statevector
+    reference (interpret mode on the CPU suite; compiled Mosaic on TPU)."""
+    angles, w = _rand_inputs(n, layers, batch, seed=n + layers)
+    want = run_circuit(angles, w, n, layers, "tensor")
+    got = run_circuit(angles, w, n, layers, "pallas_circuit")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_circuit_gradients_match():
+    """Gradients: the adjoint backward (reverse-rotation re-materialization
+    from the saved FINAL state only) == AD through the gate chain, for both
+    weights and embedding angles, on the kernel-engaging shape."""
+    n, layers, batch = 7, 2, 9
+    angles, w = _rand_inputs(n, layers, batch, seed=5)
+
+    def loss(backend):
+        return lambda w_, a_: jnp.sum(run_circuit(a_, w_, n, layers, backend) ** 2)
+
+    gw_ref, ga_ref = jax.grad(loss("tensor"), argnums=(0, 1))(w, angles)
+    gw, ga = jax.grad(loss("pallas_circuit"), argnums=(0, 1))(w, angles)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(ga_ref), rtol=1e-3, atol=1e-5)
+
+
+def test_fused_circuit_bf16_amplitudes():
+    """bf16 statevector residency: values track the f32 reference to bf16
+    tolerance, gradients stay finite and directionally consistent (the <Z>
+    contraction accumulates in f32 regardless)."""
+    from qdml_tpu.quantum.pallas_kernels import fused_circuit_expvals
+
+    n, layers, batch = 7, 2, 12
+    angles, w = _rand_inputs(n, layers, batch, seed=8)
+    want = np.asarray(run_circuit(angles, w, n, layers, "tensor"))
+    got = np.asarray(fused_circuit_expvals(angles, w, n, layers, bf16_amps=True))
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.03)
+
+    gw = jax.grad(
+        lambda w_: jnp.sum(fused_circuit_expvals(angles, w_, n, layers, bf16_amps=True) ** 2)
+    )(w)
+    gw_ref = jax.grad(
+        lambda w_: jnp.sum(run_circuit(angles, w_, n, layers, "tensor") ** 2)
+    )(w)
+    assert np.all(np.isfinite(np.asarray(gw)))
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref), rtol=0.2, atol=0.05)
+
+
+def test_fused_circuit_lead_shape_and_jit():
+    """Extra lead dims survive the reshape/pad path, under jit."""
+    n, layers = 7, 2
+    rng = np.random.default_rng(13)
+    angles = jnp.asarray(rng.uniform(-2, 2, (2, 5, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(0, 6, (layers, n, 2)).astype(np.float32))
+    f = jax.jit(lambda a, w_: run_circuit(a, w_, n, layers, "pallas_circuit"))
+    got = f(angles, w)
+    assert got.shape == (2, 5, n)
+    want = run_circuit(angles, w, n, layers, "tensor")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_tensor_alias_routes_to_circuit_kernel():
+    """The deprecated pre-v2 backend name keeps working and produces the
+    whole-circuit kernel's numbers (no more per-layer host-loop launches)."""
+    n, layers, batch = 7, 2, 5
+    angles, w = _rand_inputs(n, layers, batch, seed=2)
+    a = run_circuit(angles, w, n, layers, "pallas_tensor")
+    b = run_circuit(angles, w, n, layers, "pallas_circuit")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0.0)
+
+
+def test_quantumnat_noise_stream_identical_across_impls():
+    """The QuantumNAT noise draw must be a function of the rng stream ONLY —
+    switching circuit implementation may not perturb which noisy point the
+    gradient is taken at. Same key, different impls => same log-probs."""
+    from qdml_tpu.models.qsc import QSCP128
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 16, 8, 2)).astype(np.float32))
+    key = jax.random.PRNGKey(7)
+    outs = {}
+    for impl in ("dense", "pallas", "tensor"):
+        m = QSCP128(n_qubits=4, n_layers=2, use_quantumnat=True, noise_level=0.3, impl=impl)
+        variables = m.init(jax.random.PRNGKey(0), x, train=False)
+        outs[impl] = np.asarray(
+            m.apply(variables, x, train=True, rngs={"quantumnat": key})
+        )
+    np.testing.assert_allclose(outs["dense"], outs["tensor"], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs["dense"], outs["pallas"], rtol=1e-4, atol=1e-5)
+
+
 def test_fused_qsc_odd_batch_and_lead_shape():
     """Non-tile-aligned batch + extra lead dims survive the padding/reshape."""
     from qdml_tpu.quantum.pallas_kernels import fused_qsc_expvals

@@ -203,9 +203,11 @@ def test_auto_backend_matches_explicit():
 
 
 def test_resolve_backend_decisions(monkeypatch):
-    """auto resolves by qubit count AND platform: the Pallas whole-circuit
-    kernel only wins on a real TPU (results/bench_tpu_v5e_r3.json); everywhere
-    else it has only interpret mode, so XLA dense must be chosen."""
+    """The STATIC fallback resolves by qubit count only — kernel promotion is
+    the autotuner's job now (quantum/autotune.py): the old static TPU-pallas
+    promotion put the bench-measured LOSING impl on the hot path (BENCH_r05
+    qsc_pallas 9.76k vs qsc_dense 10.4k sps), which is exactly what the
+    measured dispatch table exists to prevent."""
     import jax
 
     from qdml_tpu.quantum.circuits import resolve_backend
@@ -213,16 +215,45 @@ def test_resolve_backend_decisions(monkeypatch):
     # explicit backends pass through untouched
     assert resolve_backend("tensor", 6) == "tensor"
     assert resolve_backend("sharded", 16) == "sharded"
-    # CPU (this suite's pinned platform): dense in the small-n regime
-    assert jax.default_backend() == "cpu"
+    # the static heuristic is platform-free: dense in the small-n regime,
+    # tensor past the 2^n x 2^n unitary build's win window — and never an
+    # unmeasured kernel, on ANY platform
     assert resolve_backend("auto", 6) == "dense"
     assert resolve_backend("auto", 11) == "tensor"
-    # TPU: the fused kernel up to its n<=8 VMEM budget, dense above it
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    assert resolve_backend("auto", 6) == "pallas"
-    assert resolve_backend("auto", 8) == "pallas"
+    assert resolve_backend("auto", 6) == "dense"
+    assert resolve_backend("auto", 8) == "dense"
     assert resolve_backend("auto", 10) == "dense"
     assert resolve_backend("auto", 12) == "tensor"
+
+
+def test_resolve_impl_precedence(monkeypatch, tmp_path):
+    """impl override > legacy backend > autotune table > static fallback."""
+    from qdml_tpu.quantum import autotune
+    from qdml_tpu.quantum.circuits import resolve_impl
+
+    table = str(tmp_path / "impl.json")
+    monkeypatch.setenv(autotune.ENV_TABLE, table)
+    autotune.invalidate_cache()
+    try:
+        # no table: static fallback (dense at small n)
+        assert resolve_impl("auto", "auto", 6, 3, 64) == "dense"
+        # a table entry wins over the fallback
+        import jax
+
+        key = autotune.table_key(jax.default_backend(), 6, 3, 64)
+        autotune.save_table(
+            {key: {"best_train": "pallas", "best_fwd": "tensor"}}, table
+        )
+        assert resolve_impl("auto", "auto", 6, 3, 64) == "pallas"
+        assert resolve_impl("auto", "auto", 6, 3, 64, mode="infer") == "tensor"
+        # legacy backend wins over the table; impl wins over both
+        assert resolve_impl("auto", "dense", 6, 3, 64) == "dense"
+        assert resolve_impl("tensor", "dense", 6, 3, 64) == "tensor"
+        # deprecated alias normalizes
+        assert resolve_impl("pallas_tensor", "auto", 7, 3, 64) == "pallas_circuit"
+    finally:
+        autotune.invalidate_cache()
 
 
 def test_trajectories_p0_matches_clean_circuit():
