@@ -115,3 +115,13 @@ TRACING_ENTRY_POINTS: frozenset[str] = frozenset(
 # Train-step maker naming convention: these must audit their jit for
 # donate_argnums/static_* (eval-step makers are exempt — nothing to donate).
 TRAIN_MAKER_PATTERN = r"^make_\w*(train|scan)\w*step"
+
+# Per-gate matrix constructors (quantum/circuits.py, quantum/statevector.py):
+# calling one of these inside a host-side Python loop over layers/gates
+# rebuilds the gate matrix every iteration — the shape the Qandle-style
+# gate-matrix-caching refactor removed from the hot paths (the whole
+# circuit's trig comes from one vectorized shot; per-layer unitaries from
+# fused_layer_unitaries). Matched on the callee's last attribute segment.
+GATE_MATRIX_CONSTRUCTORS: frozenset[str] = frozenset(
+    {"rot_gate", "gate_h", "gate_rx"}
+)

@@ -629,6 +629,50 @@ def rule_pallas_interpret_literal(ctx: ModuleContext) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 13. gate-matrix-in-loop — per-gate matrix construction inside a layer loop
+# ---------------------------------------------------------------------------
+
+
+def rule_gate_matrix_in_loop(ctx: ModuleContext) -> list[Finding]:
+    """A gate-matrix constructor (project.GATE_MATRIX_CONSTRUCTORS: the 2x2
+    builders ``rot_gate``/``gate_h``/``gate_rx``) called inside a host-side
+    Python ``for``/``while`` rebuilds the per-gate matrix every iteration —
+    the exact shape Qandle-style gate-matrix caching removed from the dense/
+    tensor hot paths (one vectorized trig shot + ``fused_layer_unitaries``
+    instead of 2Ln scalar gate builds). Loops inside a nested function (a
+    scan body judged on its own) are not host loops here, mirroring
+    ``pallas-host-loop``. Deliberately NOT caught: ad-hoc ``jnp.stack``-built
+    matrices (no name to match — the constructors are the project's single
+    sanctioned entry points) and loops that merely APPLY a precomputed
+    matrix (``apply_1q``/``apply_perm``), which is the fix, not the bug."""
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)):
+            continue
+        callee = ctx.canonical(node.func) or dotted_name(node.func) or ""
+        if callee.rsplit(".", 1)[-1] not in project.GATE_MATRIX_CONSTRUCTORS:
+            continue
+        cur = ctx.parent.get(node)
+        while cur is not None and not isinstance(cur, _FuncNode):
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                out.append(
+                    ctx.finding(
+                        "gate-matrix-in-loop",
+                        node,
+                        f"per-gate matrix constructor {callee!r} called inside "
+                        "a Python loop — the gate matrices are rebuilt every "
+                        "iteration; derive the whole circuit's trig in one "
+                        "vectorized shot and fuse the layer unitary "
+                        "(quantum/circuits.py fused_layer_unitaries / "
+                        "apply_ansatz_tensor's cached trig table)",
+                    )
+                )
+                break
+            cur = ctx.parent.get(cur)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -680,6 +724,10 @@ RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
     "pallas-interpret-literal": (
         rule_pallas_interpret_literal,
         "pallas_call(interpret=True) hardcoded outside test/fixture paths",
+    ),
+    "gate-matrix-in-loop": (
+        rule_gate_matrix_in_loop,
+        "per-gate jnp matrix construction inside a circuit layer loop",
     ),
     # "slow-marker" is data-driven (needs a --durations report) and lives in
     # qdml_tpu.analysis.slowmarkers; the CLI folds it in when given the data.

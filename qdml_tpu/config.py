@@ -110,9 +110,9 @@ class QuantumConfig:
     # QuantumNAT sigma grid for the vmapped noise-sweep ensemble (config 5)
     noise_sweep: tuple[float, ...] = (0.0, 0.01, 0.05, 0.1)
     # Legacy simulator-backend knob: "auto" (default) defers to the
-    # autotuned dispatcher below; an explicit value ("dense"/"tensor"/
-    # "pallas"/"pallas_circuit"/"sharded") forces that path everywhere
-    # (see qdml_tpu.quantum.circuits.resolve_impl / VALID_BACKENDS).
+    # autotuned dispatcher below; an explicit value ("dense"/"dense_fused"/
+    # "tensor"/"pallas"/"pallas_circuit"/"sharded") forces that path
+    # everywhere (see qdml_tpu.quantum.circuits.resolve_impl / VALID_BACKENDS).
     backend: str = "auto"
     # Autotuned implementation dispatch (qdml_tpu.quantum.autotune,
     # docs/QUANTUM.md). impl: "auto" routes every circuit shape through the
@@ -153,12 +153,16 @@ class TrainConfig:
     momentum: float = 0.9        # SGD momentum (Runner...py:45)
     print_freq: int = 50         # batch-loss print period (Runner...py:30)
     # Train steps fused into ONE device dispatch (lax.scan over the jitted
-    # step with on-device batch synthesis inside the scan body). 1 = the
-    # reference's step-per-dispatch loop. On the tunnelled single-chip
-    # backend the host-side dispatch gap is ~half the step wall time
-    # (docs/ROOFLINE.md), so fusing K steps lifts wall MFU toward the
-    # device-busy figure. Used by the on-device-generation training path;
-    # ignored (with a warning) under multi-host sliced loaders.
+    # step with on-device batch synthesis inside the scan body). K=1
+    # (default) ALSO runs under the scan: same donated carry, same in-program
+    # synthesis, so even step-per-dispatch training pays no host-side batch
+    # build and no steady-state host transfer off the probe cadence — the
+    # BENCH_r05 K=1 QSC step was ~all dispatch gap. On the tunnelled
+    # single-chip backend the host-side gap is ~half the step wall time
+    # (docs/ROOFLINE.md), so fusing K>1 steps lifts wall MFU further toward
+    # the device-busy figure. 0 = the legacy per-step placer data path
+    # (also forced, with a warning, by train.checkify and multi-host sliced
+    # loaders — scan.scan_eligible records the reason in the run JSONL).
     scan_steps: int = 1
     # Adam moment (m, v) storage dtype: "float32" (default, the reference's
     # torch.optim.Adam semantics) or "bfloat16" (halves the optimizer-state
@@ -171,10 +175,15 @@ class TrainConfig:
     # probe_every: log one on-device `numerics` probe record (grad/update
     # norms, fused NaN/Inf count) every N host-visible steps — the probe is
     # computed inside the compiled step (no extra compiles, pinned in
-    # tests), only the device->host fetch follows this cadence; 0 compiles
-    # the probes OUT of the step program entirely (static flag — the
-    # watchdog's loss checks still work). The first step of a run is always
-    # logged.
+    # tests), only the device->host fetch follows this cadence. In the
+    # scan-fused loops (the default dispatch) the per-dispatch loss fetch
+    # AND the watchdog's in-loop checks ride the SAME cadence — off-cadence
+    # dispatches enqueue with zero host transfers. 0 compiles the probes
+    # OUT of the step program entirely (static flag) and fetches nothing in
+    # steady state; the watchdog then checks the epoch-aggregate loss (one
+    # existing fetch per epoch — NaN propagates through the sum, divergence
+    # still raises, at epoch granularity). The first step of a run is
+    # always logged when probes are on.
     probe_every: int = 100
     # Divergence watchdog: convert NaN/Inf losses/grads (and, when
     # watchdog_grad_norm_max > 0, grad-norm explosions past that ceiling)

@@ -2,6 +2,8 @@ from qdml_tpu.quantum.circuits import (  # noqa: F401
     angle_embed,
     ansatz_unitary,
     apply_ansatz_tensor,
+    fused_ansatz_unitary,
+    fused_layer_unitaries,
     rot_gate,
     run_circuit,
 )

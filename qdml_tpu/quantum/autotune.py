@@ -56,7 +56,9 @@ _ACTIVE_PATH: str | None = None
 # Winners a table entry may name: concrete, single-host-dispatchable impls
 # only. "auto" would recurse through the resolver; "sharded" needs a
 # multi-device mesh the tuner deliberately never assumes (eligible_impls).
-_DISPATCHABLE = frozenset({"dense", "pallas", "pallas_circuit", "pallas_tensor", "tensor"})
+_DISPATCHABLE = frozenset(
+    {"dense", "dense_fused", "pallas", "pallas_circuit", "pallas_tensor", "tensor"}
+)
 
 
 def set_table_path(path: str | None) -> None:
@@ -93,6 +95,10 @@ def eligible_impls(n_qubits: int, platform: str) -> list[str]:
     """Implementations worth timing at this qubit count/platform.
 
     - ``dense``: always (the safe fallback is always a candidate);
+    - ``dense_fused`` (gate-matrix-cached / layer-fused unitary build,
+      ``circuits.fused_ansatz_unitary``): wherever dense is — it races the
+      unfused twin so the table PROVES where the fused build wins instead of
+      the heuristic assuming it;
     - ``pallas`` (whole-circuit blockdiag-unitary kernel): dim <= 256 — its
       (2D, 2D) VMEM operand grows quadratically past n=8;
     - ``pallas_circuit`` (VMEM-resident multi-layer kernel): 128 <= dim <=
@@ -105,7 +111,7 @@ def eligible_impls(n_qubits: int, platform: str) -> list[str]:
       a latency race). Select it explicitly via ``quantum.impl=sharded``.
     """
     dim = 1 << n_qubits
-    impls = ["dense"]
+    impls = ["dense", "dense_fused"]
     if dim <= 256:
         impls.append("pallas")
     if 128 <= dim <= 4096:

@@ -22,6 +22,13 @@ Execution paths:
   batch costs two real MXU matmuls against U^T plus the sign contraction.
   Best for the reference's 4-8 qubit regime where ``2**n`` is tiny compared
   to the batch.
+- ``dense_fused``: the dense math with Qandle-style gate-matrix caching /
+  layer fusion (arXiv 2404.09213): no per-gate 2x2 matrix is ever built —
+  each layer's fused rotation unitary comes from one vectorized trig shot,
+  a layer-batched real Kronecker chain and a phase einsum over the CACHED
+  ``z_signs`` structure, with the cached ring permutation applied per layer
+  (:func:`fused_layer_unitaries`). Registered as a first-class autotune
+  impl so the dispatcher proves where it wins.
 - ``pallas``: the dense math as ONE fused TPU kernel per batch tile —
   in-kernel embedding, blockdiag unitary matmul, <Z> contraction
   (:mod:`qdml_tpu.quantum.pallas_kernels`).
@@ -50,6 +57,7 @@ VALID_BACKENDS = (
     "auto",
     "tensor",
     "dense",
+    "dense_fused",
     "sharded",
     "pallas",
     "pallas_circuit",
@@ -79,12 +87,20 @@ def angle_embed(psi: CArr, angles: jnp.ndarray, n: int) -> CArr:
 
 
 def apply_ansatz_tensor(psi: CArr, weights: jnp.ndarray, n: int, n_layers: int) -> CArr:
-    """Gate-by-gate ansatz application on the statevector tensor."""
+    """Gate-by-gate ansatz application on the statevector tensor.
+
+    Gate-matrix caching (Qandle, arXiv 2404.09213): the per-gate trig is
+    derived ONCE for the whole circuit — one vectorized cos/sin pair over the
+    ``(L, n, 2)`` weight tensor — and each gate application reads its cached
+    ``(cos, sin)`` scalar instead of re-deriving trig per gate (2Ln tiny
+    transcendental ops collapse into 2 fused ones)."""
     ring = jnp.asarray(sv.ring_cnot_perm(n))
+    half = 0.5 * weights
+    cos_t, sin_t = jnp.cos(half), jnp.sin(half)  # (L, n, 2) each, one shot
     for l in range(n_layers):
         for q in range(n):
-            psi = sv.apply_ry(psi, n, q, weights[l, q, 0])
-            psi = sv.apply_rz(psi, n, q, weights[l, q, 1])
+            psi = sv.apply_ry_cs(psi, n, q, cos_t[l, q, 0], sin_t[l, q, 0])
+            psi = sv.apply_rz_cs(psi, n, q, cos_t[l, q, 1], sin_t[l, q, 1])
         psi = sv.apply_perm(psi, ring)
     return psi
 
@@ -94,17 +110,87 @@ def ansatz_unitary(weights: jnp.ndarray, n: int, n_layers: int) -> CArr:
 
     Layer unitary = RingPerm . (u_0 x u_1 x ... x u_{n-1}) with qubit 0 as the
     most significant factor; total = U_{L-1} ... U_0.
+
+    This is the UNFUSED reference formulation — one 2x2 gate matrix built per
+    (layer, qubit) and kron'd in sequence. The hot paths dispatch the fused
+    twin (:func:`fused_ansatz_unitary`, impl ``dense_fused``); this one stays
+    as the independently-derived construction the equivalence pins compare
+    against (``tests/test_quantum.py``).
     """
     ring = sv.ring_cnot_perm(n)
     total: CArr | None = None
     for l in range(n_layers):
-        u = rot_gate(weights[l, 0, 0], weights[l, 0, 1])
+        u = rot_gate(weights[l, 0, 0], weights[l, 0, 1])  # lint: disable=gate-matrix-in-loop(the unfused reference construction the dense_fused equivalence pins compare against; hot paths dispatch fused_ansatz_unitary)
         for q in range(1, n):
-            u = ckron(u, rot_gate(weights[l, q, 0], weights[l, q, 1]))
+            u = ckron(u, rot_gate(weights[l, q, 0], weights[l, q, 1]))  # lint: disable=gate-matrix-in-loop(unfused reference twin of fused_layer_unitaries — see above)
         # ring perm acts on rows: (P M)[y, :] = M[src[y], :]
         u = CArr(u.re[ring, :], u.im[ring, :])
         total = u if total is None else ceinsum("ij,jk->ik", u, total)
     assert total is not None
+    return total
+
+
+def fused_layer_unitaries(weights: jnp.ndarray, n: int, n_layers: int) -> CArr:
+    """All L layer unitaries at once from the parameter vector — gate-matrix
+    caching / layer fusion (Qandle, arXiv 2404.09213) applied to this ansatz.
+
+    Structure exploited (vs :func:`ansatz_unitary`'s per-gate kron chain):
+
+    - the whole circuit's trig comes from ONE vectorized cos/sin pair over the
+      ``(L, n, 2)`` weight tensor (2 fused ops, not 2Ln scalar gate builds);
+    - the RY half of every layer is REAL, so the rotation kron is a real
+      doubling chain batched over all L layers simultaneously;
+    - the RZ half is DIAGONAL: its phase per basis state is an einsum of the
+      RZ half-angles against the CACHED ``z_signs`` bit-sign table
+      (``phase[l, i] = -0.5 * sum_q signs[i, q] * w_rz[l, q]``) — the cached
+      structure, rebuilt never, contracted once per step;
+    - the ring-CNOT entangler is the cached composed permutation
+      (:func:`~qdml_tpu.quantum.statevector.ring_cnot_perm`) applied to rows.
+
+    Returns a ``(L, 2**n, 2**n)`` CArr; layer l equals
+    ``RingPerm . (RZ(w[l,:,1]) RY(w[l,:,0]))^{(x) n}`` exactly (same qubit-0
+    most-significant convention), to f32 rounding.
+    """
+    dim = 1 << n
+    half = 0.5 * weights  # (L, n, 2)
+    c, s = jnp.cos(half), jnp.sin(half)
+    # Real RY kron chain, batched over layers: (L, 1, 1) -> (L, dim, dim) by
+    # doubling, qubit 0 outermost (most significant) like the ckron chain.
+    kron = jnp.ones((n_layers, 1, 1), weights.dtype)
+    d = 1
+    for q in range(n):
+        # (L, 2, 2) RY matrix elements for THIS qubit, from the cached trig
+        m = jnp.stack(
+            [
+                jnp.stack([c[:, q, 0], -s[:, q, 0]], axis=-1),
+                jnp.stack([s[:, q, 0], c[:, q, 0]], axis=-1),
+            ],
+            axis=-2,
+        )
+        kron = kron[:, :, None, :, None] * m[:, None, :, None, :]
+        d *= 2
+        kron = kron.reshape(n_layers, d, d)
+    # RZ diagonal phase per basis-state row: einsum over the cached sign
+    # table (z_signs[i, q] = +1 when bit q of i is 0). RZ contributes
+    # e^{-i w/2} on the 0-row and e^{+i w/2} on the 1-row of each qubit.
+    signs = jnp.asarray(sv.z_signs(n))  # (dim, n), cached structure
+    phase = -0.5 * jnp.einsum("iq,lq->li", signs, weights[:, :, 1])  # (L, dim)
+    re = jnp.cos(phase)[:, :, None] * kron
+    im = jnp.sin(phase)[:, :, None] * kron
+    # ring perm acts on rows: (P M)[y, :] = M[src[y], :]
+    ring = sv.ring_cnot_perm(n)
+    return CArr(re[:, ring, :], im[:, ring, :])
+
+
+def fused_ansatz_unitary(weights: jnp.ndarray, n: int, n_layers: int) -> CArr:
+    """The full ansatz unitary from :func:`fused_layer_unitaries`: total =
+    U_{L-1} ... U_0, composed by L-1 complex MXU matmuls. Numerically
+    equivalent to :func:`ansatz_unitary` (pinned in ``tests/test_quantum.py``);
+    built without any per-gate matrix construction."""
+    layers = fused_layer_unitaries(weights, n, n_layers)
+    total = CArr(layers.re[0], layers.im[0])
+    for l in range(1, n_layers):
+        total = ceinsum("ij,jk->ik", CArr(layers.re[l], layers.im[l]), total)
     return total
 
 
@@ -182,12 +268,18 @@ def run_circuit(
 
     batch = int(_np.prod(angles.shape[:-1])) if angles.ndim > 1 else 1
     backend = resolve_impl(impl, backend, n_qubits, n_layers, batch, mode=mode)
-    if backend == "dense":
+    if backend in ("dense", "dense_fused"):
         # Closed-form embedding: the RY-embedded state is a REAL product
         # state (sv.ry_product_state), so the whole circuit is two real
         # matmuls against U^T plus the sign contraction — no gate chain on
         # the 2^n tensor, half the matmul work of a complex-LHS product.
-        u = ansatz_unitary(weights, n_qubits, n_layers)
+        # "dense_fused" builds the unitary with gate-matrix caching / layer
+        # fusion (fused_ansatz_unitary: one vectorized trig shot + batched
+        # real kron + cached-sign-table phase einsum) instead of the per-gate
+        # kron chain; same math, registered as its own impl so the autotuner
+        # PROVES it wins instead of this module assuming it.
+        build = fused_ansatz_unitary if backend == "dense_fused" else ansatz_unitary
+        u = build(weights, n_qubits, n_layers)
         amp = sv.ry_product_state(angles, n_qubits)
         psi = CArr(
             jnp.einsum("...i,ji->...j", amp, u.re),

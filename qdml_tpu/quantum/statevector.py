@@ -61,8 +61,18 @@ def apply_ry(psi: CArr, n: int, q: int, theta: jnp.ndarray) -> CArr:
     ``theta`` may be scalar or batched with the statevector's lead shape
     (per-sample angles for AngleEmbedding, reference ``Estimators...py:127``).
     """
+    return apply_ry_cs(
+        psi, n, q, jnp.cos(jnp.asarray(theta) / 2), jnp.sin(jnp.asarray(theta) / 2)
+    )
+
+
+def apply_ry_cs(psi: CArr, n: int, q: int, c: jnp.ndarray, s: jnp.ndarray) -> CArr:
+    """RY application from PRECOMPUTED half-angle (cos, sin) — the gate-matrix
+    cache form: callers that walk many gates (``apply_ansatz_tensor``) derive
+    the whole circuit's trig table in one vectorized ``cos``/``sin`` pair and
+    feed per-gate scalars here, instead of re-deriving trig gate by gate."""
     p0, p1, lead = _split(psi, n, q)
-    c, s = jnp.cos(_bcast(theta) / 2), jnp.sin(_bcast(theta) / 2)
+    c, s = _bcast(c), _bcast(s)
     new0 = CArr(c * p0.re - s * p1.re, c * p0.im - s * p1.im)
     new1 = CArr(s * p0.re + c * p1.re, s * p0.im + c * p1.im)
     return _join(new0, new1, lead, n)
@@ -70,8 +80,16 @@ def apply_ry(psi: CArr, n: int, q: int, theta: jnp.ndarray) -> CArr:
 
 def apply_rz(psi: CArr, n: int, q: int, theta: jnp.ndarray) -> CArr:
     """RZ(theta) on qubit q: diag(e^{-i theta/2}, e^{+i theta/2})."""
+    return apply_rz_cs(
+        psi, n, q, jnp.cos(jnp.asarray(theta) / 2), jnp.sin(jnp.asarray(theta) / 2)
+    )
+
+
+def apply_rz_cs(psi: CArr, n: int, q: int, c: jnp.ndarray, s: jnp.ndarray) -> CArr:
+    """RZ application from precomputed half-angle (cos, sin) — see
+    :func:`apply_ry_cs` for the gate-matrix-cache rationale."""
     p0, p1, lead = _split(psi, n, q)
-    c, s = jnp.cos(_bcast(theta) / 2), jnp.sin(_bcast(theta) / 2)
+    c, s = _bcast(c), _bcast(s)
     new0 = CArr(c * p0.re + s * p0.im, c * p0.im - s * p0.re)  # * e^{-i t/2}
     new1 = CArr(c * p1.re - s * p1.im, c * p1.im + s * p1.re)  # * e^{+i t/2}
     return _join(new0, new1, lead, n)

@@ -148,6 +148,52 @@ def analyze(executable: Any, platform: str | None = None) -> dict:
     return out
 
 
+def achieved_roofline(
+    cost: dict | None, programs_per_sec: float, platform: str | None = None
+) -> dict | None:
+    """Achieved-vs-roofline fraction for a MEASURED program rate.
+
+    The cost record says what the compiled program does (FLOPs, bytes,
+    arithmetic intensity); a measurement says how often it ran. Together they
+    place the program ON the roofline: the ceiling at its intensity is
+    ``min(peak_flops, bw * intensity)``, the achieved rate is ``flops *
+    programs_per_sec``, and their ratio is the fraction of the hardware
+    floor actually reached — THE number the dispatch-gap work moves (device
+    time can be at peak while wall throughput rots in host gaps).
+
+    Returns ``{"achieved_tflops_per_s", "ceiling_tflops_per_s", "fraction",
+    "bound", "arithmetic_intensity", "platform"}`` or ``None`` when the cost
+    block is unavailable / carries no flops+bytes (degradation mirrors
+    :func:`analyze`: accounting must never kill the measurement it annotates).
+    ``bound`` names the ceiling's limiting resource at this intensity —
+    "compute" past the ridge, "memory" below it.
+    """
+    if not isinstance(cost, dict) or not cost.get("available"):
+        return None
+    flops, bytes_accessed = cost.get("flops"), cost.get("bytes_accessed")
+    if not (
+        isinstance(flops, (int, float))
+        and isinstance(bytes_accessed, (int, float))
+        and flops > 0
+        and bytes_accessed > 0
+        and programs_per_sec > 0
+    ):
+        return None
+    platform = platform or cost.get("platform") or detect_platform()
+    peak, bw = _PLATFORM_PEAKS.get(platform, _PLATFORM_PEAKS[_DEFAULT_RIDGE_PLATFORM])
+    intensity = flops / bytes_accessed
+    ceiling = min(peak, bw * intensity)
+    achieved = flops * programs_per_sec
+    return {
+        "platform": platform,
+        "arithmetic_intensity": round(intensity, 4),
+        "achieved_tflops_per_s": round(achieved / 1e12, 6),
+        "ceiling_tflops_per_s": round(ceiling / 1e12, 6),
+        "fraction": round(achieved / ceiling, 6),
+        "bound": "compute" if peak <= bw * intensity else "memory",
+    }
+
+
 def analyze_jit(jitted: Any, *args, platform: str | None = None, **kwargs) -> dict:
     """Cost record for a jitted callable at concrete/abstract args: traces
     (``.lower``, cheap) but never compiles — the caller's own first dispatch

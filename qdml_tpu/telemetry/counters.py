@@ -174,6 +174,12 @@ class StepClock:
                 compile_s=round(self.compile_s, 6) if self.compile_s else None,
                 step=self.steps.summary(),
                 host_transfer=self.transfers.summary(),
+                # explicit count (0 instead of a null summary): the
+                # zero-steady-state-host-transfer contract of the scan-fused
+                # loops is asserted off this field (tests/test_train.py), and
+                # a reappearing transfer must be visible as a number, not as
+                # the difference between null and non-null
+                host_transfers=len(self.transfers),
                 memory=device_memory_snapshot(),
                 compile_cache=compile_cache_stats(),
                 **tags,

@@ -161,12 +161,18 @@ def _j(x):
 
 
 class Watchdog:
-    """Divergence trip policy over the per-step loss and fetched probes.
+    """Divergence trip policy over fetched losses and probes.
 
     Trips (returns the reason string) on:
 
-    - nonfinite loss — checked EVERY step, for free: the train loops already
-      transfer the loss each step;
+    - nonfinite loss — checked whenever the loop hands one over. Per-step
+      dispatch fetches the loss every step, so the check runs every step
+      there; the scan-fused loops fetch ONLY on the probe cadence (the
+      zero-steady-state-transfer contract, ``FlightRecorder.should_fetch``)
+      and additionally feed the epoch-aggregate loss sum through
+      :meth:`FlightRecorder.on_epoch_loss` — with ``probe_every=0`` the
+      aggregate check is the armed path (NaN propagates through the sum), at
+      epoch granularity and zero extra transfers;
     - a nonzero fused ``nonfinite`` probe count (NaN/Inf in grads/updates);
     - ``grad_norm`` above ``grad_norm_max`` (0 disables the ceiling — the
       NaN/Inf trips stay armed).
@@ -235,6 +241,24 @@ class FlightRecorder:
 
     def _target(self):
         return self._sink if self._sink is not None else _spans.get_sink()
+
+    def should_fetch(self) -> bool:
+        """Whether the NEXT :meth:`on_step` call lands on the logging cadence
+        (first step of the run, or a ``probe_every`` multiple).
+
+        The scan-fused train loops use this to decide whether to pay the
+        device->host loss sync for a dispatch at all: off-cadence dispatches
+        enqueue back-to-back with ZERO host transfers (the dispatch-gap
+        elimination contract, pinned in ``tests/test_train.py``), and the
+        watchdog's loss/probe checks ride the same cadence — ``probe_every=0``
+        fetches nothing in steady state. Mirrors :meth:`on_step`'s internal
+        cadence exactly; a drift between the two would either fetch losses
+        nobody logs or log records with no loss.
+        """
+        if self.probe_every <= 0:
+            return False
+        nxt = self._n + 1
+        return nxt == 1 or nxt % self.probe_every == 0
 
     def note_good(self, params) -> None:
         """Snapshot known-good params (a COPY — the train steps donate their
@@ -332,6 +356,31 @@ class FlightRecorder:
                              probe_host=probe_host, metrics=metrics)
         raise DivergenceError(
             f"{self.name} diverged at step {self._n} (epoch {epoch}): {reason}"
+            + (f" — flight-recorder dump: {dump_dir}" if dump_dir else ""),
+            dump_dir,
+            reason,
+        )
+
+    def on_epoch_loss(self, epoch: int, loss) -> None:
+        """Watchdog check over an epoch's ALREADY-FETCHED loss aggregate.
+
+        The scan-fused loops accumulate losses on device and fetch once per
+        epoch; NaN/Inf propagates through the sum, so this one check catches
+        any divergence the cadence-gated per-dispatch checks skipped —
+        including the ``probe_every=0`` mode, where NO in-loop fetch happens
+        and this is the only armed loss check. Costs nothing: the epoch
+        fetch already happened for the history. Trips exactly like
+        :meth:`on_step` (dump + typed :class:`DivergenceError`)."""
+        if self.watchdog is None or loss is None:
+            return
+        reason = self.watchdog.check(loss=loss)
+        if reason is None:
+            return
+        reason = f"epoch-aggregate {reason}"
+        dump_dir = self.dump(reason, epoch, loss=loss)
+        raise DivergenceError(
+            f"{self.name} diverged during epoch {epoch} (aggregate over the "
+            f"epoch's fused dispatches): {reason}"
             + (f" — flight-recorder dump: {dump_dir}" if dump_dir else ""),
             dump_dir,
             reason,

@@ -117,6 +117,8 @@ def extract(path: str) -> dict:
         "throughput": {},
         "serving": None,
         "cost": {},
+        "roofline": {},
+        "host_transfers": {},
         "platform": None,
     }
     for obj in _iter_objs(path):
@@ -161,6 +163,16 @@ def extract(path: str) -> dict:
                 src["throughput"][f"{key}.samples_per_sec"] = float(d["samples_per_sec"])
             if isinstance(d.get("cost"), dict):
                 src["cost"][key] = d["cost"]
+            # achieved-vs-roofline fraction (bench train records since the
+            # latency-floor PR): gated with an inverted-improvement sign —
+            # the fraction DROPPING is the regression
+            roof = d.get("roofline")
+            if isinstance(roof, dict) and isinstance(roof.get("fraction"), (int, float)):
+                src["roofline"][key] = float(roof["fraction"])
+            # steady-state host transfers inside the timed loop: 0 by
+            # construction; any reappearance is a program-property failure
+            if isinstance(d.get("host_transfers"), (int, float)):
+                src["host_transfers"][key] = int(d["host_transfers"])
     # Synthesized best-of-impls QSC metric: the regression gate for the
     # quantum classifier compares the fastest implementation measured on each
     # side (the per-impl rows stay in the table, informational).
@@ -288,6 +300,7 @@ def build_report_data(
 
     regressions: list[dict] = []
     gate_armed = True
+    transfer_failed = False
 
     # Lint gate (qdml-tpu lint --json artifact): folded in alongside the perf
     # gates so CI reads ONE exit code. Static analysis is host-side — the
@@ -361,6 +374,10 @@ def build_report_data(
             # lint failures force the regression exit even when the perf gate
             # is platform-disarmed: static analysis ran on THIS host's source
             "lint_failed": bool(lint is not None and not lint["ok"]),
+            # a reappearing steady-state host transfer is a PROGRAM property
+            # (the bench loop is transfer-free by construction), so like lint
+            # it forces the regression exit even under platform disarm
+            "transfer_failed": transfer_failed,
             "note": note,
             "markdown": "\n".join(lines),
         }
@@ -524,6 +541,98 @@ def build_report_data(
             )
             lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status_md} |")
 
+    # Roofline section: achieved-vs-roofline fraction per train sub-bench
+    # (bench.py details.*.roofline.fraction — telemetry/cost.py). The sign is
+    # inverted like latency in spirit but the metric is a fraction of the
+    # hardware ceiling: the fraction DROPPING beyond the threshold is the
+    # regression (the fused path slid back toward dispatch-/transfer-bound).
+    # Platform rules arm it like throughput — a fraction is measured against
+    # THIS platform's ridge, so cross-platform deltas compare hardware.
+    base_roof = base.get("roofline") or {}
+    cur_roof: dict[str, float] = {}
+    for c_src in curs:
+        cur_roof.update(c_src.get("roofline") or {})
+    if base_roof or cur_roof:
+        lines += [
+            "",
+            "## roofline fraction (achieved / ceiling at program intensity)",
+            "",
+            "| program | baseline | current | delta | status |",
+            "|---|---|---|---|---|",
+        ]
+        for key in sorted(set(base_roof) | set(cur_roof)):
+            b = base_roof.get(key)
+            c = cur_roof.get(key)
+            metric = f"{key}.roofline_fraction"
+            if b is None or c is None:
+                only = "current-only" if b is None else "baseline-only"
+                gates.append(
+                    {"metric": metric, "kind": "roofline", "baseline": b,
+                     "current": c, "delta_pct": None, "status": only}
+                )
+                lines.append(
+                    f"| {key} | {'—' if b is None else f'{b:g}'} | "
+                    f"{'—' if c is None else f'{c:g}'} | — | {only} |"
+                )
+                continue
+            delta_pct = _pct(c, b)
+            if delta_pct is None:
+                gates.append(
+                    {"metric": metric, "kind": "roofline", "baseline": b,
+                     "current": c, "delta_pct": None, "status": "zero-baseline"}
+                )
+                lines.append(f"| {key} | {b:g} | {c:g} | — | zero-baseline |")
+                continue
+            if delta_pct < -threshold_pct:
+                status_key, status_md = "regression", "**REGRESSION**"
+                regressions.append(
+                    {"metric": metric, "baseline": b, "current": c,
+                     "delta_pct": round(delta_pct, 2)}
+                )
+            elif delta_pct > threshold_pct:
+                status_key = status_md = "improved"
+            else:
+                status_key = status_md = "ok"
+            gates.append(
+                {"metric": metric, "kind": "roofline", "baseline": b,
+                 "current": c, "delta_pct": round(delta_pct, 2), "status": status_key}
+            )
+            lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status_md} |")
+
+    # Steady-state host-transfer gate: the bench's timed loops are
+    # transfer-free by construction (0 committed in every record) and run
+    # under the strict device->host transfer guard on accelerator backends;
+    # a reintroduced sync trips the guard and bench.py records the failed
+    # sub-bench with host_transfers=1 — so "current > baseline" is the
+    # reachable failure signal, not a hypothetical. A program property,
+    # armed regardless of platform (like the lint gate).
+    base_ht = base.get("host_transfers") or {}
+    cur_ht: dict[str, int] = {}
+    for c_src in curs:
+        cur_ht.update(c_src.get("host_transfers") or {})
+    ht_rows = []
+    for key in sorted(set(base_ht) & set(cur_ht)):
+        b, c = base_ht[key], cur_ht[key]
+        if c > b:
+            transfer_failed = True
+            gates.append(
+                {"metric": f"{key}.host_transfers", "kind": "host-transfers",
+                 "baseline": b, "current": c, "delta_pct": None,
+                 "status": "regression"}
+            )
+            regressions.append(
+                {"metric": f"{key}.host_transfers", "baseline": b, "current": c,
+                 "delta_pct": None}
+            )
+            ht_rows.append(f"- **{key}**: {b} -> {c} steady-state host transfer(s)")
+        else:
+            gates.append(
+                {"metric": f"{key}.host_transfers", "kind": "host-transfers",
+                 "baseline": b, "current": c, "delta_pct": None, "status": "ok"}
+            )
+    if ht_rows:
+        lines += ["", "## steady-state host transfers — **REGRESSION**", ""] + ht_rows
+
     # Cost section: the XLA accounting for every program both sides measured.
     # A FLOPs/bytes delta is a PROGRAM change (config, lowering, fusion), a
     # regression with flat cost is an execution change — the table separates
@@ -656,7 +765,11 @@ def report_main(argv: list[str]) -> int:
     print(md)
     rc = (
         EXIT_REGRESSION
-        if ((data["regressions"] and data["gate_armed"]) or data["lint_failed"])
+        if (
+            (data["regressions"] and data["gate_armed"])
+            or data["lint_failed"]
+            or data.get("transfer_failed")
+        )
         else EXIT_OK
     )
     if out:

@@ -27,10 +27,11 @@ device->host error fetch per host-visible step (train) / batch (serve);
 checkify's added checks also inhibit some fusions, so it is a debugging
 mode, never the production default.
 
-Scan-fused dispatch (``train.scan_steps > 1``) falls back to per-step
-dispatch under checkify (``train/scan.py::scan_eligible``): the per-step
-error fetch is the point of the mode, and a K-step fused program would
-aggregate K steps' checks into one opaque trip.
+Scan-fused dispatch (``train.scan_steps >= 1`` — the default, K=1
+included) falls back to per-step dispatch under checkify
+(``train/scan.py::scan_eligible``, which records the reason in the run
+JSONL): the per-step error fetch is the point of the mode, and a K-step
+fused program would aggregate K steps' checks into one opaque trip.
 """
 
 from __future__ import annotations

@@ -159,8 +159,9 @@ def train_dce(
         state, start_epoch, rmeta = try_resume(workdir, "dce_resume", state)
         best = float(rmeta.get("best", best))
 
-    # Scan-fused dispatch, same machinery as train_hdce (this trainer is
-    # single-device, so eligibility reduces to scan_steps > 1).
+    # Scan-fused dispatch, same machinery as train_hdce — the DEFAULT, K=1
+    # included (this trainer is single-device, so eligibility reduces to
+    # scan_steps >= 1 without checkify; 0 opts out).
     from qdml_tpu.train.scan import scan_eligible
 
     scan_run = None
@@ -179,6 +180,7 @@ def train_dce(
             if scan_run is not None:
                 seed = jnp.uint32(cfg.data.seed)
                 scen, user = train_loader.grid_coords
+                tot_dev = None  # on-device loss accumulator, fetched once per epoch
                 for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
                     if not cost_done:
                         maybe_emit_cost(
@@ -186,16 +188,26 @@ def train_dce(
                             user, idx, snrs, scan_steps=cfg.train.scan_steps,
                         )
                         cost_done = True
+                    fetch = rec.should_fetch()
+                    losses = None
                     with clock.step() as st:
                         state, ms = scan_run(state, seed, scen, user, idx, snrs)
-                        st.transfer()
-                        losses = np.asarray(jax.device_get(ms["loss"]))
-                        tot = tot + float(losses.sum())
+                        if fetch:
+                            # sole steady-state sync, on the probe cadence
+                            # only (zero with probe_every=0) — see train_hdce
+                            st.transfer()
+                            losses = np.asarray(jax.device_get(ms["loss"]))
+                    chunk = jnp.sum(ms["loss"])
+                    tot_dev = chunk if tot_dev is None else tot_dev + chunk
                     rec.on_step(
                         epoch, ms, loss=losses, params=state.params,
                         batch_info={"dispatch": "scan", "idx": idx, "snrs": snrs},
                     )
                     n += idx.shape[0]
+                if tot_dev is not None:
+                    tot = float(jax.device_get(tot_dev))
+                    # epoch-aggregate watchdog check — see train_hdce
+                    rec.on_epoch_loss(epoch, tot)
             else:
                 for batch in train_loader.epoch(epoch):
                     if not cost_done:

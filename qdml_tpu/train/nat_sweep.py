@@ -343,6 +343,7 @@ def train_nat_sweep(
             if scan_run is not None:
                 seed = jnp.uint32(cfg.data.seed)
                 scen, user = train_loader.grid_coords
+                tot_dev = None  # on-device (E,) loss accumulator, one epoch fetch
                 for idx, snrs in train_loader.epoch_chunks(epoch, cfg.train.scan_steps):
                     rng, subs = presplit_keys(rng, idx.shape[0])
                     member_keys = jax.vmap(lambda s: jax.random.split(s, n_members))(subs)
@@ -353,18 +354,29 @@ def train_nat_sweep(
                             scan_steps=cfg.train.scan_steps, n_members=n_members,
                         )
                         cost_done = True
+                    fetch = rec.should_fetch()
+                    losses = None
                     with clock.step() as st:
                         (params, opt_state), ms = scan_run(
                             (params, opt_state), seed, scen, user, idx, snrs, member_keys
                         )
-                        st.transfer()
-                        losses = np.asarray(jax.device_get(ms["loss"]))
-                        tot += losses.sum(0)
+                        if fetch:
+                            # sole steady-state sync, on the probe cadence
+                            # only (zero with probe_every=0) — see train_hdce
+                            st.transfer()
+                            losses = np.asarray(jax.device_get(ms["loss"]))
+                    chunk = jnp.sum(ms["loss"], axis=0)  # (K, E) -> (E,)
+                    tot_dev = chunk if tot_dev is None else tot_dev + chunk
                     rec.on_step(
                         epoch, ms, loss=losses, params=params, rng=member_keys,
                         batch_info={"dispatch": "scan", "idx": idx, "snrs": snrs},
                     )
                     n += idx.shape[0]
+                if tot_dev is not None:
+                    tot = tot + np.asarray(jax.device_get(tot_dev))
+                    # epoch-aggregate watchdog check (per-member vector: ANY
+                    # diverged member trips) — see train_hdce
+                    rec.on_epoch_loss(epoch, tot)
             else:
                 for batch in train_loader.epoch(epoch):
                     rng, sub = jax.random.split(rng)

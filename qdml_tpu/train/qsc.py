@@ -230,8 +230,9 @@ def train_classifier(
     place_train = make_grid_placer(train_loader, mesh)
     place_val = make_grid_placer(val_loader, mesh)
 
-    # Scan-fused dispatch (cfg.train.scan_steps > 1): same machinery and
-    # eligibility rules as train_hdce (qdml_tpu.train.scan.scan_eligible).
+    # Scan-fused dispatch — the DEFAULT, K=1 included (scan_steps=0 opts
+    # out): same machinery and eligibility rules as train_hdce
+    # (qdml_tpu.train.scan.scan_eligible).
     from qdml_tpu.train.scan import presplit_keys, scan_eligible
 
     scan_k = cfg.train.scan_steps
@@ -256,6 +257,7 @@ def train_classifier(
             if scan_run is not None:
                 seed = jnp.uint32(cfg.data.seed)
                 scen, user = train_loader.grid_coords
+                tot_dev = None  # on-device loss accumulator, fetched once per epoch
                 for idx, snrs in train_loader.epoch_chunks(epoch, scan_k):
                     rng, subs = presplit_keys(rng, idx.shape[0])
                     if not cost_done:
@@ -264,16 +266,26 @@ def train_classifier(
                             user, idx, snrs, subs, scan_steps=scan_k,
                         )
                         cost_done = True
+                    fetch = rec.should_fetch()
+                    losses = None
                     with clock.step() as st:
                         state, ms = scan_run(state, seed, scen, user, idx, snrs, subs)
-                        st.transfer()
-                        losses = np.asarray(jax.device_get(ms["loss"]))
-                        tot = tot + float(losses.sum())
+                        if fetch:
+                            # sole steady-state sync, on the probe cadence
+                            # only (zero with probe_every=0) — see train_hdce
+                            st.transfer()
+                            losses = np.asarray(jax.device_get(ms["loss"]))
+                    chunk = jnp.sum(ms["loss"])
+                    tot_dev = chunk if tot_dev is None else tot_dev + chunk
                     rec.on_step(
                         epoch, ms, loss=losses, params=state.params, rng=subs,
                         batch_info={"dispatch": "scan", "idx": idx, "snrs": snrs},
                     )
                     n += idx.shape[0]
+                if tot_dev is not None:
+                    tot = float(jax.device_get(tot_dev))
+                    # epoch-aggregate watchdog check — see train_hdce
+                    rec.on_epoch_loss(epoch, tot)
             else:
                 for batch in train_loader.epoch(epoch):
                     rng, sub = jax.random.split(rng)

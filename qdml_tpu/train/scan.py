@@ -110,29 +110,55 @@ def scan_eligible(cfg, mesh, loader, logger) -> bool:
     """Whether the scan-fused dispatch path may own the data for this run.
 
     Shared gate for every trainer: eligible single-device, or on a
-    single-process mesh whose ``data`` axis divides the batch. Multi-process
-    runs (per-host slice generation + global assembly) and non-dividing
-    batches (the placer runs those replicated) keep the per-step placer
-    path; logs the fallback when scan_steps was requested but ineligible."""
-    if cfg.train.scan_steps <= 1:
-        return False
+    single-process mesh whose ``data`` axis divides the batch — INCLUDING
+    ``scan_steps=1``: the K=1 program is the same ``lax.scan`` body with a
+    donated carry and on-device batch synthesis, so even step-per-dispatch
+    training pays no host-side batch build or placement (the BENCH_r05
+    all-dispatch-gap shape). ``scan_steps=0`` explicitly disables fusion
+    (the legacy per-step placer path); multi-process runs (per-host slice
+    generation + global assembly), non-dividing mesh batches (the placer
+    runs those replicated) and ``train.checkify`` keep the per-step path too.
+
+    Every decision — eligible or not — is emitted as a structured
+    ``scan_dispatch`` record (kind/eligible/scan_steps/reason) into the run's
+    JSONL, so a dispatch-bound run is diagnosable from the artifact alone;
+    declines additionally log a human-readable warning."""
+    k = cfg.train.scan_steps
+
+    def decide(eligible: bool, reason: str, warn: str | None = None) -> bool:
+        logger.log(kind="scan_dispatch", eligible=eligible, scan_steps=k, reason=reason)
+        if warn is not None:
+            logger.log(warning=warn)
+        return eligible
+
+    if k < 1:
+        return decide(False, "disabled: scan_steps=0 selects the per-step placer path")
     if cfg.train.checkify:
         # the sanitizer's contract is a per-step error fetch; a K-step fused
         # program would aggregate K steps' checks into one opaque trip
-        logger.log(
-            warning=f"scan_steps={cfg.train.scan_steps} ignored: "
-            "train.checkify forces per-step dispatch"
+        return decide(
+            False,
+            "checkify: per-step error fetch is the sanitizer's contract",
+            warn=f"scan_steps={k} ignored: train.checkify forces per-step dispatch",
         )
-        return False
     if mesh is None:
-        return True
-    if jax.process_count() == 1 and loader.batch_size % mesh.shape["data"] == 0:
-        return True
-    logger.log(
-        warning=f"scan_steps={cfg.train.scan_steps} ignored: multi-process "
-        "or non-dividing batch uses the per-step placer data path"
+        return decide(True, "fused: single-device, synthesis inside the scan body")
+    if jax.process_count() > 1:
+        return decide(
+            False,
+            "loader shape: multi-process per-host slice generation owns the data",
+            warn=f"scan_steps={k} ignored: multi-process "
+            "or non-dividing batch uses the per-step placer data path",
+        )
+    if loader.batch_size % mesh.shape["data"] == 0:
+        return decide(True, "fused: single-process mesh, data axis divides the batch")
+    return decide(
+        False,
+        f"loader shape: batch {loader.batch_size} does not divide over "
+        f"data axis {mesh.shape['data']} (placer runs it replicated)",
+        warn=f"scan_steps={k} ignored: multi-process "
+        "or non-dividing batch uses the per-step placer data path",
     )
-    return False
 
 
 def presplit_keys(rng: jax.Array, k: int) -> tuple[jax.Array, jnp.ndarray]:

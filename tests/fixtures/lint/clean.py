@@ -102,3 +102,23 @@ def inspect_and_reraise():
     except Exception as e:
         log_failure(e)  # noqa: F821
         raise
+
+
+def make_k1_scan_train_step_good(run):
+    # the K=1 scan runner with its carry donated: the audited twin
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, seed, scen, user, idx, snrs):
+        return jax.lax.scan(run, state, (idx, snrs))
+
+    return step
+
+
+def fused_layer_build(weights, n_layers, layer_unitaries):
+    # gate-matrix-in-loop's legitimate twins: ALL gate trig derived in one
+    # vectorized shot OUTSIDE any loop, and the loop only APPLIES the
+    # precomputed per-layer unitaries (composition, no construction)
+    cos_t, sin_t = jnp.cos(0.5 * weights), jnp.sin(0.5 * weights)
+    total = layer_unitaries[0]
+    for l in range(1, n_layers):
+        total = layer_unitaries[l] @ total
+    return total, cos_t, sin_t

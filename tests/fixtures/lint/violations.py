@@ -117,3 +117,24 @@ def pallas_interpret_left_on(x, kernel):
 
 
 IMPORT_TIME_ARRAY = jnp.zeros((4,))  # import-time-jnp: device alloc on import
+
+
+def make_k1_scan_train_step(run):
+    # train-step-jit-audit: the K=1 scan-fused runner shape — the carry is
+    # the whole train state, so an unaudited jit doubles its HBM footprint
+    # exactly like a per-step maker's
+    @jax.jit
+    def step(state, seed, scen, user, idx, snrs):
+        return jax.lax.scan(run, state, (idx, snrs))
+
+    return step
+
+
+def ansatz_unitary_per_gate(weights, n, n_layers):
+    # gate-matrix-in-loop: one 2x2 gate matrix rebuilt per (layer, qubit) —
+    # the unfused shape gate-matrix caching (fused_layer_unitaries) removes
+    total = None
+    for l in range(n_layers):
+        u = rot_gate(weights[l, 0, 0], weights[l, 0, 1])  # noqa: F821
+        total = u if total is None else total @ u
+    return total

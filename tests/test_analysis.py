@@ -41,7 +41,7 @@ def test_violation_fixture_trips_every_rule():
     assert err is None
     rules = _rules_found(findings)
     assert rules["jit-mutable-global"] == 1
-    assert rules["train-step-jit-audit"] == 2      # decorator + call forms
+    assert rules["train-step-jit-audit"] == 3      # decorator + call + K=1 scan maker
     assert rules["tracer-branch"] == 2             # if + while
     assert rules["host-sync-hot-path"] == 1
     assert rules["wall-clock-in-jit"] == 1
@@ -51,6 +51,7 @@ def test_violation_fixture_trips_every_rule():
     assert rules["import-time-jnp"] == 1
     assert rules["pallas-host-loop"] == 1          # per-layer launch loop
     assert rules["pallas-interpret-literal"] == 1  # hardcoded interpret=True
+    assert rules["gate-matrix-in-loop"] == 1       # per-gate build in layer loop
     # every finding carries a usable anchor
     for f in findings:
         assert f.path.endswith("violations.py") and f.line > 0 and f.message

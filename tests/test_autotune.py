@@ -95,9 +95,15 @@ def test_impl_override_wins_over_table():
 
 
 def test_eligible_impls_by_shape():
-    assert autotune.eligible_impls(4, "cpu") == ["dense", "pallas"]
-    assert autotune.eligible_impls(7, "tpu") == ["dense", "pallas", "pallas_circuit"]
-    assert autotune.eligible_impls(10, "tpu") == ["dense", "pallas_circuit", "tensor"]
+    # dense_fused races dense at every shape: the gate-matrix-cached build is
+    # a first-class impl the table must PROVE wins, never assume
+    assert autotune.eligible_impls(4, "cpu") == ["dense", "dense_fused", "pallas"]
+    assert autotune.eligible_impls(7, "tpu") == [
+        "dense", "dense_fused", "pallas", "pallas_circuit",
+    ]
+    assert autotune.eligible_impls(10, "tpu") == [
+        "dense", "dense_fused", "pallas_circuit", "tensor",
+    ]
     assert "sharded" not in autotune.eligible_impls(14, "tpu")
 
 
@@ -230,7 +236,9 @@ def test_serve_warmup_autotunes_with_zero_request_path_compiles():
     warm = engine.warmup()
     # the warmup artifact names the impl each bucket's executable dispatches,
     # with the tuner's candidate timings attached
-    assert warm["quantum_impl"]["4"]["impl"] in ("dense", "pallas", "tensor")
+    assert warm["quantum_impl"]["4"]["impl"] in (
+        "dense", "dense_fused", "pallas", "tensor",
+    )
     assert warm["quantum_impl"]["4"].get("autotuned") is True
     assert "dense" in warm["quantum_impl"]["4"]["candidates"]
     # the winner is the persisted table's infer-mode selection

@@ -195,3 +195,64 @@ def test_all_children_fail_yields_structured_error(benchmod, monkeypatch):
     assert rec["platform"] == "none"
     assert rec["value"] is None
     assert "error" in rec
+
+
+def test_transfer_guard_trip_records_counted_transfer(benchmod):
+    """A sub-bench killed by the timed loop's strict transfer guard records
+    host_transfers=1 (so the report gate fails on a counted transfer), while
+    ordinary failures stay plain error entries."""
+    trip = benchmod._bench_error_entry(
+        RuntimeError("Disallowed host-to-device transfer ... transfer guard")
+    )
+    assert trip["host_transfers"] == 1 and "error" in trip
+    trip2 = benchmod._bench_error_entry(
+        RuntimeError("jax_transfer_guard_device_to_host: device-to-host transfer")
+    )
+    assert trip2["host_transfers"] == 1
+    plain = benchmod._bench_error_entry(ValueError("backend init hang"))
+    assert "host_transfers" not in plain and "error" in plain
+
+
+def test_probe_storm_collapses_to_structured_summary(benchmod, monkeypatch):
+    """The BENCH_r05 retry-storm artifact shape is gone: N identical timeout
+    tails collapse into ONE probe_attempts summary (outcome counts, window)
+    plus a single structured probe_unavailable record on artifacts that never
+    reached the TPU."""
+    # seed a storm-shaped probe log (what 10 timed-out attempts produce)
+    benchmod.PROBE_LOG.extend(
+        {"t": 60.0 * i, "timeout_s": 45, "result": "probe timed out after 45s (backend init hang)"}
+        for i in range(9)
+    )
+    benchmod.PROBE_LOG.append({"t": 580.0, "timeout_s": 150, "result": "rc!=0"})
+    summary = benchmod.summarize_probe_log()
+    assert summary["attempts"] == 10
+    assert summary["outcomes"] == {
+        "probe timed out after 45s (backend init hang)": 9,
+        "rc!=0": 1,
+    }
+    assert summary["window_s"] == 580.0
+    assert summary["first"]["t"] == 0.0 and summary["last"]["result"] == "rc!=0"
+    # no successful probe anywhere -> the single structured outcome
+    down = benchmod.probe_unavailable_outcome(600.0, 450.0)
+    assert down is not None and down["probe_budget_s"] == 600.0
+    # one success anywhere in the campaign suppresses it
+    benchmod.PROBE_LOG.append({"t": 700.0, "timeout_s": 45, "result": "ok"})
+    assert benchmod.probe_unavailable_outcome(600.0, 450.0) is None
+
+    # end to end: a fallback record carries the summary, not the raw tails
+    benchmod.PROBE_LOG.clear()
+    monkeypatch.setattr(benchmod, "probe_tpu", lambda **kw: "down")
+
+    def fake_child(env, platform, timeout_s):
+        return {
+            "backend": "cpu",
+            "hdce_f32": {"samples_per_sec": 10.0, "model_tflops": 0.1},
+        }
+
+    monkeypatch.setattr(benchmod, "_run_bench_child", fake_child)
+    monkeypatch.setenv("QDML_BENCH_WALL_BUDGET_S", "1")
+    rc, rec = _run_main(benchmod)
+    assert rc == 0
+    assert isinstance(rec["probe_attempts"], dict)  # summary, not a list
+    assert "probe_unavailable" in rec
+    assert rec["probe_unavailable"]["probe_budget_s"] > 0

@@ -277,6 +277,35 @@ def test_forced_nan_qsc_run_trips_watchdog_with_restorable_dump(tmp_path):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+def test_epoch_aggregate_watchdog_trips_with_probes_compiled_out(tmp_path):
+    """probe_every=0 pins zero steady-state transfers in the fused loops —
+    but divergence must STILL raise: the watchdog checks the epoch-aggregate
+    loss (one existing epoch-end fetch, NaN propagates through the on-device
+    sum). The trip lands at epoch granularity with the epoch-aggregate
+    reason."""
+    from qdml_tpu.train.qsc import train_classifier
+
+    cfg = tiny_cfg(
+        **{
+            "train.probe_every": 0,
+            "train.n_epochs": 2,
+            "eval.results_dir": str(tmp_path / "results"),
+        }
+    )
+    cfg = dataclasses.replace(
+        cfg,
+        quantum=QuantumConfig(
+            n_qubits=4, use_quantumnat=True, noise_level=float("inf")
+        ),
+    )
+    with pytest.raises(DivergenceError) as ei:
+        train_classifier(cfg, quantum=True, workdir=str(tmp_path / "wd"))
+    assert ei.value.reason.startswith("epoch-aggregate")
+    assert ei.value.dump_dir is not None
+    bundle = json.load(open(os.path.join(ei.value.dump_dir, "bundle.json")))
+    assert bundle["reason"].startswith("epoch-aggregate")
+
+
 def test_watchdog_disabled_lets_nan_run_continue(tmp_path):
     """train.watchdog=false restores the old silently-NaN behavior (the knob
     must actually disconnect the trip, not just the dump)."""
@@ -316,9 +345,13 @@ def test_hdce_loop_emits_numerics_and_cost_records(tmp_path):
     assert lines[0]["kind"] == "manifest"
     numerics = [l for l in lines if l.get("kind") == "numerics"]
     assert numerics and numerics[0]["name"] == "hdce_train"
-    assert numerics[0]["grad_norm"] > 0 and numerics[0]["nonfinite"] == 0
+    # the default loop is the K=1 scan-fused dispatch: probe leaves carry a
+    # leading (K,) axis, so the record's scalars arrive as length-K lists
+    assert np.all(np.asarray(numerics[0]["grad_norm"]) > 0)
+    assert np.all(np.asarray(numerics[0]["nonfinite"]) == 0)
     costs = [l for l in lines if l.get("kind") == "cost"]
-    assert costs and costs[0]["name"] == "hdce_train_step"
+    assert costs and costs[0]["name"] == "hdce_train_scan"
+    assert costs[0]["scan_steps"] == 1
     assert costs[0]["available"] is True
     assert costs[0]["flops"] > 0 and costs[0]["bytes_accessed"] > 0
     assert costs[0]["roofline"] in ("compute-bound", "memory-bound")
@@ -387,6 +420,30 @@ def test_cost_analyze_degrades_when_backend_unavailable():
 def test_cost_analyze_jit_never_raises_on_bad_args():
     rec = cost.analyze_jit(jax.jit(lambda x: x), object())
     assert rec["available"] is False and "lowering failed" in rec["reason"]
+
+
+def test_achieved_roofline_fraction_math_and_degradation():
+    """achieved_roofline: ceiling = min(peak, bw * intensity); fraction =
+    flops * rate / ceiling; degrades to None (never raises) on unavailable
+    or flops-free cost blocks — the bench record ships without it."""
+    peak, bw = cost._PLATFORM_PEAKS["cpu"]
+    # memory-bound program: intensity below the ridge
+    c = {"available": True, "platform": "cpu", "flops": 1e9, "bytes_accessed": 1e9}
+    rec = cost.achieved_roofline(c, programs_per_sec=2.0)
+    assert rec["bound"] == "memory" and rec["arithmetic_intensity"] == 1.0
+    assert rec["ceiling_tflops_per_s"] == pytest.approx(bw * 1.0 / 1e12)
+    # the record rounds to 6 decimals — compare at that precision
+    assert rec["fraction"] == pytest.approx(2e9 / (bw * 1.0), rel=1e-4)
+    # compute-bound program: intensity far past the ridge
+    c2 = {"available": True, "platform": "cpu", "flops": 1e12, "bytes_accessed": 1e7}
+    rec2 = cost.achieved_roofline(c2, programs_per_sec=0.01)
+    assert rec2["bound"] == "compute"
+    assert rec2["ceiling_tflops_per_s"] == pytest.approx(peak / 1e12)
+    # degradation: unavailable / missing numbers / zero rate -> None
+    assert cost.achieved_roofline({"available": False}, 1.0) is None
+    assert cost.achieved_roofline({"available": True, "flops": 1e9}, 1.0) is None
+    assert cost.achieved_roofline(c, 0.0) is None
+    assert cost.achieved_roofline(None, 1.0) is None
 
 
 def test_maybe_emit_cost_inert_without_sink(tmp_path):

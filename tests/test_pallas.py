@@ -219,7 +219,7 @@ def test_quantumnat_noise_stream_identical_across_impls():
     x = jnp.asarray(rng.standard_normal((4, 16, 8, 2)).astype(np.float32))
     key = jax.random.PRNGKey(7)
     outs = {}
-    for impl in ("dense", "pallas", "tensor"):
+    for impl in ("dense", "dense_fused", "pallas", "tensor"):
         m = QSCP128(n_qubits=4, n_layers=2, use_quantumnat=True, noise_level=0.3, impl=impl)
         variables = m.init(jax.random.PRNGKey(0), x, train=False)
         outs[impl] = np.asarray(
@@ -227,6 +227,7 @@ def test_quantumnat_noise_stream_identical_across_impls():
         )
     np.testing.assert_allclose(outs["dense"], outs["tensor"], rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(outs["dense"], outs["pallas"], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs["dense"], outs["dense_fused"], rtol=1e-4, atol=1e-5)
 
 
 def test_fused_qsc_odd_batch_and_lead_shape():

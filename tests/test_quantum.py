@@ -131,7 +131,7 @@ def test_circuit_matches_numpy_reference(n, layers):
     angles = rng.uniform(-1, 1, (5, n)).astype(np.float32)
     weights = rng.uniform(-np.pi, np.pi, (layers, n, 2)).astype(np.float32)
     want = np.stack([np_reference_circuit(a, weights, n, layers) for a in angles])
-    for backend in ("tensor", "dense"):
+    for backend in ("tensor", "dense", "dense_fused"):
         got = run_circuit(jnp.asarray(angles), jnp.asarray(weights), n, layers, backend)
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
 
@@ -254,6 +254,93 @@ def test_resolve_impl_precedence(monkeypatch, tmp_path):
         assert resolve_impl("pallas_tensor", "auto", 7, 3, 64) == "pallas_circuit"
     finally:
         autotune.invalidate_cache()
+
+
+# ---------------------------------------------------------------------------
+# dense_fused: gate-matrix-cached / layer-fused unitary build (PR-5 pins
+# extended to the fused impl — values AND grads, f32 and bf16, whole window)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,layers", [(2, 1), (4, 2), (6, 3), (8, 2), (10, 1)])
+def test_fused_ansatz_unitary_matches_unfused(n, layers):
+    """fused_ansatz_unitary (one vectorized trig shot + layer-batched real
+    kron + cached z_signs phase einsum) == the per-gate kron chain, across
+    the whole dense win window."""
+    from qdml_tpu.quantum import fused_ansatz_unitary, fused_layer_unitaries
+
+    rng = np.random.default_rng(n * 10 + layers)
+    w = jnp.asarray(rng.uniform(-np.pi, np.pi, (layers, n, 2)).astype(np.float32))
+    want = ansatz_unitary(w, n, layers).to_numpy()
+    got = fused_ansatz_unitary(w, n, layers).to_numpy()
+    np.testing.assert_allclose(got, want, atol=2e-6)
+    # per-layer: each fused layer unitary is itself unitary
+    layers_u = fused_layer_unitaries(w, n, layers)
+    for l in range(layers):
+        u = layers_u.to_numpy()[l]
+        np.testing.assert_allclose(u @ u.conj().T, np.eye(1 << n), atol=1e-5)
+
+
+@pytest.mark.parametrize("n,layers", [(2, 1), (4, 3), (6, 3), (8, 2), (10, 1)])
+def test_dense_fused_values_and_grads_match_dense(n, layers):
+    """Values AND weight-gradients of the dense_fused impl match the unfused
+    dense path over the supported window (the dispatcher may swap one for
+    the other at any shape, so divergence anywhere is a silent training
+    change)."""
+    rng = np.random.default_rng(n)
+    angles = jnp.asarray(rng.uniform(-1, 1, (5, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(0, 2 * np.pi, (layers, n, 2)).astype(np.float32))
+    a = run_circuit(angles, w, n, layers, "dense")
+    b = run_circuit(angles, w, n, layers, "dense_fused")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+    def loss(w, backend):
+        return jnp.sum(run_circuit(angles, w, n, layers, backend) ** 2)
+
+    ga = jax.grad(lambda w: loss(w, "dense"))(w)
+    gb = jax.grad(lambda w: loss(w, "dense_fused"))(w)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), rtol=1e-3, atol=1e-5)
+
+
+def test_dense_fused_bf16_inputs_match_dense():
+    """bf16 activations (the MXU fast path feeds bf16 angles into the
+    circuit): fused and unfused agree at bf16 precision, values and grads."""
+    n, layers = 6, 3
+    rng = np.random.default_rng(9)
+    angles = jnp.asarray(rng.uniform(-1, 1, (7, n)).astype(np.float32)).astype(
+        jnp.bfloat16
+    )
+    w = jnp.asarray(rng.uniform(0, 2 * np.pi, (layers, n, 2)).astype(np.float32))
+    a = run_circuit(angles, w, n, layers, "dense")
+    b = run_circuit(angles, w, n, layers, "dense_fused")
+    np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=2e-2, atol=2e-2
+    )
+
+    def loss(w, backend):
+        return jnp.sum(run_circuit(angles, w, n, layers, backend) ** 2)
+
+    ga = jax.grad(lambda w: loss(w, "dense"))(w)
+    gb = jax.grad(lambda w: loss(w, "dense_fused"))(w)
+    np.testing.assert_allclose(
+        np.asarray(ga, np.float32), np.asarray(gb, np.float32), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_dense_fused_jit_vmap_and_lead_shapes():
+    """dense_fused composes with jit/vmap and preserves lead shapes like
+    every other impl (the dispatcher's substitutability contract)."""
+    n, layers = 4, 2
+    rng = np.random.default_rng(4)
+    angles = jnp.asarray(rng.uniform(-1, 1, (3, 5, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(0, 2 * np.pi, (layers, n, 2)).astype(np.float32))
+    f = jax.jit(lambda a, w: run_circuit(a, w, n, layers, "dense_fused"))
+    out = f(angles, w)
+    assert out.shape == (3, 5, n)
+    want = run_circuit(angles.reshape(-1, n), w, n, layers, "dense")
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(-1, n), np.asarray(want), rtol=1e-4, atol=1e-5
+    )
 
 
 def test_trajectories_p0_matches_clean_circuit():

@@ -282,6 +282,54 @@ def test_report_heterogeneous_current_platforms_disarm_gate(tmp_path):
     assert not armed and "span platforms" in md
 
 
+def test_report_roofline_fraction_gate(tmp_path):
+    """The roofline-fraction rows gate with the inverted sign: the fraction
+    DROPPING beyond the threshold is the regression; holding or rising is
+    not (docs/ROOFLINE.md)."""
+    from qdml_tpu.telemetry.report import build_report_data
+
+    def art(name, frac):
+        rec = _bench_record(1000.0)
+        rec["details"]["hdce_f32"]["roofline"] = {"fraction": frac, "bound": "memory"}
+        return _write(tmp_path, name, rec)
+
+    base = art("b.json", 0.50)
+    ok = build_report_data([art("ok.json", 0.47)], base, 10.0)
+    assert not ok["regressions"]
+    assert any(
+        g["kind"] == "roofline" and g["status"] == "ok" for g in ok["gates"]
+    )
+    bad = build_report_data([art("bad.json", 0.30)], base, 10.0)
+    assert any(
+        r["metric"] == "hdce_f32.roofline_fraction" for r in bad["regressions"]
+    )
+    assert "roofline fraction" in bad["markdown"]
+
+
+def test_report_host_transfer_gate_forces_exit_even_disarmed(tmp_path):
+    """A reappearing steady-state host transfer is a program property: it
+    forces the regression exit even when the perf gate is disarmed by a
+    platform mismatch (the lint-gate rule applied to transfers)."""
+    from qdml_tpu.telemetry.report import build_report_data
+
+    def art(name, ht, platform):
+        rec = _bench_record(1000.0, platform=platform)
+        rec["details"]["hdce_f32"]["host_transfers"] = ht
+        return _write(tmp_path, name, rec)
+
+    base = art("b.json", 0, "tpu-v5e")
+    cur = art("c.json", 3, "cpu_fallback")  # platform mismatch disarms perf
+    data = build_report_data([cur], base, 10.0)
+    assert not data["gate_armed"] and data["transfer_failed"]
+    assert any(g["kind"] == "host-transfers" and g["status"] == "regression"
+               for g in data["gates"])
+    assert report_main([f"--current={cur}", f"--baseline={base}"]) == EXIT_REGRESSION
+    # equal (zero) transfers: ok row, no forced exit
+    cur2 = art("c2.json", 0, "tpu-v5e")
+    data2 = build_report_data([cur2], base, 10.0)
+    assert not data2["transfer_failed"]
+
+
 def test_report_main_usage_errors(tmp_path, capsys):
     assert report_main([]) == EXIT_USAGE
     assert report_main(["--current=/no/such", "--baseline=/no/such"]) == EXIT_USAGE
