@@ -39,7 +39,16 @@ DEFAULT_PATHS: tuple[str, ...] = (
 # is exempt: construction happens-before any sharing).
 LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
     "qdml_tpu/serve/batcher.py": {"MicroBatcher": {"_q": "_lock"}},
-    "qdml_tpu/serve/server.py": {"ServeLoop": {"_live_workers": "_exit_lock"}},
+    # pool-wide worker-exit accounting: every replica's workers share one
+    # coordinator, and an unlocked read is exactly the "crashed worker sheds
+    # a queue its peers are draining" race the counter exists to prevent
+    "qdml_tpu/serve/server.py": {"ExitCoordinator": {"_live": "_lock"}},
+    # hot-swap epoch state: the live (hdce, clf) param tuple and its epoch
+    # counter swap atomically between batches — a read outside the lock can
+    # see a torn checkpoint mid-swap
+    "qdml_tpu/serve/engine.py": {
+        "ServeEngine": {"_live": "_swap_lock", "_swap_epoch": "_swap_lock"}
+    },
 }
 
 # (file, ClassName.method) host-side hot paths audited for device->host

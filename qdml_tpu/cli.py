@@ -25,11 +25,15 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
                                   [--durations=F] [--paths=...] [--list-rules]
                                   # graftlint static analysis gate
                                   # (docs/ANALYSIS.md); exit 1 on new findings
-    python -m qdml_tpu.cli serve  [--serve.port=8377 ...]  # online inference:
-                                  # restore ckpt, AOT-warm buckets, JSON/TCP loop
-                                  # ({"op": "metrics"} returns live counters)
-    python -m qdml_tpu.cli loadgen [--rate=RPS] [--n=N]    # open-loop Poisson
-                                  # traffic vs an in-process warmed engine
+    python -m qdml_tpu.cli serve  [--serve.port=8377 --serve.replicas=N ...]
+                                  # online inference: restore ckpt, AOT-warm
+                                  # buckets (mesh-sharded when >1 device),
+                                  # replica pool, JSON/TCP loop ({"op":
+                                  # "metrics"} live counters; {"op": "swap"}
+                                  # zero-downtime checkpoint hot-swap)
+    python -m qdml_tpu.cli loadgen [--rate=RPS] [--n=N]    # open-loop traffic
+                                  # (--serve.arrival=poisson|bursty|diurnal)
+                                  # vs an in-process warmed engine/pool
 
 Every command's metrics JSONL starts with a run-manifest header (config hash,
 git SHA, device topology, perf knobs, seeds) and carries span/counter records
@@ -325,17 +329,23 @@ def main(argv: list[str] | None = None) -> int:
             )
             print("wrote:\n  " + "\n  ".join(written))
         elif cmd == "serve":
+            from qdml_tpu.parallel.mesh import serve_mesh
             from qdml_tpu.serve import ServeEngine
             from qdml_tpu.serve.server import run_server
             from qdml_tpu.telemetry import span as _span
 
-            engine = ServeEngine.from_workdir(cfg, workdir)
+            # mesh before the engine: every bucket executable bakes in its
+            # sharding at warmup (docs/SERVING.md, "sharded serving")
+            engine = ServeEngine.from_workdir(cfg, workdir, mesh=serve_mesh(cfg))
             with _span("serve_warmup", buckets=list(engine.buckets)):
                 engine.warmup()
-            run_server(cfg, engine, logger=logger)
+            # workdir arms the {"op": "swap"} hot-swap verb: a training run
+            # promoting a new *_best deploys without restarting the server
+            run_server(cfg, engine, logger=logger, workdir=workdir)
         elif cmd == "loadgen":
             import json
 
+            from qdml_tpu.parallel.mesh import serve_mesh
             from qdml_tpu.serve import ServeEngine
             from qdml_tpu.serve.loadgen import run_loadgen
 
@@ -345,7 +355,7 @@ def main(argv: list[str] | None = None) -> int:
             n = int(next(
                 (e.split("=", 1)[1] for e in extra if e.startswith("--n=")), 512
             ))
-            engine = ServeEngine.from_workdir(cfg, workdir)
+            engine = ServeEngine.from_workdir(cfg, workdir, mesh=serve_mesh(cfg))
             deadline = cfg.serve.deadline_ms if cfg.serve.deadline_ms > 0 else None
             summary = run_loadgen(
                 cfg, engine, rate=rate, n=n, deadline_ms=deadline, logger=logger

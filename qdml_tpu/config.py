@@ -234,6 +234,20 @@ class ServeConfig:
     max_batch: int = 64        # largest (and last) bucket; batches never exceed it
     max_wait_ms: float = 2.0   # coalescing window before a partial batch flushes
     max_queue: int = 256       # bounded request queue; beyond it, shed Overloaded
+    # Mesh sharding of the fused request-path executable: "auto" pjit-shards
+    # every AOT bucket over the (fed, data, model) mesh whenever more than
+    # one device is visible (batch axis data-parallel; buckets not divisible
+    # by the data-axis size stay replicated), "off" pins the PR-2
+    # single-device layout regardless of topology.
+    shard: str = "auto"
+    # Shard the stacked per-scenario trunks over the mesh "fed" axis (expert
+    # parallelism for the all-trunks pass) — requires mesh.fed_axis ==
+    # data.n_scenarios, exactly like federated training/eval placement.
+    expert_sharding: bool = False
+    # Replica pool size: N ServeLoops sharing ONE warmup, ONE autotune table
+    # and ONE MicroBatcher feed (serve/server.py ReplicaPool). Per-replica
+    # ServeMetrics merge exactly via Histogram.merge.
+    replicas: int = 1
     # Default per-request deadline in ms; 0 disables. Requests whose deadline
     # has passed are shed (typed Overloaded) at admission or dequeue, never
     # silently served late.
@@ -252,6 +266,15 @@ class ServeConfig:
     # which the serve loop forwards into every affected request future. OFF
     # (default) compiles exactly today's program — zero extra compiles.
     checkify: bool = False
+    # Loadgen arrival process: "poisson" (open-loop, PR-2), "bursty"
+    # (two-state Markov-modulated Poisson — mean rate preserved, burst/lull
+    # phases with rate ratio `burstiness`), or "diurnal" (replayed
+    # sinusoidal-rate trace via thinning — a compressed day/night cycle).
+    arrival: str = "poisson"
+    # Arrival-process shape knob: the bursty lull-state rate is
+    # rate/burstiness (burst state balances to keep the mean), and the
+    # diurnal peak-to-trough ratio grows with it — serve/loadgen.arrival_times.
+    burstiness: float = 4.0
     # Local socket endpoint for `qdml-tpu serve`.
     host: str = "127.0.0.1"
     port: int = 8377

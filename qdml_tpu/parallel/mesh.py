@@ -94,5 +94,48 @@ def training_mesh(cfg) -> Mesh | None:
     return mesh
 
 
+def serve_mesh(cfg) -> Mesh | None:
+    """Mesh for the serving engine, or ``None`` for the single-device layout.
+
+    The request path's twin of :func:`training_mesh`: ``serve.shard="auto"``
+    (default) builds the (fed, data, model) mesh whenever more than one
+    device is visible, so every AOT bucket executable is lowered with its
+    batch axis data-parallel across the whole topology; ``"off"`` pins the
+    single-device PR-2 layout regardless of device count. Expert sharding
+    (``serve.expert_sharding``) additionally requires the fed axis to equal
+    the scenario count — validated here, before any bucket compiles, with
+    the same message contract as training.
+    """
+    if cfg.serve.shard not in ("auto", "off"):
+        raise ValueError(
+            f"serve.shard must be 'auto' or 'off', got {cfg.serve.shard!r}"
+        )
+    if cfg.serve.shard == "off":
+        if cfg.serve.expert_sharding:
+            # contradictory on its face — never silently un-shard the experts
+            raise ValueError(
+                "serve.expert_sharding=true requires sharding: remove "
+                "serve.shard='off' (or drop expert_sharding)"
+            )
+        return None
+    mesh = training_mesh(cfg)
+    if mesh is None:
+        if cfg.serve.expert_sharding:
+            # portable configs run on laptops too: degrade loudly, not
+            # silently (the single visible device serves every expert)
+            print(
+                "note: serve.expert_sharding requested but only one device "
+                "is visible — serving single-device, experts unsharded"
+            )
+        return None
+    if cfg.serve.expert_sharding and mesh.shape[cfg.mesh.fed_axis_name] != cfg.data.n_scenarios:
+        raise ValueError(
+            f"serve.expert_sharding needs mesh.fed_axis == data.n_scenarios "
+            f"({cfg.data.n_scenarios}); the mesh has fed="
+            f"{mesh.shape[cfg.mesh.fed_axis_name]}"
+        )
+    return mesh
+
+
 def single_device_mesh() -> Mesh:
     return Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1), ("fed", "data", "model"))

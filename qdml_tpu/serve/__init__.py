@@ -10,10 +10,20 @@ serve heavy traffic). A checkpoint goes online in three layers:
 - :class:`~qdml_tpu.serve.batcher.MicroBatcher` — bounded queue, dynamic
   max-batch/max-wait coalescing into power-of-two buckets, deadline-aware
   admission that sheds typed ``Overloaded`` results;
-- :class:`~qdml_tpu.serve.server.ServeLoop` / ``qdml-tpu serve`` — the
-  worker pump and a newline-JSON local socket front-end; ``qdml-tpu
-  loadgen`` (:mod:`qdml_tpu.serve.loadgen`) drives it with open-loop
-  Poisson traffic and reports tail latency + offline-forward parity.
+- :class:`~qdml_tpu.serve.server.ServeLoop` /
+  :class:`~qdml_tpu.serve.server.ReplicaPool` / ``qdml-tpu serve`` — the
+  worker pump, the N-replica pool sharing one warmup + one batcher feed,
+  and a newline-JSON local socket front-end (live ``metrics`` and
+  zero-downtime checkpoint ``swap`` verbs); ``qdml-tpu loadgen``
+  (:mod:`qdml_tpu.serve.loadgen`) drives it with open-loop Poisson /
+  bursty-MMPP / diurnal traffic and reports tail latency, SLO attainment
+  and offline-forward parity.
+
+With a multi-device mesh (``parallel.mesh.serve_mesh``) every bucket
+executable is pjit-sharded: batch data-parallel over ``data``, params
+replicated (or trunks expert-sharded over ``fed``), and checkpoint
+hot-swap (``ServeEngine.swap_params``) re-places new params with the live
+shardings — zero recompiles, proven by the compile-cache counters.
 
 Architecture, bucket/warmup policy, overload semantics and telemetry record
 shapes: ``docs/SERVING.md``.
@@ -25,9 +35,19 @@ from qdml_tpu.serve.batcher import (  # noqa: F401
     power_of_two_buckets,
 )
 from qdml_tpu.serve.engine import ServeEngine  # noqa: F401
-from qdml_tpu.serve.loadgen import make_request_samples, run_loadgen  # noqa: F401
+from qdml_tpu.serve.loadgen import (  # noqa: F401
+    arrival_times,
+    make_request_samples,
+    run_loadgen,
+)
 from qdml_tpu.serve.metrics import ServeMetrics  # noqa: F401
-from qdml_tpu.serve.server import ServeLoop, run_server, serve_async  # noqa: F401
+from qdml_tpu.serve.server import (  # noqa: F401
+    ExitCoordinator,
+    ReplicaPool,
+    ServeLoop,
+    run_server,
+    serve_async,
+)
 from qdml_tpu.serve.types import (  # noqa: F401
     Overloaded,
     Prediction,

@@ -81,6 +81,14 @@ class MicroBatcher:
         self.clock = clock
         self._q: deque[Request] = deque()
         self._lock = threading.Lock()
+        # Wake signal owned by the QUEUE, not any one consumer: a replica
+        # pool runs several ServeLoops draining this one batcher, and a
+        # submit must be able to wake whichever replica's worker is idle
+        # (an Event wakes every waiter; each loop's bounded wait_hint sleep
+        # caps the staleness of a racing clear at max_wait_s, exactly the
+        # single-loop behavior). Loops wait on this instead of a private
+        # event; submit() sets it on every successful enqueue.
+        self.wake = threading.Event()
 
     @property
     def depth(self) -> int:
@@ -99,6 +107,7 @@ class MicroBatcher:
             if len(self._q) >= self.max_queue:
                 return Overloaded(req.rid, QUEUE_FULL)
             self._q.append(req)
+        self.wake.set()
         return None
 
     def next_batch(

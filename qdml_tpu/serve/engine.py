@@ -1,4 +1,5 @@
-"""Serving engine: fused HDCE inference, bucketed AOT warmup, zero request-path compiles.
+"""Serving engine: fused HDCE inference, bucketed AOT warmup, zero request-path
+compiles — sharded over the mesh, hot-swappable under live traffic.
 
 The online pipeline is the eval sweep's forward (``eval/sweep.py``) stripped
 to its serving core: scenario classifier -> argmax -> run ALL stacked
@@ -18,6 +19,24 @@ bench — must keep seeing the run's true totals). The request path itself
 calls pre-compiled executables only; an un-warmed shape raises instead of
 silently tracing.
 
+Sharding (``parallel/mesh.serve_mesh``): with a mesh, every bucket executable
+is lowered with explicit ``NamedSharding`` in_shardings — the batch axis
+data-parallel over ``data`` (buckets the data-axis size does not divide stay
+replicated; the executable is still one SPMD program), params replicated,
+and with ``serve.expert_sharding`` the stacked per-scenario trunks sharded
+over ``fed`` (the federated placement rules, ``parallel/federated.py``, so
+serve- and eval-time expert layouts cannot drift). The sharding is BAKED
+into each compiled executable exactly like the autotuned circuit impl, and
+the zero-request-path-compiles pin is unchanged.
+
+Hot-swap (:meth:`swap_params`): checkpoints restore eval-only and shapes are
+fixed, so new params ``device_put`` with the LIVE shardings slot into the
+existing executables with zero recompiles (pinned via the compile-cache
+counters). The live param tuple swaps atomically under ``_swap_lock``
+between batches; in-flight batches keep the old committed arrays (XLA holds
+the buffers until their dispatches retire), so no request ever sees a torn
+checkpoint.
+
 Padding: batches pad with zeros up to the bucket size and outputs are sliced
 back to the real count. Every per-sample op in the pipeline (convs, eval-mode
 BatchNorm over running stats, dense heads, the routing gather) is
@@ -27,11 +46,14 @@ valid-count slice.
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.models.cnn import SCP128
@@ -55,9 +77,11 @@ class ServeEngine:
         clf_vars: dict,
         quantum: bool = False,
         buckets: tuple[int, ...] | None = None,
+        mesh: Any | None = None,
     ):
         self.cfg = cfg
         self.quantum = quantum
+        self.mesh = mesh
         self.buckets = tuple(
             sorted(buckets or cfg.serve.buckets or power_of_two_buckets(cfg.serve.max_batch))
         )
@@ -77,11 +101,27 @@ class ServeEngine:
             )
         else:
             self.clf = SCP128(n_classes=cfg.quantum.n_classes)
-        # Commit vars to device once: checkpoints restore as host numpy, and
-        # re-transferring the params on every request batch would make the
-        # serving path host-bandwidth-bound.
-        self._hdce_vars = jax.tree.map(jnp.asarray, hdce_vars)
-        self._clf_vars = jax.tree.map(jnp.asarray, clf_vars)
+        # Param placement: commit vars to device once (checkpoints restore as
+        # host numpy, and re-transferring on every request batch would make
+        # serving host-bandwidth-bound). With a mesh the placement carries
+        # the NamedShardings every bucket executable is lowered against —
+        # swap_params re-places new checkpoints with these SAME shardings,
+        # which is what makes the swap recompile-free.
+        self._var_shardings = self._build_var_shardings(hdce_vars, clf_vars)
+        self._swap_lock = threading.Lock()
+        # serializes whole swaps (resolve -> restore -> validate -> place ->
+        # flip): two concurrent {"op": "swap"}s racing check-then-act could
+        # land in reverse completion order and leave the OLDER checkpoint
+        # live — so swap_from_workdir holds it across the workdir resolve and
+        # restore too, not just the flip (reentrant: swap_params re-acquires
+        # on the same thread). Never held on the request path — infer only
+        # takes the inner _swap_lock.
+        self._swap_gate = threading.RLock()
+        self._swap_epoch = 0
+        self._live = (
+            self._place(hdce_vars, self._var_shardings[0]),
+            self._place(clf_vars, self._var_shardings[1]),
+        )
         self._compiled: dict[int, Any] = {}
         # serve.checkify: the buckets hold checkified executables returning
         # (err, (h, pred)); infer() raises typed DivergenceError on a trip
@@ -91,10 +131,58 @@ class ServeEngine:
         # per-bucket XLA cost records (flops/bytes/peak memory/roofline),
         # filled by warmup from each AOT-compiled executable
         self.bucket_cost: dict[str, dict] = {}
+        # per-bucket batch-axis partitioning actually baked into the
+        # executable ("data" or "replicated") — warmup fills it, the
+        # serve_summary fleet block reports it
+        self.bucket_sharding: dict[str, str] = {}
         # quantum classifier only: the circuit implementation each bucket's
         # AOT executable dispatches (autotuned at warmup — docs/QUANTUM.md),
         # plus the candidate timings when the tuner actually ran
         self.quantum_impl: dict[str, Any] = {}
+
+    # -- placement / sharding ------------------------------------------------
+
+    def _build_var_shardings(self, hdce_vars: dict, clf_vars: dict):
+        """(hdce, clf) NamedSharding trees, or (None, None) single-device."""
+        if self.mesh is None:
+            return (None, None)
+        if self.cfg.serve.expert_sharding:
+            from qdml_tpu.parallel.federated import hdce_state_shardings
+
+            hdce_sh = hdce_state_shardings(
+                hdce_vars, self.mesh, n_scenarios=self.cfg.data.n_scenarios
+            )
+        else:
+            hdce_sh = jax.tree.map(lambda _: NamedSharding(self.mesh, P()), hdce_vars)
+        clf_sh = jax.tree.map(lambda _: NamedSharding(self.mesh, P()), clf_vars)
+        return (hdce_sh, clf_sh)
+
+    def _place(self, tree: Any, shardings: Any) -> Any:
+        if shardings is None:
+            return jax.tree.map(jnp.asarray, tree)
+        # single-controller placement (the serve path is a local server; a
+        # multi-controller frontend would route through the jitted-identity
+        # placer like parallel/federated._place)
+        return jax.tree.map(jax.device_put, tree, shardings)
+
+    def _x_sharding(self, b: int) -> NamedSharding | None:
+        """Batch-axis sharding for bucket ``b``: data-parallel when the data
+        axis divides it, replicated otherwise (tiny buckets below the device
+        count run everywhere rather than compiling an uneven layout)."""
+        if self.mesh is None:
+            return None
+        dp = self.mesh.shape[self.cfg.mesh.data_axis_name]
+        return NamedSharding(self.mesh, P("data") if b % dp == 0 else P())
+
+    def mesh_topology(self) -> dict | None:
+        """Fleet-facing mesh facts for serve_summary / the report gate."""
+        if self.mesh is None:
+            return None
+        return {
+            "devices": int(np.prod(list(self.mesh.shape.values()))),
+            "axes": {k: int(v) for k, v in self.mesh.shape.items()},
+            "expert_sharding": bool(self.cfg.serve.expert_sharding),
+        }
 
     # -- construction -------------------------------------------------------
 
@@ -104,41 +192,159 @@ class ServeEngine:
         cfg: ExperimentConfig,
         workdir: str,
         buckets: tuple[int, ...] | None = None,
+        mesh: Any | None = None,
     ) -> "ServeEngine":
         """Restore the newest trained HDCE + classifier from ``workdir``.
 
-        Tag discovery goes through :func:`~qdml_tpu.train.checkpoint.latest_tag`
+        Tag discovery goes through
+        :func:`~qdml_tpu.train.checkpoint.restore_latest_params`
         (best > last > resume); the quantum classifier is preferred when one
         was trained (its checkpoint meta reconciles the circuit config via
         ``reconcile_quantum_cfg``, exactly like the eval CLI), falling back to
         the classical ``SCP128``.
         """
         from qdml_tpu.train.checkpoint import (
-            latest_tag,
+            CheckpointNotFoundError,
             reconcile_quantum_cfg,
-            restore_params,
+            restore_latest_params,
         )
 
-        hdce_tag = latest_tag(workdir, "hdce")
-        if hdce_tag is None:
-            raise FileNotFoundError(
-                f"no hdce checkpoint (best/last/resume) under {workdir!r} — "
-                "run `qdml-tpu train-hdce` first"
-            )
-        hdce_vars, _ = restore_params(workdir, hdce_tag)
-        qsc_tag = latest_tag(workdir, "qsc")
-        if qsc_tag is not None:
-            clf_vars, clf_meta = restore_params(workdir, qsc_tag)
+        hdce_vars, _, _ = restore_latest_params(workdir, "hdce")
+        try:
+            # one resolve-and-restore per family: a separate existence check
+            # would scan the directory twice and race checkpoint promotion.
+            # Only the typed never-trained miss falls through to the
+            # classical classifier — a failed restore of an EXISTING qsc tag
+            # (partial/corrupt checkpoint) propagates; silently downgrading a
+            # quantum deployment to SCP128 would serve the wrong model.
+            clf_vars, clf_meta, _ = restore_latest_params(workdir, "qsc")
+        except CheckpointNotFoundError:
+            pass
+        else:
             cfg = reconcile_quantum_cfg(cfg, clf_meta)
-            return cls(cfg, hdce_vars, clf_vars, quantum=True, buckets=buckets)
-        sc_tag = latest_tag(workdir, "sc")
-        if sc_tag is None:
+            return cls(cfg, hdce_vars, clf_vars, quantum=True, buckets=buckets, mesh=mesh)
+        try:
+            clf_vars, _, _ = restore_latest_params(workdir, "sc")
+        except CheckpointNotFoundError:
             raise FileNotFoundError(
                 f"no scenario-classifier checkpoint (qsc/sc) under {workdir!r} "
                 "— run `qdml-tpu train-sc` (or train-qsc) first"
+            ) from None
+        return cls(cfg, hdce_vars, clf_vars, quantum=False, buckets=buckets, mesh=mesh)
+
+    # -- live params (hot-swap) ---------------------------------------------
+
+    def live_vars(self) -> tuple[dict, dict]:
+        """One atomic read of the live ``(hdce_vars, clf_vars)`` pair. The
+        only sanctioned way to look at the serving params from outside:
+        reading the halves in two separate lock acquisitions could pair hdce
+        params from one checkpoint with clf params from the next if a swap
+        lands in between — mismatched model halves that swap_params' shape
+        validation cannot catch."""
+        with self._swap_lock:
+            return self._live
+
+    @property
+    def swap_epoch(self) -> int:
+        """Number of successful hot-swaps since construction (0 = the params
+        the engine was built with)."""
+        with self._swap_lock:
+            return self._swap_epoch
+
+    def swap_params(self, hdce_vars: dict, clf_vars: dict) -> dict:
+        """Zero-downtime checkpoint hot-swap: place new params with the LIVE
+        shardings and flip the live tuple between batches.
+
+        Shapes/dtypes/tree structure must match the serving params exactly —
+        that is the invariant that lets the existing AOT executables accept
+        the new arrays with zero compiles (validated up front; a mismatched
+        checkpoint raises ``ValueError`` and the old params keep serving).
+        In-flight batches already dispatched against the old committed arrays
+        resolve against them (XLA pins the buffers); every batch dequeued
+        after the flip sees the new checkpoint. Returns ``{"epoch", "compile"
+        <cache-counter deltas over the swap — all-zero is the gate>}``.
+        """
+        if not self._warm:
+            raise RuntimeError("swap_params before warmup() — nothing is serving yet")
+
+        def _sig(tree):
+            # shape/dtype without materializing device arrays (np.asarray on
+            # a committed sharded param would be a full device->host copy)
+            return jax.tree.map(
+                lambda a: (tuple(np.shape(a)), str(getattr(a, "dtype", "?"))), tree
             )
-        clf_vars, _ = restore_params(workdir, sc_tag)
-        return cls(cfg, hdce_vars, clf_vars, quantum=False, buckets=buckets)
+
+        # one swap at a time, end to end: validation against the live tree
+        # and the flip must not interleave with another swap's
+        with self._swap_gate:
+            with self._swap_lock:
+                live_h, live_c = self._live
+            for name, new, old in (("hdce", hdce_vars, live_h), ("clf", clf_vars, live_c)):
+                # dict equality recurses containers, so a structure mismatch
+                # compares unequal rather than raising
+                if _sig(new) != _sig(old):
+                    raise ValueError(
+                        f"hot-swap {name} params do not match the serving tree "
+                        "(structure/shape/dtype) — a shape-changing checkpoint "
+                        "needs a fresh engine + warmup, not a swap"
+                    )
+            pre = compile_cache_stats()
+            new_h = self._place(hdce_vars, self._var_shardings[0])
+            new_c = self._place(clf_vars, self._var_shardings[1])
+            # fault the transfers in OFF the request path: the first
+            # post-swap batch must not pay the host->device copy
+            jax.block_until_ready((new_h, new_c))
+            post = compile_cache_stats()
+            with self._swap_lock:
+                self._swap_epoch += 1
+                self._live = (new_h, new_c)
+                epoch = self._swap_epoch
+        rec = {
+            "epoch": epoch,
+            "compile": {k: post[k] - pre.get(k, 0) for k in post},
+        }
+        sink = get_sink()
+        if sink is not None and getattr(sink, "active", False):
+            sink.emit("counters", name="serve_swap", **rec)
+        return rec
+
+    def swap_from_workdir(self, workdir: str) -> dict:
+        """Re-resolve the newest checkpoints under ``workdir`` (best > last >
+        resume, per family) and hot-swap to them — the ``{"op": "swap"}``
+        serve verb's engine half. A training run that just promoted a new
+        ``*_best`` is deployed without restarting the server."""
+        from qdml_tpu.train.checkpoint import (
+            reconcile_quantum_cfg,
+            restore_latest_params,
+        )
+
+        # the gate spans resolve+restore+flip: restoring OUTSIDE it would let
+        # two concurrent swap verbs resolve different tags (slow orbax IO)
+        # and flip in reverse completion order — the stale checkpoint would
+        # pass swap_params' shape validation and end up live
+        with self._swap_gate:
+            hdce_vars, _, hdce_tag = restore_latest_params(workdir, "hdce")
+            clf_prefix = "qsc" if self.quantum else "sc"
+            clf_vars, clf_meta, clf_tag = restore_latest_params(workdir, clf_prefix)
+            if self.quantum:
+                # from_workdir RECONCILES the circuit config from checkpoint
+                # meta; a live engine cannot (the model is baked into every
+                # AOT executable), so the checkpoint must already match.
+                # Shape-free flags (input_norm above all) matter here:
+                # shapes/dtypes would pass swap_params validation while the
+                # serving forward silently diverged from what the new
+                # checkpoint was trained for.
+                reconciled = reconcile_quantum_cfg(self.cfg, clf_meta)
+                if reconciled.quantum != self.cfg.quantum:
+                    raise ValueError(
+                        f"hot-swap checkpoint {clf_tag!r} was trained for a "
+                        "different quantum config than this engine serves "
+                        "(see the reconcile note above) — deploy it with a "
+                        "fresh engine + warmup, not a swap"
+                    )
+            rec = self.swap_params(hdce_vars, clf_vars)
+        rec["tags"] = {"hdce": hdce_tag, clf_prefix: clf_tag}
+        return rec
 
     # -- forward ------------------------------------------------------------
 
@@ -158,7 +364,8 @@ class ServeEngine:
         (unpadded, unbucketed) batch shape — numerically the offline eval
         path. Loadgen/tests call this BEFORE :meth:`warmup` so its compile
         never pollutes the request-path compile gate."""
-        h, pred = jax.jit(self._forward)(self._hdce_vars, self._clf_vars, jnp.asarray(x))
+        hdce_live, clf_live = self.live_vars()
+        h, pred = jax.jit(self._forward)(hdce_live, clf_live, jnp.asarray(x))
         return np.asarray(jax.device_get(h)), np.asarray(jax.device_get(pred))
 
     # -- warmup -------------------------------------------------------------
@@ -185,9 +392,10 @@ class ServeEngine:
             from qdml_tpu.telemetry.sanitizer import checks
 
             fwd = _checkify.checkify(self._forward, errors=checks())
+        hdce_live, clf_live = self.live_vars()
         var_specs = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            (self._hdce_vars, self._clf_vars),
+            (hdce_live, clf_live),
         )
         hw = self.cfg.image_hw
         for b in self.buckets:
@@ -213,12 +421,21 @@ class ServeEngine:
                         rec_impl["candidates"] = entry["candidates"]
                     self.quantum_impl[str(b)] = rec_impl
                 x_spec = jax.ShapeDtypeStruct((b, *hw, 2), jnp.float32)
-                compiled = jax.jit(fwd).lower(*var_specs, x_spec).compile()
+                jit_kwargs: dict[str, Any] = {}
+                x_sh = self._x_sharding(b)
+                if x_sh is not None:
+                    # the sharding is baked into the executable exactly like
+                    # the autotuned impl: batch over `data` when it divides,
+                    # params per the placement trees — one SPMD program per
+                    # bucket, collectives on ICI, nothing decided per request
+                    jit_kwargs["in_shardings"] = (*self._var_shardings, x_sh)
+                    self.bucket_sharding[str(b)] = (
+                        "data" if x_sh.spec else "replicated"
+                    )
+                compiled = jax.jit(fwd, **jit_kwargs).lower(*var_specs, x_spec).compile()
                 # first execute outside the request path (XLA may lazily
                 # finalize; also faults in the params transfer)
-                out = compiled(
-                    self._hdce_vars, self._clf_vars, np.zeros((b, *hw, 2), np.float32)
-                )
+                out = compiled(hdce_live, clf_live, np.zeros((b, *hw, 2), np.float32))
                 h, pred = out[1] if self._checkify else out
                 jax.block_until_ready((h, pred))
                 self._compiled[b] = compiled
@@ -242,6 +459,9 @@ class ServeEngine:
             "compile": {k: post[k] - pre.get(k, 0) for k in post},
             "cost": self.bucket_cost,
         }
+        if self.mesh is not None:
+            out["mesh"] = self.mesh_topology()
+            out["sharding"] = dict(self.bucket_sharding)
         if self.quantum_impl:
             out["quantum_impl"] = self.quantum_impl
         return out
@@ -281,7 +501,10 @@ class ServeEngine:
         b = pick_bucket(n, self.buckets)
         xp = np.zeros((b, *x.shape[1:]), np.float32)
         xp[:n] = x
-        out = self._compiled[b](self._hdce_vars, self._clf_vars, xp)
+        # one atomic read of the live checkpoint per batch: a swap that lands
+        # mid-batch applies to the NEXT dequeue, never tears this one
+        hdce_live, clf_live = self.live_vars()
+        out = self._compiled[b](hdce_live, clf_live, xp)
         if self._checkify:
             err, (h, pred) = out
             # per-batch device->host error fetch: the sanitizer's contract

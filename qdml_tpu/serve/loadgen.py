@@ -1,25 +1,38 @@
-"""Open-loop Poisson load generator + offline-parity harness.
+"""Open-loop load generator (Poisson / bursty MMPP / diurnal trace) +
+offline-parity harness with SLO and fleet reporting.
 
-Open-loop means arrivals are scheduled by the Poisson clock, NOT by response
-completion — the generator keeps offering load while requests are in flight,
-which is the only traffic model that exposes queue growth, coalescing
+Open-loop means arrivals are scheduled by the arrival-process clock, NOT by
+response completion — the generator keeps offering load while requests are in
+flight, which is the only traffic model that exposes queue growth, coalescing
 behavior, and load shedding (a closed loop self-throttles and can never
 overload the server; Schroeder et al., "Open Versus Closed: A Cautionary
-Tale").
+Tale"). Three arrival processes (:func:`arrival_times`):
 
-Each run reports the three acceptance numbers for the serving engine:
+- ``poisson`` — homogeneous, exponential gaps (the PR-2 baseline);
+- ``bursty`` — two-state Markov-modulated Poisson (MMPP): exponential dwell
+  times alternate a lull state (``rate/burstiness``) with a burst state
+  (balanced so the MEAN rate stays ``rate``) — the flash-crowd shape that
+  stresses the bounded queue and deadline shedding;
+- ``diurnal`` — an inhomogeneous Poisson replay of a compressed day/night
+  rate trace (sinusoidal, peak/trough set by ``burstiness``) via thinning —
+  the million-user traffic envelope at test-run scale.
+
+Each run reports the acceptance numbers for the serving engine:
 
 - ``compile_cache_after_warmup`` — all-zero iff NO compile happened on the
-  request path (the engine resets the counters when warmup ends);
+  request path (the engine snapshots the counters when warmup ends);
 - parity — per-request estimates must match the offline eval forward on the
-  same checkpoint bit-for-bit-modulo-fp (same executable family, same
-  params; the padded bucket must not change any real row), reported as
-  ``parity_max_abs_err`` plus served-vs-offline NMSE in dB;
-- tail latency — p50/p95/p99 per-request latency, throughput, batch-fill.
+  same checkpoint bit-for-bit-modulo-fp, reported as ``parity_max_abs_err``
+  plus served-vs-offline NMSE in dB;
+- tail latency + SLO — p50/p95/p99 per-request latency, throughput, batch
+  fill, and (when deadlines are offered) the SLO-attainment fraction;
+- fleet — replica count, total workers, mesh topology and per-bucket batch
+  sharding, so ``qdml-tpu report`` can gate fleet-level rps / p99 / SLO.
 
 The summary lands in the run's manifest-headed telemetry JSONL as a
 ``serve_summary`` record, which ``qdml-tpu report`` diffs (rps into the
-throughput gate, latency percentiles into the serving-latency section).
+throughput gate, latency percentiles into the serving-latency section, SLO
+attainment into the serving-SLO gate).
 """
 
 from __future__ import annotations
@@ -33,11 +46,83 @@ from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
 from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.serve.engine import ServeEngine
-from qdml_tpu.serve.metrics import ServeMetrics
-from qdml_tpu.serve.server import ServeLoop
+from qdml_tpu.serve.server import ReplicaPool
 from qdml_tpu.serve.types import Prediction
 from qdml_tpu.telemetry import span
 from qdml_tpu.utils.metrics import nmse_db
+
+ARRIVAL_PROCESSES = ("poisson", "bursty", "diurnal")
+
+
+def arrival_times(
+    n: int,
+    rate: float,
+    rng: np.random.Generator,
+    process: str = "poisson",
+    burstiness: float = 4.0,
+    period_s: float | None = None,
+) -> np.ndarray:
+    """``n`` increasing arrival offsets (seconds from t0) with mean rate
+    ``rate`` under the named process.
+
+    ``bursty``: two-state MMPP. The lull state offers ``rate/burstiness``;
+    the burst state offers ``2*rate - rate/burstiness`` so equal expected
+    dwell in each state preserves the mean. Dwells are exponential with mean
+    ~20 arrivals, so a run of a few hundred requests sees several
+    burst/lull cycles.
+
+    ``diurnal``: inhomogeneous Poisson via thinning against the peak rate of
+    a sinusoidal day trace ``rate * (1 + depth*sin(2*pi*t/period))`` with
+    ``depth = 1 - 1/burstiness`` (burstiness 4 -> peak/trough ratio 7); the
+    ``period_s`` default compresses ~2 "days" into the run.
+    """
+    if process not in ARRIVAL_PROCESSES:
+        raise ValueError(
+            f"unknown arrival process {process!r} (have {ARRIVAL_PROCESSES})"
+        )
+    if rate <= 0 or n < 1:
+        raise ValueError(f"need rate > 0 and n >= 1, got rate={rate}, n={n}")
+    if process == "poisson":
+        return np.cumsum(rng.exponential(1.0 / rate, n))
+    b = max(1.0, float(burstiness))
+    if process == "bursty":
+        r_lull = rate / b
+        r_burst = 2.0 * rate - r_lull  # equal dwell -> mean stays `rate`
+        dwell_mean = 20.0 / rate  # ~20 arrivals per state visit
+        rates = (r_lull, r_burst)
+        state = int(rng.integers(2))
+        t, next_switch = 0.0, float(rng.exponential(dwell_mean))
+        out = np.empty(n)
+        for i in range(n):
+            while True:
+                gap = float(rng.exponential(1.0 / rates[state]))
+                if t + gap < next_switch:
+                    t += gap
+                    break
+                # no arrival before the state flips: a gap drawn at the old
+                # rate must not overrun the new dwell (lull-rate gaps would
+                # swallow whole bursts and drag the realized mean under
+                # `rate`) — truncate at the switch and resample at the new
+                # state's rate; exponentials are memoryless, so this is the
+                # exact MMPP law, not an approximation
+                t = next_switch
+                state ^= 1
+                next_switch = t + float(rng.exponential(dwell_mean))
+            out[i] = t
+        return out
+    # diurnal: thinning at the trace's peak rate
+    depth = 1.0 - 1.0 / b
+    period = float(period_s) if period_s else max(n / rate / 2.0, 1e-3)
+    r_max = rate * (1.0 + depth)
+    out = np.empty(n)
+    t, i = 0.0, 0
+    while i < n:
+        t += float(rng.exponential(1.0 / r_max))
+        r_t = rate * (1.0 + depth * np.sin(2.0 * np.pi * t / period))
+        if rng.uniform() * r_max <= r_t:
+            out[i] = t
+            i += 1
+    return out
 
 
 def make_request_samples(cfg: ExperimentConfig, n: int) -> dict[str, np.ndarray]:
@@ -69,13 +154,26 @@ def run_loadgen(
     seed: int = 0,
     deadline_ms: float | None = None,
     logger=None,
+    process: str | None = None,
+    replicas: int | None = None,
 ) -> dict:
-    """Drive a warmed (or about-to-be-warmed) engine with Poisson traffic.
+    """Drive a warmed (or about-to-be-warmed) engine with open-loop traffic.
 
     Order matters: the offline parity reference compiles BEFORE
     ``engine.warmup()`` re-arms the compile counters, so the request-path
-    compile gate measures serving alone.
+    compile gate measures serving alone. ``process`` selects the arrival
+    process (default ``cfg.serve.arrival``); ``replicas`` sizes the
+    :class:`~qdml_tpu.serve.server.ReplicaPool` (default
+    ``cfg.serve.replicas``) — every replica shares the one warmup and one
+    batcher feed, and the summary merges every replica's metrics exactly.
     """
+    process = process or cfg.serve.arrival
+    if process not in ARRIVAL_PROCESSES:
+        # fail on the config typo BEFORE the restore/parity-compile/warmup
+        # minutes are spent (arrival_times would only catch it after)
+        raise ValueError(
+            f"unknown arrival process {process!r} (have {ARRIVAL_PROCESSES})"
+        )
     samples = make_request_samples(cfg, n)
     x, h_perf = samples["x"], samples["h_perf"]
 
@@ -84,36 +182,41 @@ def run_loadgen(
     with span("serve_warmup", buckets=list(engine.buckets)):
         warm = engine.warmup()
 
-    metrics = ServeMetrics(
-        sink=None if logger is None else logger.telemetry, log_requests=n <= 2048
-    )
-    loop = ServeLoop(engine, metrics=metrics).start()
+    sink = None if logger is None else logger.telemetry
+    pool = ReplicaPool(
+        engine, replicas=replicas, sink=sink, log_requests=n <= 2048
+    ).start()
     rng = np.random.default_rng(seed)
-    gaps = rng.exponential(1.0 / rate, n)
-    arrivals = np.cumsum(gaps)
+    arrivals = arrival_times(
+        n, rate, rng, process=process, burstiness=cfg.serve.burstiness
+    )
 
     futures = []
     t0 = time.perf_counter()
-    with span("loadgen_traffic", rate_rps=rate, n=n):
+    with span("loadgen_traffic", rate_rps=rate, n=n, process=process):
         for i in range(n):
             lag = t0 + arrivals[i] - time.perf_counter()
             if lag > 0:
-                time.sleep(lag)  # open loop: schedule by the Poisson clock only
-            futures.append(loop.submit(x[i], rid=i, deadline_ms=deadline_ms))
+                time.sleep(lag)  # open loop: schedule by the arrival clock only
+            futures.append(pool.submit(x[i], rid=i, deadline_ms=deadline_ms))
         # offered window ends when the LAST request was offered — the result
         # drain must not dilute the offered rate, or an overloaded server
         # would look like a slow generator and mask its own overload
         offered_elapsed = time.perf_counter() - t0
         results = [f.result(timeout=60.0) for f in futures]
-    loop.stop()
+    pool.stop()
     cache_after = engine.request_path_compiles()
     # End-of-run poll of the live `{"op": "metrics"}` view, folded SLIM: the
     # summary below is already built from the same (merged) collectors, so
-    # only the fields the verb adds ride along — worker/queue/bucket state
+    # only the fields the verb adds ride along — replica/queue/bucket state
     # plus `completed` as a cross-check that the verb saw the same window.
-    live = loop.live_metrics()
+    live = pool.live_metrics()
     live_slim = {
-        k: live[k] for k in ("workers", "queue_depth_now", "buckets", "completed")
+        k: live[k]
+        for k in (
+            "workers", "replicas", "replica_completed",
+            "queue_depth_now", "buckets", "completed", "swap_epoch",
+        )
     }
 
     done = {r.rid: r for r in results if isinstance(r, Prediction)}
@@ -135,9 +238,10 @@ def run_loadgen(
 
     import jax
 
-    # aggregate across ALL serve-loop workers (== metrics when workers=1);
-    # worker 0's collector alone would undercount a multi-worker loop
-    metrics_all = loop.merged_metrics(sink=metrics._sink)
+    # aggregate across every replica's every worker (== the single loop's
+    # metrics when replicas=workers=1); any one collector alone would
+    # undercount the pool
+    metrics_all = pool.merged_metrics(sink=sink)
     summary = metrics_all.summary(
         compile_cache=cache_after,
         # labels the record for report's platform-mismatch disarm: a CPU
@@ -147,14 +251,26 @@ def run_loadgen(
         target_rps=rate,
         n_requests=n,
         n_shed=len(shed),
+        arrival={"process": process, "burstiness": cfg.serve.burstiness},
+        deadline_ms=deadline_ms,
         parity_max_abs_err=parity_max,
         pred_agreement=pred_agree,
         nmse_db_served=nmse_served,
         nmse_db_offline=nmse_offline,
+        # fleet facts for the report gate: aggregate rps is the `rps` field
+        # above; topology makes "scaled out" vs "sped up" attributable
+        replicas=pool.n_replicas,
+        workers=pool.workers,
+        mesh=engine.mesh_topology(),
+        bucket_sharding=engine.bucket_sharding or None,
         warmup=warm,
         server_metrics=live_slim,
     )
-    metrics_all.flush(compile_cache=cache_after, workers=loop.workers)
+    if summary.get("rps") is not None and pool.n_replicas:
+        summary["rps_per_replica"] = round(summary["rps"] / pool.n_replicas, 2)
+    metrics_all.flush(
+        compile_cache=cache_after, workers=pool.workers, replicas=pool.n_replicas
+    )
     if logger is not None:
         logger.telemetry.write_raw(summary)
     return summary

@@ -37,6 +37,12 @@ class ServeMetrics:
         self.batches = 0
         self.completed = 0
         self.shed: dict[str, int] = {}
+        # SLO attainment: of the requests that CARRIED a deadline, how many
+        # resolved within it. Completions feed via Prediction.deadline_met;
+        # a shed request that had a deadline is a miss by definition (the
+        # client never got an answer in time).
+        self.slo_total = 0
+        self.slo_met = 0
         self._t0 = time.perf_counter()
 
     def _target(self):
@@ -62,6 +68,9 @@ class ServeMetrics:
             )
         for p in preds:
             self.latency.add(p.latency_s)
+            if p.deadline_met is not None:
+                self.slo_total += 1
+                self.slo_met += int(p.deadline_met)
             if active and self.log_requests:
                 target.emit(
                     "span",
@@ -73,8 +82,10 @@ class ServeMetrics:
                     bucket=bucket,
                 )
 
-    def observe_shed(self, o: Overloaded) -> None:
+    def observe_shed(self, o: Overloaded, had_deadline: bool = False) -> None:
         self.shed[o.reason] = self.shed.get(o.reason, 0) + 1
+        if had_deadline:
+            self.slo_total += 1  # shed with a deadline = an SLO miss
 
     def merge(self, other: "ServeMetrics") -> "ServeMetrics":
         """Fold another collector into this one (``Histogram.merge`` keeps
@@ -90,8 +101,23 @@ class ServeMetrics:
         self.completed += other.completed
         for k, v in other.shed.items():
             self.shed[k] = self.shed.get(k, 0) + v
+        self.slo_total += other.slo_total
+        self.slo_met += other.slo_met
         self._t0 = min(self._t0, other._t0)
         return self
+
+    def slo(self) -> dict | None:
+        """``{"n", "met", "attainment"}`` over deadline-carrying requests, or
+        ``None`` when no request in the window had a deadline (an attainment
+        over zero requests would read as a perfect-or-failed SLO that was
+        never actually offered)."""
+        if self.slo_total == 0:
+            return None
+        return {
+            "n": self.slo_total,
+            "met": self.slo_met,
+            "attainment": round(self.slo_met / self.slo_total, 4),
+        }
 
     def _scaled(self, hist: Histogram) -> dict | None:
         """Histogram.summary() without the ms scaling (fill/depth are not
@@ -121,6 +147,7 @@ class ServeMetrics:
                 batches=self.batches,
                 completed=self.completed,
                 shed=dict(self.shed),
+                slo=self.slo(),
                 compile_cache=compile_cache,
                 **tags,
             )
@@ -144,6 +171,7 @@ class ServeMetrics:
             "batches": self.batches,
             "shed": dict(self.shed),
             "rps": round(self.completed / elapsed, 2) if elapsed > 0 else None,
+            "slo": self.slo(),
             "latency_ms": self.latency.summary(),
             "batch_fill": self._scaled(self.batch_fill),
             "queue_depth": self._scaled(self.queue_depth),

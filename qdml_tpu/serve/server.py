@@ -1,19 +1,32 @@
-"""Serve loop + local socket front-end.
+"""Serve loop, replica pool + local socket front-end.
 
-:class:`ServeLoop` is the in-process serving core: a worker thread that
-drains the micro-batcher — shed results resolve immediately, ready batches go
+:class:`ServeLoop` is the in-process serving core: worker thread(s) that
+drain the micro-batcher — shed results resolve immediately, ready batches go
 through the engine's pre-compiled executables, and every request's future
 resolves with a typed :class:`~qdml_tpu.serve.types.Prediction` or
-:class:`~qdml_tpu.serve.types.Overloaded`. The loadgen harness and the smoke
-tests drive this object directly; the socket server below is a thin framing
-layer over it.
+:class:`~qdml_tpu.serve.types.Overloaded`. :class:`ReplicaPool` runs N of
+them over ONE shared micro-batcher against ONE warmed engine (one warmup,
+one autotune table, one set of AOT executables), with per-replica
+:class:`~qdml_tpu.serve.metrics.ServeMetrics` merged exactly via
+``Histogram.merge`` — the fleet story of docs/SERVING.md. The loadgen
+harness and the smoke tests drive these objects directly; the socket server
+below is a thin framing layer over either.
+
+Exit discipline: every worker of every replica registers with one
+:class:`ExitCoordinator`. A crashed (or stopped) worker must never shed the
+shared queue while ANY peer — same replica or not — can still serve it; the
+LAST worker out pool-wide always drains, so nothing strands either way (the
+PR-3 hazard, generalized from one loop's threads to the whole pool).
 
 ``qdml-tpu serve`` runs :func:`run_server`: an asyncio loop accepting
 newline-delimited JSON over a local TCP socket (``{"id", "x", [deadline_ms]}``
 -> ``{"id", "ok", "pred", "h", "latency_ms"}`` or
-``{"id", "ok": false, "reason"}``). One engine, one batcher: concurrent
-connections coalesce into the same buckets, which is the entire point of
-dynamic micro-batching.
+``{"id", "ok": false, "reason"}``), plus the ``{"op": "metrics"}`` live
+observability verb and the ``{"op": "swap"}`` zero-downtime checkpoint
+hot-swap verb (re-restores the newest checkpoints and swaps them under live
+traffic with zero recompiles — docs/SERVING.md). One engine, one batcher:
+concurrent connections coalesce into the same buckets, which is the entire
+point of dynamic micro-batching.
 """
 
 from __future__ import annotations
@@ -23,6 +36,7 @@ import json
 import threading
 import time
 from concurrent.futures import Future
+from typing import Callable
 
 import numpy as np
 
@@ -31,6 +45,34 @@ from qdml_tpu.serve.batcher import MicroBatcher
 from qdml_tpu.serve.engine import ServeEngine
 from qdml_tpu.serve.metrics import ServeMetrics
 from qdml_tpu.serve.types import SHUTDOWN, Overloaded, Prediction, Request
+
+
+class ExitCoordinator:
+    """Worker-liveness accounting shared by every loop draining one batcher.
+
+    One instance per ServeLoop by default; a :class:`ReplicaPool` injects a
+    single shared instance into all its replicas, so "am I the last worker
+    out" (the drain trigger) and "is anyone still serving" (the submit
+    liveness check) are pool-wide facts, not per-loop guesses.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live = 0
+
+    def enter(self, n: int) -> None:
+        with self._lock:
+            self._live += n
+
+    def leave(self) -> bool:
+        """Deregister one worker; True iff it was the last one pool-wide."""
+        with self._lock:
+            self._live -= 1
+            return self._live <= 0
+
+    def live(self) -> int:
+        with self._lock:
+            return self._live
 
 
 class ServeLoop:
@@ -42,6 +84,9 @@ class ServeLoop:
     :meth:`merged_metrics`/:meth:`live_metrics` aggregate them exactly via
     ``Histogram.merge``. ``self.metrics`` is worker 0's collector — the
     single-worker default keeps the PR-2 behavior and tests unchanged.
+    ``exit_coord`` shares worker-exit accounting across loops (the replica
+    pool passes one coordinator to all replicas); ``name`` labels the
+    threads.
     """
 
     def __init__(
@@ -50,9 +95,12 @@ class ServeLoop:
         batcher: MicroBatcher | None = None,
         metrics: ServeMetrics | None = None,
         workers: int | None = None,
+        exit_coord: ExitCoordinator | None = None,
+        name: str = "serve-loop",
     ):
         serve_cfg = engine.cfg.serve
         self.engine = engine
+        self.name = name
         self.batcher = batcher or MicroBatcher(
             max_batch=serve_cfg.max_batch,
             max_wait_s=serve_cfg.max_wait_ms / 1e3,
@@ -70,10 +118,11 @@ class ServeLoop:
             serve_cfg.deadline_ms / 1e3 if serve_cfg.deadline_ms > 0 else None
         )
         self._stop = threading.Event()
-        self._wake = threading.Event()
+        # wake rides on the BATCHER (its owner): pool replicas share the
+        # queue, so a submit must reach whichever loop's worker is idle
+        self._wake = self.batcher.wake
         self._threads: list[threading.Thread] = []
-        self._exit_lock = threading.Lock()
-        self._live_workers = 0
+        self._exit = exit_coord or ExitCoordinator()
         self._started = False  # stays True after stop(): a finished loop rejects
         self._rid = 0
 
@@ -97,11 +146,13 @@ class ServeLoop:
         if rid is None:
             self._rid += 1
             rid = self._rid
-        if self._started and not any(t.is_alive() for t in self._threads):
-            # a stopped or CRASHED worker must not accept work: the queue
-            # would grow with futures nobody will ever resolve (clients hung
+        if self._started and self._exit.live() <= 0:
+            # no worker anywhere in the pool can serve this: the queue would
+            # grow with futures nobody will ever resolve (clients hung
             # forever behind a server that still accepts connections).
-            # Submits before start() are fine — start() will drain them.
+            # Submits before start() are fine — start() will drain them; a
+            # crashed worker with live peers is fine too — the coordinator
+            # counts pool-wide, and the peers drain the shared queue.
             fut: Future = Future()
             fut.set_result(Overloaded(rid, SHUTDOWN))
             return fut
@@ -117,10 +168,8 @@ class ServeLoop:
         )
         rejected = self.batcher.submit(req, now=now)
         if rejected is not None:
-            self.metrics.observe_shed(rejected)
+            self.metrics.observe_shed(rejected, had_deadline=req.deadline is not None)
             req.future.set_result(rejected)
-        else:
-            self._wake.set()
         return req.future
 
     # -- worker side --------------------------------------------------------
@@ -134,24 +183,31 @@ class ServeLoop:
                 target=self._run,
                 args=(self._worker_metrics[i],),
                 daemon=True,
-                name=f"serve-loop-{i}",
+                name=f"{self.name}-{i}",
             )
             for i in range(self.workers)
         ]
         self._started = True
-        with self._exit_lock:  # workers read this under the same lock on exit
-            self._live_workers = len(self._threads)
+        # register BEFORE the threads run: a submit racing start() must see
+        # the pool as live (the coordinator is the liveness source of truth)
+        self._exit.enter(len(self._threads))
         for t in self._threads:
             t.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
         """Stop the workers; with ``drain`` (default) only after the queue
-        has emptied, so every submitted future resolves."""
+        has emptied, so every submitted future resolves. When pool PEERS
+        share the batcher, draining is their job — a scaled-down replica
+        must not block on a feed that live peers keep refilling (and they,
+        or the pool-wide last-worker-out drain, resolve every future)."""
         if not self._threads:
             return
         if drain:
-            while self.batcher.depth > 0 and any(t.is_alive() for t in self._threads):
+            while (
+                self.batcher.depth > 0
+                and 0 < self._exit.live() <= sum(t.is_alive() for t in self._threads)
+            ):
                 self._wake.set()
                 time.sleep(0.001)
         self._stop.set()
@@ -172,14 +228,16 @@ class ServeLoop:
 
     def live_metrics(self) -> dict:
         """The ``{"op": "metrics"}`` serve-verb payload: merged per-worker
-        counters/histograms, current queue depth, bucket layout, and the
-        request-path compile-cache snapshot — a running server is observable
-        without restarting it. Safe to call any time (also after stop)."""
+        counters/histograms, current queue depth, bucket layout, swap epoch,
+        and the request-path compile-cache snapshot — a running server is
+        observable without restarting it. Safe to call any time (also after
+        stop)."""
         return self.merged_metrics().snapshot(
             compile_cache=self.engine.request_path_compiles(),
             workers=self.workers,
             queue_depth_now=self.batcher.depth,
             buckets=list(self.engine.buckets),
+            swap_epoch=self.engine.swap_epoch,
         )
 
     def _serve_one(self, metrics: ServeMetrics | None = None) -> bool:
@@ -191,7 +249,8 @@ class ServeLoop:
         depth = self.batcher.depth
         batch, shed = self.batcher.next_batch()
         for r, o in shed:
-            metrics.observe_shed(o)
+            # dequeue sheds are deadline expiries by construction
+            metrics.observe_shed(o, had_deadline=True)
             if r.future is not None:
                 r.future.set_result(o)
         if not batch:
@@ -220,6 +279,7 @@ class ServeLoop:
                 latency_s=now - r.enqueue_ts,
                 bucket=bucket,
                 batch_n=len(batch),
+                deadline_met=None if r.deadline is None else now <= r.deadline,
             )
             preds.append(p)
         # metrics before resolution: a client awaiting the future must be able
@@ -239,24 +299,130 @@ class ServeLoop:
                     self._wake.clear()
         finally:
             # shutdown OR crash: resolve EVERYTHING still queued (no silent
-            # hangs) — but only once no OTHER worker can still serve it. A
-            # single crashed worker must not shed a queue its surviving
-            # peers are actively draining; the LAST worker out (crash or
-            # stop) always drains, so nothing strands either way.
-            with self._exit_lock:
-                self._live_workers -= 1
-                last_out = self._live_workers <= 0
-            while self._stop.is_set() or last_out:
+            # hangs) — but only once no OTHER worker, in THIS loop or any
+            # pool peer sharing the batcher, can still serve it. A single
+            # crashed worker (or a stopped replica) must not shed a queue
+            # its surviving peers are actively draining; the LAST worker out
+            # pool-wide always drains, so nothing strands either way.
+            last_out = self._exit.leave()
+            while last_out:
                 batch, shed = self.batcher.next_batch(now=float("inf"))
                 if not batch and not shed:
                     break
                 for r, o in shed:
-                    metrics.observe_shed(o)
+                    metrics.observe_shed(o, had_deadline=True)
                     if r.future is not None:
                         r.future.set_result(o)
                 for r in batch:
                     if r.future is not None:
-                        r.future.set_result(Overloaded(r.rid, SHUTDOWN))
+                        r.future.set_result(
+                            Overloaded(r.rid, SHUTDOWN)
+                        )
+
+
+class ReplicaPool:
+    """N ServeLoops over one shared batcher, one engine, one warmup.
+
+    The fleet unit of docs/SERVING.md: every replica pumps the SAME
+    :class:`MicroBatcher` feed through the SAME warmed engine (one set of
+    AOT executables, one autotune table — warmup runs exactly once however
+    many replicas serve), with per-replica/per-worker :class:`ServeMetrics`
+    merged exactly via ``Histogram.merge`` on demand. One
+    :class:`ExitCoordinator` spans the pool, so submit-liveness and
+    last-worker-out draining are pool-wide facts. A checkpoint hot-swap on
+    the shared engine (``engine.swap_params``) lands on every replica at
+    once — each batch reads the live param tuple at dequeue.
+    """
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        replicas: int | None = None,
+        batcher: MicroBatcher | None = None,
+        workers: int | None = None,
+        sink=None,
+        log_requests: bool = True,
+    ):
+        serve_cfg = engine.cfg.serve
+        self.engine = engine
+        self.n_replicas = max(
+            1, int(replicas if replicas is not None else serve_cfg.replicas)
+        )
+        self.batcher = batcher or MicroBatcher(
+            max_batch=serve_cfg.max_batch,
+            max_wait_s=serve_cfg.max_wait_ms / 1e3,
+            max_queue=serve_cfg.max_queue,
+        )
+        self._exit = ExitCoordinator()
+        self.replicas = [
+            ServeLoop(
+                engine,
+                batcher=self.batcher,
+                metrics=ServeMetrics(sink=sink, log_requests=log_requests),
+                workers=workers,
+                exit_coord=self._exit,
+                name=f"serve-replica-{i}",
+            )
+            for i in range(self.n_replicas)
+        ]
+
+    @property
+    def workers(self) -> int:
+        """Total worker threads across the pool."""
+        return sum(r.workers for r in self.replicas)
+
+    def start(self) -> "ReplicaPool":
+        if not self.engine._compiled:
+            self.engine.warmup()  # ONE warmup, shared by every replica
+        for r in self.replicas:
+            r.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if drain:
+            while self.batcher.depth > 0 and self._exit.live() > 0:
+                self.batcher.wake.set()
+                time.sleep(0.001)
+        for r in self.replicas:
+            r.stop(drain=False)
+
+    def submit(
+        self,
+        x: np.ndarray,
+        rid: int | str | None = None,
+        deadline_ms: float | None = None,
+    ) -> Future:
+        """Validated enqueue into the SHARED feed (replica 0 fronts it; the
+        liveness check is pool-wide through the coordinator, so work is
+        accepted as long as ANY replica can serve it)."""
+        return self.replicas[0].submit(x, rid=rid, deadline_ms=deadline_ms)
+
+    def merged_metrics(self, sink=None) -> ServeMetrics:
+        """Every replica's every worker folded into one collector — exact
+        quantiles across the whole pool (``Histogram.merge``)."""
+        agg = ServeMetrics(sink=sink, log_requests=False)
+        for r in self.replicas:
+            for m in r._worker_metrics:
+                agg.merge(m)
+        return agg
+
+    def live_metrics(self) -> dict:
+        """Pool-wide ``{"op": "metrics"}`` payload: the merged counters plus
+        replica topology and per-replica completion split (the fleet-balance
+        view), the shared queue depth, and the swap epoch."""
+        return self.merged_metrics().snapshot(
+            compile_cache=self.engine.request_path_compiles(),
+            workers=self.workers,
+            replicas=self.n_replicas,
+            # plain counter sums — a per-replica merged_metrics() here would
+            # copy every raw histogram sample once per replica per poll
+            replica_completed=[
+                sum(m.completed for m in r._worker_metrics) for r in self.replicas
+            ],
+            queue_depth_now=self.batcher.depth,
+            buckets=list(self.engine.buckets),
+            swap_epoch=self.engine.swap_epoch,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -277,7 +443,7 @@ def _encode(res) -> dict:
     return {"id": res.rid, "ok": False, "reason": res.reason}
 
 
-async def _handle(reader, writer, loop_: ServeLoop) -> None:
+async def _handle(reader, writer, loop_, swap_fn: Callable[[], dict] | None) -> None:
     while True:
         line = await reader.readline()
         if not line:
@@ -298,6 +464,28 @@ async def _handle(reader, writer, loop_: ServeLoop) -> None:
                 None, loop_.live_metrics
             )
             reply = {"id": msg.get("id"), "ok": True, "metrics": metrics_view}
+            writer.write((json.dumps(reply) + "\n").encode())
+            await writer.drain()
+            continue
+        if isinstance(msg, dict) and msg.get("op") == "swap":
+            # zero-downtime deploy verb: re-restore the newest checkpoints
+            # and hot-swap them under live traffic (engine.swap_params —
+            # zero recompiles, in-flight batches keep the old params). Off
+            # the event loop: the orbax restore + device_put is host work
+            # that must not stall connected clients' reply paths.
+            if swap_fn is None:
+                reply = {"id": msg.get("id"), "ok": False,
+                         "reason": "swap_unavailable: server has no checkpoint workdir"}
+            else:
+                try:
+                    rec = await asyncio.get_running_loop().run_in_executor(None, swap_fn)
+                    reply = {"id": msg.get("id"), "ok": True, "swap": rec}
+                except (FileNotFoundError, ValueError, RuntimeError) as e:
+                    # a missing/mismatched checkpoint is a client-visible
+                    # deploy failure, not a reason to kill the server — the
+                    # old params keep serving (swap_params validated first)
+                    reply = {"id": msg.get("id"), "ok": False,
+                             "reason": f"swap_failed: {e}"}
             writer.write((json.dumps(reply) + "\n").encode())
             await writer.drain()
             continue
@@ -324,15 +512,19 @@ async def _handle(reader, writer, loop_: ServeLoop) -> None:
 
 
 async def serve_async(
-    loop_: ServeLoop,
+    loop_,
     host: str,
     port: int,
     ready: "asyncio.Future | None" = None,
+    swap_fn: Callable[[], dict] | None = None,
 ) -> None:
     """Accept connections until cancelled; resolves ``ready`` with the bound
-    port (port=0 binds an ephemeral port — how the tests avoid collisions)."""
+    port (port=0 binds an ephemeral port — how the tests avoid collisions).
+    ``loop_`` is a :class:`ServeLoop` or :class:`ReplicaPool` (both expose
+    ``submit``/``live_metrics``); ``swap_fn`` arms the ``{"op": "swap"}``
+    verb."""
     server = await asyncio.start_server(
-        lambda r, w: _handle(r, w, loop_), host=host, port=port
+        lambda r, w: _handle(r, w, loop_, swap_fn), host=host, port=port
     )
     bound = server.sockets[0].getsockname()[1]
     if ready is not None and not ready.done():
@@ -341,17 +533,25 @@ async def serve_async(
         await server.serve_forever()
 
 
-def run_server(cfg: ExperimentConfig, engine: ServeEngine, logger=None) -> None:
+def run_server(
+    cfg: ExperimentConfig,
+    engine: ServeEngine,
+    logger=None,
+    workdir: str | None = None,
+) -> None:
     """Blocking entry for ``qdml-tpu serve``: warm, announce, serve until
-    interrupted; flush serving counters on the way out."""
-    metrics = ServeMetrics()
-    loop_ = ServeLoop(engine, metrics=metrics, workers=cfg.serve.workers).start()
+    interrupted; flush serving counters on the way out. ``workdir`` arms the
+    ``{"op": "swap"}`` hot-swap verb (re-restore newest checkpoints live)."""
+    pool = ReplicaPool(engine, workers=cfg.serve.workers).start()
     print(
         json.dumps(
             {
                 "serving": f"{cfg.serve.host}:{cfg.serve.port}",
                 "buckets": list(engine.buckets),
-                "workers": loop_.workers,
+                "replicas": pool.n_replicas,
+                "workers": pool.workers,
+                "mesh": engine.mesh_topology(),
+                "sharding": engine.bucket_sharding or None,
                 # post-warmup counters: anything non-zero here (or later)
                 # is a compile the warmup failed to cover
                 "compile_cache_after_warmup": engine.request_path_compiles(),
@@ -361,13 +561,17 @@ def run_server(cfg: ExperimentConfig, engine: ServeEngine, logger=None) -> None:
         ),
         flush=True,
     )
+    swap_fn = None if workdir is None else (lambda: engine.swap_from_workdir(workdir))
     try:
-        asyncio.run(serve_async(loop_, cfg.serve.host, cfg.serve.port))
+        asyncio.run(serve_async(pool, cfg.serve.host, cfg.serve.port, swap_fn=swap_fn))
     except KeyboardInterrupt:
         pass
     finally:
-        loop_.stop(drain=False)
-        # merged across workers: the same aggregate the metrics verb serves
-        loop_.merged_metrics().flush(
-            compile_cache=engine.request_path_compiles(), workers=loop_.workers
+        pool.stop(drain=False)
+        # merged across every replica's workers: the same aggregate the
+        # metrics verb serves
+        pool.merged_metrics().flush(
+            compile_cache=engine.request_path_compiles(),
+            workers=pool.workers,
+            replicas=pool.n_replicas,
         )

@@ -44,6 +44,10 @@ class Prediction:
     latency_s: float                  # enqueue -> result, monotonic
     bucket: int                       # padded batch bucket that served it
     batch_n: int                      # real (unpadded) requests in that batch
+    # SLO accounting: True/False when the request carried a deadline
+    # (completed before/after it), None when it had none. Feeds the
+    # serve_summary slo-attainment figure (ServeMetrics).
+    deadline_met: bool | None = None
 
     @property
     def ok(self) -> bool:

@@ -89,19 +89,42 @@ def _record_from(obj: dict) -> dict | None:
 
 
 def _serving_from(obj: dict) -> dict | None:
-    """Latency/throughput numbers from a ``serve_summary`` telemetry record
-    (the loadgen harness writes one per run). Latency percentiles live in a
-    separate namespace from throughput because their regression sign is
-    inverted: serving got WORSE when latency went UP."""
+    """Latency/throughput/SLO/fleet numbers from a ``serve_summary``
+    telemetry record (the loadgen harness writes one per run). Latency
+    percentiles live in a separate namespace from throughput because their
+    regression sign is inverted: serving got WORSE when latency went UP.
+    SLO attainment inverts the other way (a DROP is the regression), and the
+    fleet block (replicas × devices) makes rps deltas attributable to
+    scale-out vs speed-up."""
     if obj.get("kind") != "serve_summary":
         return None
-    out: dict = {"latency": {}, "rps": None, "platform": obj.get("platform")}
+    out: dict = {
+        "latency": {},
+        "rps": None,
+        "platform": obj.get("platform"),
+        "slo_attainment": None,
+        "fleet": None,
+    }
     lat = obj.get("latency_ms") or {}
     for key in ("p50_ms", "p95_ms", "p99_ms"):
         if isinstance(lat.get(key), (int, float)):
             out["latency"][key] = float(lat[key])
     if isinstance(obj.get("rps"), (int, float)):
         out["rps"] = float(obj["rps"])
+    slo = obj.get("slo")
+    if isinstance(slo, dict) and isinstance(slo.get("attainment"), (int, float)):
+        out["slo_attainment"] = float(slo["attainment"])
+    fleet = {}
+    if isinstance(obj.get("replicas"), int):
+        fleet["replicas"] = obj["replicas"]
+    if isinstance(obj.get("workers"), int):
+        fleet["workers"] = obj["workers"]
+    mesh = obj.get("mesh")
+    if isinstance(mesh, dict) and isinstance(mesh.get("devices"), int):
+        fleet["devices"] = mesh["devices"]
+    if isinstance(obj.get("rps_per_replica"), (int, float)):
+        fleet["rps_per_replica"] = float(obj["rps_per_replica"])
+    out["fleet"] = fleet or None
     return out
 
 
@@ -498,6 +521,33 @@ def build_report_data(
             "",
             "## serving latency",
             "",
+        ]
+        # fleet topology line: a serve.rps delta between 1 replica on 1
+        # device and 4 replicas on 8 is scale-out, not speed-up — name the
+        # topologies so the aggregate-rps gate reads attributably
+        def _fleet_str(src):
+            f = (src.get("serving") or {}).get("fleet")
+            if not f:
+                return None
+            topo = [f"{f.get('replicas', '?')} replica(s)"]
+            if f.get("devices"):
+                topo.append(f"{f['devices']} device(s)")
+            s = " x ".join(topo)
+            if f.get("rps_per_replica") is not None:
+                s += f" ({f['rps_per_replica']:g} rps/replica)"
+            return s
+
+        base_fleet = _fleet_str(base)
+        cur_fleet = next(
+            (s for s in (_fleet_str(c) for c in reversed(curs)) if s), None
+        )
+        if base_fleet or cur_fleet:
+            lines.append(
+                f"- fleet: baseline {base_fleet or 'n/a'} -> current "
+                f"{cur_fleet or 'n/a'}"
+            )
+            lines.append("")
+        lines += [
             "| percentile | baseline | current | delta | status |",
             "|---|---|---|---|---|",
         ]
@@ -540,6 +590,60 @@ def build_report_data(
                  "current": c, "delta_pct": round(delta_pct, 2), "status": status_key}
             )
             lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status_md} |")
+
+    # Serving-SLO gate: attainment = fraction of deadline-carrying requests
+    # answered within their deadline (serve_summary.slo.attainment). The
+    # sign works like roofline-fraction: a DROP beyond the threshold is the
+    # regression; the same platform rules arm it (attainment under load is a
+    # hardware-throughput-shaped number).
+    b_slo = (base.get("serving") or {}).get("slo_attainment")
+    c_slo = None
+    for c_src in curs:
+        v = (c_src.get("serving") or {}).get("slo_attainment")
+        if v is not None:
+            c_slo = v
+    if b_slo is not None or c_slo is not None:
+        if not (base_lat or cur_lat):
+            # an all-shed run can carry an SLO figure with NO latency
+            # samples — give the bullet its own section instead of
+            # orphaning it under the throughput table
+            lines += ["", "## serving"]
+        if b_slo is None or c_slo is None:
+            only = "current-only" if b_slo is None else "baseline-only"
+            gates.append(
+                {"metric": "serve.slo_attainment", "kind": "slo", "baseline": b_slo,
+                 "current": c_slo, "delta_pct": None, "status": only}
+            )
+            lines.append(
+                f"- serving SLO attainment: "
+                f"{'—' if b_slo is None else f'{b_slo:g}'} -> "
+                f"{'—' if c_slo is None else f'{c_slo:g}'} ({only})"
+            )
+        else:
+            delta_pct = _pct(c_slo, b_slo)
+            if delta_pct is None:
+                status_key = status_md = "zero-baseline"
+            elif delta_pct < -threshold_pct:
+                status_key, status_md = "regression", "**REGRESSION**"
+                regressions.append(
+                    {"metric": "serve.slo_attainment", "baseline": b_slo,
+                     "current": c_slo, "delta_pct": round(delta_pct, 2)}
+                )
+            elif delta_pct > threshold_pct:
+                status_key = status_md = "improved"
+            else:
+                status_key = status_md = "ok"
+            gates.append(
+                {"metric": "serve.slo_attainment", "kind": "slo",
+                 "baseline": b_slo, "current": c_slo,
+                 "delta_pct": None if delta_pct is None else round(delta_pct, 2),
+                 "status": status_key}
+            )
+            lines.append(
+                f"- serving SLO attainment: {b_slo:g} -> {c_slo:g} "
+                + (f"({delta_pct:+.1f}%) " if delta_pct is not None else "")
+                + f"{status_md}"
+            )
 
     # Roofline section: achieved-vs-roofline fraction per train sub-bench
     # (bench.py details.*.roofline.fraction — telemetry/cost.py). The sign is

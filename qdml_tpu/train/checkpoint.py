@@ -147,6 +147,37 @@ def restore_params(workdir: str, tag: str) -> tuple[dict, dict]:
     return out, meta
 
 
+class CheckpointNotFoundError(FileNotFoundError):
+    """A model family was never trained in this workdir (no best/last/resume
+    tag). Distinct from a *failed restore* of an existing tag — a partially
+    written or corrupt checkpoint raises orbax's own error, which callers
+    with a fallback (``ServeEngine.from_workdir``'s qsc -> sc downgrade) must
+    NOT swallow: silently serving the wrong model family is worse than
+    failing loudly."""
+
+
+def restore_latest_params(workdir: str, prefix: str) -> tuple[dict, dict, str]:
+    """Eval-only restore of a family's newest checkpoint: ``(vars, meta,
+    tag)`` via :func:`latest_tag` + :func:`restore_params`.
+
+    One home for the restore-the-newest dance the serving engine runs at
+    construction AND at every live hot-swap (``ServeEngine.swap_from_workdir``
+    re-resolves the tag each call, so a training run promoting a new
+    ``*_best`` is picked up without restarting the server). Raises
+    :class:`CheckpointNotFoundError` with the train-command hint when the
+    family was never trained here; restore failures on an existing tag
+    propagate as-is.
+    """
+    tag = latest_tag(workdir, prefix)
+    if tag is None:
+        raise CheckpointNotFoundError(
+            f"no {prefix} checkpoint (best/last/resume) under {workdir!r} — "
+            f"run `qdml-tpu train-{prefix}` first"
+        )
+    vars_, meta = restore_params(workdir, tag)
+    return vars_, meta, tag
+
+
 def _broadcast_meta(meta: dict) -> dict:
     """Under multi-process, make process 0's sidecar meta authoritative.
 

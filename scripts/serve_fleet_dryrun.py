@@ -1,0 +1,148 @@
+"""Sharded multi-replica serving dryrun on virtual devices (ISSUE 7).
+
+The serve twin of the MULTICHIP mesh dryruns: force a multi-device CPU
+backend (``XLA_FLAGS=--xla_force_host_platform_device_count=N`` via
+``utils.platform.force_cpu``), drive the mesh-sharded engine with loadgen at
+replicas=1 and replicas=2 over the SAME warmed engine family, and feed the
+two ``serve_summary`` artifacts through the ``qdml-tpu report`` fleet gate.
+Writes ``results/serve_dryrun/``:
+
+- ``loadgen_r{replicas}_t{trial}.jsonl`` — manifest-headed telemetry with
+  the fleet-tagged serve_summary records, one file per interleaved trial;
+- ``SERVE_DRYRUN.json`` — the headline comparison (rps, p99, SLO attainment,
+  zero-compile gate, topology) plus the report-gate exit code;
+- ``report_fleet.md`` — the rendered gate (replicas=2 current vs replicas=1
+  baseline; the fleet line names both topologies).
+
+Run: ``python scripts/serve_fleet_dryrun.py [--devices=4] [--n=512] [--rate=4000]``
+Virtual-device throughput on one CPU host measures dispatch/coalescing
+overhead, not ICI scaling — the workload is sized so per-batch device
+compute is large enough that replica overlap (one replica in XLA while the
+peer does host-side result handling) is visible at all, but the artifact is
+primarily the wiring proof (fleet fields flow loadgen -> serve_summary ->
+report gate), not a hardware headline. On a real pod the data-sharded
+buckets put the batch on ICI-connected chips and the same report gates the
+real scaling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+
+def main(argv: list[str]) -> int:
+    devices = int(next((a.split("=", 1)[1] for a in argv if a.startswith("--devices=")), 4))
+    n = int(next((a.split("=", 1)[1] for a in argv if a.startswith("--n=")), 512))
+    rate = float(next((a.split("=", 1)[1] for a in argv if a.startswith("--rate=")), 4000.0))
+    force_cpu(devices)
+
+    from qdml_tpu.config import DataConfig, ExperimentConfig, MeshConfig, ModelConfig, ServeConfig, TrainConfig
+    from qdml_tpu.parallel.mesh import serve_mesh
+    from qdml_tpu.serve import ServeEngine, run_loadgen
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.telemetry.report import report_main
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    out_dir = os.path.join("results", "serve_dryrun")
+    os.makedirs(out_dir, exist_ok=True)
+
+    # Heavy enough per-batch device compute (the full-width trunk stack on a
+    # 16x8 pilot image) that a batch's XLA execution dominates its Python
+    # result handling — the regime where replica overlap can show at all on
+    # one host; tiny toy models are pure GIL contention.
+    cfg = ExperimentConfig(
+        name="serve_fleet_dryrun",
+        data=DataConfig(n_ant=32, n_sub=16, n_beam=8, data_len=64),
+        model=ModelConfig(features=32),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        mesh=MeshConfig(data_axis=devices, model_axis=1, fed_axis=1),
+        serve=ServeConfig(max_batch=32, buckets=(8, 16, 32), max_wait_ms=2.0, max_queue=512),
+    )
+    mesh = serve_mesh(cfg)
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    clf_vars = {"params": sc_state.params}
+
+    trials = int(next((a.split("=", 1)[1] for a in argv if a.startswith("--trials=")), 3))
+    headline: dict = {
+        "devices": devices,
+        "mesh": None,
+        "n": n,
+        "target_rate": rate,
+        "trials": trials,
+        "note": (
+            "interleaved best-of-N trials: one contended CPU host swings "
+            "per-run rps by ~10%, so each setting's best run approximates "
+            "its uncontended capability (all trials recorded)"
+        ),
+        "runs": {},
+    }
+    paths = {}
+    best: dict = {}
+    trial_rps: dict = {1: [], 2: []}
+    # interleave the replica settings across trials: host contention drifts
+    # over minutes, and blocked A-A-A-B-B-B ordering would hand whichever
+    # setting ran in the quiet window a fake win
+    for trial in range(trials):
+        for replicas in (1, 2):
+            # fresh engine per run: each run's warmup/compile gate and
+            # metrics window stand alone (the executables hit the
+            # persistent compile cache, so repeat warmups are cheap)
+            engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
+            path = os.path.join(out_dir, f"loadgen_r{replicas}_t{trial}.jsonl")
+            logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+            try:
+                summary = run_loadgen(
+                    cfg, engine, rate=rate, n=n, deadline_ms=2000.0,
+                    logger=logger, replicas=replicas,
+                )
+            finally:
+                logger.close()
+            trial_rps[replicas].append(summary["rps"])
+            if replicas not in best or (summary["rps"] or 0) > (best[replicas][0]["rps"] or 0):
+                best[replicas] = (summary, path)
+    for replicas in (1, 2):
+        summary, path = best[replicas]
+        headline["mesh"] = summary["mesh"]
+        headline["runs"][f"replicas={replicas}"] = {
+            "rps": summary["rps"],
+            "rps_all_trials": trial_rps[replicas],
+            "rps_per_replica": summary.get("rps_per_replica"),
+            "offered_rps": summary["offered_rps"],
+            "p50_ms": (summary["latency_ms"] or {}).get("p50_ms"),
+            "p99_ms": (summary["latency_ms"] or {}).get("p99_ms"),
+            "slo": summary["slo"],
+            "completed": summary["completed"],
+            "n_shed": summary["n_shed"],
+            "compile_cache_after_warmup": summary["compile_cache_after_warmup"],
+            "bucket_sharding": summary["bucket_sharding"],
+        }
+        paths[replicas] = path
+        print(f"replicas={replicas}: best rps={summary['rps']} (trials {trial_rps[replicas]}) "
+              f"p99={(summary['latency_ms'] or {}).get('p99_ms')}ms "
+              f"slo={summary['slo']} compiles={summary['compile_cache_after_warmup']}")
+
+    # the fleet gate consumes the records: replicas=2 current vs replicas=1
+    # baseline (same platform -> armed; the fleet line names both topologies)
+    report_md = os.path.join(out_dir, "report_fleet.md")
+    rc = report_main(
+        [f"--current={paths[2]}", f"--baseline={paths[1]}", f"--out={report_md}"]
+    )
+    headline["report_gate"] = {"exit_code": rc, "markdown": report_md}
+    with open(os.path.join(out_dir, "SERVE_DRYRUN.json"), "w") as fh:
+        json.dump(headline, fh, indent=2)
+    print(json.dumps(headline, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -94,6 +94,77 @@ def test_lock_discipline_rule_uses_project_map():
     assert rule_serve_lock_discipline(_ctx(src, "other/file.py")) == []
 
 
+def test_lock_discipline_covers_pool_exit_coordinator():
+    """The replica-pool worker-exit counter: reads/writes of
+    ExitCoordinator._live outside `with self._lock:` are findings (the
+    crashed-worker-sheds-live-queue race), the locked twins are clean."""
+    from qdml_tpu.analysis.rules import rule_serve_lock_discipline
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+        class ExitCoordinator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._live = 0            # __init__ is exempt
+
+            def leave_locked(self):
+                with self._lock:
+                    self._live -= 1
+                    return self._live <= 0
+
+            def leave_racy(self):
+                self._live -= 1           # unlocked decrement
+                return self._live <= 0    # unlocked read
+        """
+    )
+    findings = rule_serve_lock_discipline(_ctx(src, "qdml_tpu/serve/server.py"))
+    assert all(f.rule == "serve-lock-discipline" for f in findings)
+    assert {f.context for f in findings} == {"ExitCoordinator.leave_racy"}
+    assert len(findings) >= 1
+
+
+def test_lock_discipline_covers_engine_swap_state():
+    """The hot-swap structures: the live (hdce, clf) param tuple and the
+    swap epoch flip atomically under _swap_lock — a bare read can see a
+    torn checkpoint mid-swap; the locked twins are clean."""
+    from qdml_tpu.analysis.rules import rule_serve_lock_discipline
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+        class ServeEngine:
+            def __init__(self):
+                self._swap_lock = threading.Lock()
+                self._live = (1, 2)       # __init__ is exempt
+                self._swap_epoch = 0
+
+            def infer_locked(self):
+                with self._swap_lock:
+                    h, c = self._live
+                return h, c
+
+            def swap_locked(self, new):
+                with self._swap_lock:
+                    self._swap_epoch += 1
+                    self._live = new
+
+            def infer_torn(self):
+                return self._live         # unlocked: can tear mid-swap
+
+            def epoch_racy(self):
+                return self._swap_epoch   # unlocked epoch read
+        """
+    )
+    findings = rule_serve_lock_discipline(_ctx(src, "qdml_tpu/serve/engine.py"))
+    assert {f.context for f in findings} == {
+        "ServeEngine.infer_torn",
+        "ServeEngine.epoch_racy",
+    }
+
+
 # ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------

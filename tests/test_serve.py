@@ -171,7 +171,7 @@ def warmed():
 
 def test_unwarmed_engine_refuses_request_path(warmed):
     cfg, engine, *_ = warmed
-    fresh = ServeEngine(cfg, engine._hdce_vars, engine._clf_vars)
+    fresh = ServeEngine(cfg, *engine.live_vars())
     with pytest.raises(RuntimeError, match="warmup"):
         fresh.infer(np.zeros((2, *cfg.image_hw, 2), np.float32))
 
@@ -240,9 +240,16 @@ def test_loadgen_fast_run_emits_manifest_headed_telemetry(warmed, tmp_path):
     # the end-of-run poll of the live-metrics verb, folded slim (only the
     # fields the verb ADDS; the summary already carries the histograms)
     assert summary["server_metrics"] == {
-        "workers": 1, "queue_depth_now": 0,
-        "buckets": list(engine.buckets), "completed": 48,
+        "workers": 1, "replicas": 1, "replica_completed": [48],
+        "queue_depth_now": 0, "buckets": list(engine.buckets),
+        "completed": 48, "swap_epoch": 0,
     }
+    # fleet facts ride the summary for the report gate (single-device here)
+    assert summary["replicas"] == 1 and summary["workers"] == 1
+    assert summary["mesh"] is None and summary["rps_per_replica"] == summary["rps"]
+    assert summary["arrival"] == {"process": "poisson", "burstiness": 4.0}
+    # no deadlines offered -> no SLO figure (never a fake 100%)
+    assert summary["slo"] is None
     # warmup cost accounting rides into the serve_summary record
     assert all(c["available"] for c in summary["warmup"]["cost"].values())
 
@@ -550,6 +557,27 @@ def test_latest_tag_preference_and_eval_only_restore(tmp_path):
     assert latest_tag(wd, "qsc") is None  # other families unaffected
 
 
+def test_from_workdir_corrupt_qsc_fails_loud_never_downgrades(tmp_path):
+    """A qsc tag that EXISTS but fails to restore (partial/corrupt write)
+    must propagate, not silently fall back to the classical classifier — a
+    quantum deployment quietly serving SCP128 is the worst failure mode.
+    Only the typed never-trained miss (CheckpointNotFoundError) downgrades."""
+    import os
+
+    from qdml_tpu.train.checkpoint import CheckpointNotFoundError, save_checkpoint
+
+    wd = str(tmp_path)
+    save_checkpoint(wd, "hdce_last", {"params": {"w": np.ones(4, np.float32)}})
+    save_checkpoint(wd, "sc_last", {"params": {"w": np.ones(4, np.float32)}})
+    # corrupt qsc: the tag directory resolves (latest_tag finds it) but
+    # orbax's restore raises — a FileNotFoundError, the exact shape a broad
+    # except would confuse with "never trained"
+    os.makedirs(os.path.join(wd, "qsc_last"))
+    with pytest.raises(FileNotFoundError) as ei:
+        ServeEngine.from_workdir(_tiny_cfg(), wd)
+    assert not isinstance(ei.value, CheckpointNotFoundError)  # the restore failure, not the miss
+
+
 # ---------------------------------------------------------------------------
 # Compile-cache counters: listener idempotency + reset
 # ---------------------------------------------------------------------------
@@ -627,6 +655,154 @@ def test_report_serving_latency_section_and_gate(tmp_path):
     md, regressions, armed = build_report([good], base, 10.0)
     assert not regressions and "improved" in md
     assert report_main([f"--current={good}", f"--baseline={base}"]) == EXIT_OK
+
+
+def test_arrival_processes_shapes_and_mean_rate():
+    """All three arrival processes produce n strictly-increasing offsets; the
+    Poisson and MMPP means track the target rate (law of large numbers at
+    n=4000), and the bursty/diurnal processes are visibly non-Poisson (gap
+    coefficient of variation > 1 — burstiness is the point)."""
+    from qdml_tpu.serve.loadgen import arrival_times
+
+    rng = np.random.default_rng(7)
+    n, rate = 4000, 500.0
+    for process in ("poisson", "bursty", "diurnal"):
+        t = arrival_times(n, rate, np.random.default_rng(7), process=process)
+        assert t.shape == (n,) and np.all(np.diff(t) > 0) and t[0] > 0
+        mean_rate = n / t[-1]
+        assert 0.6 * rate < mean_rate < 1.6 * rate, (process, mean_rate)
+    # the MMPP generator is exact (gaps truncate+resample at state switches:
+    # lull-rate gaps must not swallow burst dwells), so its realized mean
+    # tracks the nominal rate even at high burstiness — a regression to
+    # draw-then-flip lands ~40% under nominal at burstiness=16, far outside
+    # this band (dwell-length variance keeps finite-n runs within ~±20%)
+    for b in (4.0, 16.0):
+        t = arrival_times(n, rate, np.random.default_rng(11), process="bursty", burstiness=b)
+        assert 0.8 * rate < n / t[-1] < 1.2 * rate, (b, n / t[-1])
+    gaps_p = np.diff(arrival_times(n, rate, np.random.default_rng(1), process="poisson"))
+    for process in ("bursty", "diurnal"):
+        gaps = np.diff(arrival_times(n, rate, np.random.default_rng(1), process=process))
+        cv = np.std(gaps) / np.mean(gaps)
+        cv_p = np.std(gaps_p) / np.mean(gaps_p)
+        assert cv > cv_p * 1.1, (process, cv, cv_p)  # over-dispersed vs Poisson
+    with pytest.raises(ValueError, match="arrival process"):
+        arrival_times(4, 10.0, rng, process="lunar")
+    with pytest.raises(ValueError, match="rate"):
+        arrival_times(4, 0.0, rng)
+
+
+def test_loadgen_slo_attainment_with_generous_deadline(warmed, tmp_path):
+    """Every request carries a deadline it can trivially meet -> the
+    serve_summary slo block reports full attainment over exactly n
+    requests."""
+    cfg, engine, *_ = warmed
+    summary = run_loadgen(cfg, engine, rate=2000.0, n=24, deadline_ms=30000.0)
+    assert summary["completed"] == 24
+    assert summary["slo"] == {"n": 24, "met": 24, "attainment": 1.0}
+    assert summary["deadline_ms"] == 30000.0
+
+
+def test_slo_counts_sheds_as_misses(warmed):
+    """A deadline-carrying request shed at dequeue is an SLO miss; the
+    attainment fraction reflects it (driving the pump with a fake clock)."""
+    cfg, engine, samples, *_ = warmed
+    clock = FakeClock()
+    loop = ServeLoop(
+        engine,
+        batcher=MicroBatcher(max_batch=4, max_wait_s=0.005, max_queue=8, clock=clock),
+    )
+    f1 = loop.submit(samples["x"][0], rid=1, deadline_ms=3.0)
+    f2 = loop.submit(samples["x"][1], rid=2, deadline_ms=10000.0)
+    clock.t = 0.01  # req 1's deadline passes while queued
+    loop._serve_one()
+    assert isinstance(f1.result(timeout=1.0), Overloaded)
+    assert isinstance(f2.result(timeout=1.0), Prediction)
+    assert f2.result().deadline_met is True
+    slo = loop.metrics.slo()
+    assert slo == {"n": 2, "met": 1, "attainment": 0.5}
+
+
+def test_replica_pool_shares_feed_and_merges_metrics(warmed):
+    """ReplicaPool: 2 replicas drain ONE batcher against ONE warmed engine —
+    every future resolves, parity holds, the pool-merged metrics account for
+    every request exactly once, and the request path never compiles."""
+    from qdml_tpu.serve import ReplicaPool
+
+    cfg, engine, samples, offline_h, _ = warmed
+    pool = ReplicaPool(engine, replicas=2).start()
+    try:
+        assert pool.n_replicas == 2 and pool.workers == 2
+        results = []
+        for wave in range(2):
+            futs = [
+                pool.submit(samples["x"][i % 32], rid=wave * 32 + i)
+                for i in range(32)
+            ]
+            results += [f.result(timeout=30.0) for f in futs]
+        live = pool.live_metrics()
+    finally:
+        pool.stop()
+    preds = [r for r in results if isinstance(r, Prediction)]
+    assert len(preds) == 64
+    for r in preds:
+        np.testing.assert_allclose(r.h, offline_h[r.rid % 32], rtol=1e-5, atol=1e-5)
+    merged = pool.merged_metrics()
+    assert merged.completed == 64 and merged.latency.summary()["n"] == 64
+    assert live["replicas"] == 2 and sum(live["replica_completed"]) == 64
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+
+
+def test_pool_stopped_replica_does_not_shed_while_peer_lives(warmed):
+    """Stopping ONE replica of a pool must not drain the shared queue as
+    SHUTDOWN while its peer still serves — last-worker-out POOL-WIDE is the
+    drain trigger (the PR-3 hazard generalized across replicas)."""
+    from qdml_tpu.serve import ReplicaPool
+
+    cfg, engine, samples, *_ = warmed
+    pool = ReplicaPool(engine, replicas=2).start()
+    try:
+        pool.replicas[0].stop()
+        # peer replica still drains the shared feed: work completes normally
+        futs = [pool.submit(samples["x"][i], rid=i) for i in range(8)]
+        results = [f.result(timeout=30.0) for f in futs]
+        assert all(isinstance(r, Prediction) for r in results)
+    finally:
+        pool.stop()
+    # the WHOLE pool stopped -> submits reject with typed shutdown
+    from qdml_tpu.serve.types import SHUTDOWN
+
+    res = pool.submit(samples["x"][0]).result(timeout=1.0)
+    assert isinstance(res, Overloaded) and res.reason == SHUTDOWN
+
+
+def test_report_serving_slo_gate_and_fleet_line(tmp_path):
+    """serve_summary slo.attainment gates like roofline (a DROP beyond the
+    threshold regresses); the fleet block renders baseline-vs-current
+    topology in the serving section."""
+    from qdml_tpu.telemetry.report import EXIT_OK, EXIT_REGRESSION, build_report, report_main
+
+    def rec(attain, replicas, devices, rps):
+        r = _serve_summary_rec(5.0, 9.0, 12.0, rps)
+        r["slo"] = {"n": 100, "met": int(attain * 100), "attainment": attain}
+        r["replicas"] = replicas
+        r["workers"] = replicas
+        r["mesh"] = {"devices": devices, "axes": {"data": devices}}
+        r["rps_per_replica"] = round(rps / replicas, 2)
+        return r
+
+    base = _write(tmp_path, "base.jsonl", rec(0.99, 2, 4, 800.0))
+    bad = _write(tmp_path, "bad.jsonl", rec(0.70, 2, 4, 820.0))
+    md, regressions, armed = build_report([bad], base, 10.0)
+    assert armed and {r["metric"] for r in regressions} == {"serve.slo_attainment"}
+    assert "serving SLO attainment" in md
+    assert "fleet: baseline 2 replica(s) x 4 device(s)" in md
+    assert report_main([f"--current={bad}", f"--baseline={base}"]) == EXIT_REGRESSION
+
+    ok = _write(tmp_path, "ok.jsonl", rec(0.995, 4, 8, 1600.0))
+    md, regressions, armed = build_report([ok], base, 10.0)
+    assert not regressions
+    assert "current 4 replica(s) x 8 device(s)" in md
+    assert report_main([f"--current={ok}", f"--baseline={base}"]) == EXIT_OK
 
 
 def test_report_serving_platform_mismatch_disarms(tmp_path):

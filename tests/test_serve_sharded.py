@@ -1,0 +1,327 @@
+"""Sharded multi-replica serving: pjit-sharded AOT buckets over the mesh,
+replica pools on the shared feed, and zero-downtime checkpoint hot-swap.
+
+Runs on the 8-virtual-device CPU mesh from conftest.py (the
+``XLA_FLAGS=--xla_force_host_platform_device_count`` pattern the mesh
+dryruns use, applied by ``utils.platform.force_cpu(8)``) — the tier-1
+multi-device serve smoke the ISSUE-7 acceptance criteria name: the sharded
+engine proves zero request-path compiles across warmup, steady traffic AND
+a live hot-swap, and the loadgen fleet summary carries the topology the
+report gate consumes.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from qdml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    ServeConfig,
+    TrainConfig,
+    override,
+)
+from qdml_tpu.parallel.mesh import serve_mesh
+from qdml_tpu.serve import ReplicaPool, ServeEngine, ServeLoop, run_loadgen
+from qdml_tpu.serve.loadgen import make_request_samples
+from qdml_tpu.serve.types import Prediction
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+ZERO = {"hits": 0, "misses": 0, "requests": 0}
+
+
+def _cfg(**serve_kw):
+    serve = ServeConfig(max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=64, **serve_kw)
+    return ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        # data_axis=4: both buckets (4, 8) divide, so every executable is
+        # batch-sharded; fed/model stay 1 unless a test overrides
+        mesh=MeshConfig(data_axis=4, model_axis=1, fed_axis=1),
+        serve=serve,
+    )
+
+
+def _vars(cfg, seed=None):
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    if seed is not None:
+        cfg = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, seed=seed))
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    return hdce_vars, {"params": sc_state.params}
+
+
+@pytest.fixture(scope="module")
+def sharded():
+    """One warmed data-parallel engine + offline reference shared by the
+    sharded serving tests (each bucket is an XLA compile; module scope keeps
+    the suite fast)."""
+    cfg = _cfg()
+    mesh = serve_mesh(cfg)
+    assert mesh is not None and mesh.shape["data"] == 4
+    hdce_vars, clf_vars = _vars(cfg)
+    engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
+    samples = make_request_samples(cfg, 32)
+    offline_h, offline_pred = engine.offline_forward(samples["x"])
+    warm = engine.warmup()
+    return cfg, engine, samples, offline_h, offline_pred, warm
+
+
+def test_serve_mesh_resolution():
+    """serve_mesh: auto builds the mesh, off pins single-device, expert
+    sharding validates the fed axis before any bucket compiles."""
+    assert serve_mesh(_cfg()) is not None
+    assert serve_mesh(_cfg(shard="off")) is None
+    with pytest.raises(ValueError, match="serve.shard"):
+        serve_mesh(_cfg(shard="maybe"))
+    bad = override(_cfg(expert_sharding=True), "mesh.fed_axis", 2)
+    with pytest.raises(ValueError):  # fed=2 != n_scenarios=3 (training_mesh or serve_mesh)
+        serve_mesh(bad)
+
+
+def test_sharded_warmup_bakes_batch_sharding(sharded):
+    """Every bucket the data axis divides is lowered batch-sharded — the
+    sharding is baked into the executable, recorded per bucket, and the
+    warmup record carries the mesh topology."""
+    cfg, engine, _, _, _, warm = sharded
+    assert engine.bucket_sharding == {"4": "data", "8": "data"}
+    assert warm["sharding"] == {"4": "data", "8": "data"}
+    assert warm["mesh"] == {
+        "devices": 4,
+        "axes": {"fed": 1, "data": 4, "model": 1},
+        "expert_sharding": False,
+    }
+    # the executables' h output is actually partitioned over the data axis
+    out_sh = engine._compiled[8](
+        *engine.live_vars(),
+        np.zeros((8, *cfg.image_hw, 2), np.float32),
+    )[0].sharding
+    assert "data" in str(out_sh.spec)
+
+
+def test_sharded_infer_parity_and_zero_compiles(sharded):
+    """Sharded buckets (and padded partial fills) reproduce the offline
+    forward; the request path never compiles — the SPMD program is as
+    pinned as the single-device one."""
+    cfg, engine, samples, offline_h, offline_pred, _ = sharded
+    for n in (1, 3, 4, 5, 8):
+        h, pred, bucket = engine.infer(samples["x"][:n])
+        assert h.shape == (n, cfg.h_out_dim)
+        np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(pred, offline_pred[:n])
+    assert engine.request_path_compiles() == ZERO
+
+
+def test_sharded_serve_loop_end_to_end(sharded):
+    """The full loop over the sharded engine: N requests coalesce, serve,
+    parity-check, zero request-path compiles (the tier-1 multi-device serve
+    smoke)."""
+    cfg, engine, samples, offline_h, offline_pred, _ = sharded
+    loop = ServeLoop(engine).start()
+    try:
+        futs = [loop.submit(samples["x"][i], rid=i) for i in range(20)]
+        results = [f.result(timeout=30.0) for f in futs]
+    finally:
+        loop.stop()
+    assert all(isinstance(r, Prediction) for r in results)
+    served = np.stack([r.h for r in sorted(results, key=lambda r: r.rid)])
+    np.testing.assert_allclose(served, offline_h[:20], rtol=1e-5, atol=1e-5)
+    assert engine.request_path_compiles() == ZERO
+
+
+def test_expert_sharded_trunks_parity():
+    """serve.expert_sharding over a fed=3 mesh: stacked trunk leaves live
+    sharded over `fed` (the federated placement rules), and the fused
+    forward still matches an unsharded engine bit-for-bit-modulo-fp."""
+    cfg = _cfg(expert_sharding=True)
+    cfg = override(cfg, "mesh.fed_axis", 3)
+    cfg = override(cfg, "mesh.data_axis", 2)
+    mesh = serve_mesh(cfg)
+    assert mesh is not None and mesh.shape["fed"] == 3 and mesh.shape["data"] == 2
+    hdce_vars, clf_vars = _vars(cfg)
+    engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
+    samples = make_request_samples(cfg, 16)
+    offline_h, offline_pred = engine.offline_forward(samples["x"])
+    warm = engine.warmup()
+    assert warm["mesh"]["expert_sharding"] is True
+    # trunk params are genuinely fed-sharded on device
+    leaves = jax.tree_util.tree_leaves_with_path(engine.live_vars()[0])
+    stacked = [l for p, l in leaves if "StackedConvP128" in str(p)]
+    assert stacked and all("fed" in str(l.sharding.spec) for l in stacked)
+    for n in (3, 8):
+        h, pred, _ = engine.infer(samples["x"][:n])
+        np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(pred, offline_pred[:n])
+    assert engine.request_path_compiles() == ZERO
+
+
+# ---------------------------------------------------------------------------
+# Zero-downtime checkpoint hot-swap
+# ---------------------------------------------------------------------------
+
+
+def test_hot_swap_under_traffic_zero_compiles_exact_parity():
+    """The ISSUE-7 hot-swap acceptance pin: serve traffic across a live
+    swap — requests before the swap match the OLD checkpoint's offline
+    forward, requests after match the NEW one's, and the compile-cache
+    counters prove zero compiles across warmup + steady traffic + the swap
+    itself.
+
+    Standalone engine (not the module fixture): BOTH parity references must
+    compile BEFORE warmup arms the gate — the counters are process-global,
+    so the gate window has to contain nothing but serving + the swap (the
+    same ordering discipline loadgen documents)."""
+    cfg = _cfg()
+    mesh = serve_mesh(cfg)
+    hdce_vars, clf_vars = _vars(cfg)
+    new_hdce, new_clf = _vars(cfg, seed=123)
+    engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
+    samples = make_request_samples(cfg, 16)
+    offline_h, _ = engine.offline_forward(samples["x"])
+    # the NEW checkpoint's parity reference, through the same engine family,
+    # compiled before the gate arms
+    ref_engine = ServeEngine(cfg, new_hdce, new_clf, mesh=mesh)
+    new_h, new_pred = ref_engine.offline_forward(samples["x"])
+    engine.warmup()
+
+    pool = ReplicaPool(engine, replicas=2).start()
+    try:
+        pre = [pool.submit(samples["x"][i], rid=i) for i in range(12)]
+        pre_res = [f.result(timeout=30.0) for f in pre]
+        rec = engine.swap_params(new_hdce, new_clf)
+        post = [pool.submit(samples["x"][i], rid=100 + i) for i in range(12)]
+        post_res = [f.result(timeout=30.0) for f in post]
+    finally:
+        pool.stop()
+
+    # swap bookkeeping: epoch advanced, the swap itself compiled NOTHING
+    assert rec["epoch"] == 1 and engine.swap_epoch == 1
+    assert rec["compile"] == ZERO
+    # pre-swap traffic resolved against the OLD checkpoint...
+    for r in pre_res:
+        assert isinstance(r, Prediction)
+        np.testing.assert_allclose(r.h, offline_h[r.rid], rtol=1e-5, atol=1e-5)
+    # ...post-swap traffic EXACTLY matches the NEW checkpoint's offline
+    # forward (same executables, new params — NMSE parity is bitwise at f32)
+    for r in post_res:
+        assert isinstance(r, Prediction)
+        np.testing.assert_allclose(r.h, new_h[r.rid - 100], rtol=1e-5, atol=1e-5)
+        assert r.scenario == int(new_pred[r.rid - 100])
+    # the whole window — warmup snapshot through traffic through the swap
+    # through drain — saw zero request-path compiles
+    assert engine.request_path_compiles() == ZERO
+    # swaps are repeatable: back to the original checkpoint, still zero
+    assert engine.swap_params(hdce_vars, clf_vars)["compile"] == ZERO
+    h, _, _ = engine.infer(samples["x"][:4])
+    np.testing.assert_allclose(h, offline_h[:4], rtol=1e-5, atol=1e-5)
+    assert engine.request_path_compiles() == ZERO
+
+
+def test_swap_rejects_mismatched_checkpoint(sharded):
+    """A shape-changing checkpoint cannot hot-swap: validation raises BEFORE
+    anything is placed, and the old params keep serving."""
+    cfg, engine, samples, offline_h, *_ = sharded
+    wrong_cfg = ExperimentConfig(
+        data=dataclasses.replace(cfg.data),
+        model=ModelConfig(features=16),  # different trunk width
+        train=cfg.train,
+        serve=cfg.serve,
+        mesh=cfg.mesh,
+    )
+    wrong_h, wrong_c = _vars(wrong_cfg)
+    with pytest.raises(ValueError, match="hot-swap"):
+        engine.swap_params(wrong_h, wrong_c)
+    h, _, _ = engine.infer(samples["x"][:4])
+    np.testing.assert_allclose(h, offline_h[:4], rtol=1e-5, atol=1e-5)
+
+
+def test_swap_before_warmup_raises():
+    cfg = _cfg(shard="off")
+    hdce_vars, clf_vars = _vars(cfg)
+    engine = ServeEngine(cfg, hdce_vars, clf_vars)
+    with pytest.raises(RuntimeError, match="warmup"):
+        engine.swap_params(hdce_vars, clf_vars)
+
+
+def test_swap_from_workdir_redeploys_newest(tmp_path):
+    """The {"op": "swap"} engine half: a training run promoting a new *_best
+    into the workdir hot-swaps in (tags re-resolved each call), zero
+    compiles, and the served numbers flip to the new checkpoint."""
+    from qdml_tpu.train.checkpoint import save_checkpoint
+
+    cfg = _cfg(shard="off")
+    h0, c0 = _vars(cfg)
+    h1, c1 = _vars(cfg, seed=321)
+    wd = str(tmp_path)
+    save_checkpoint(wd, "hdce_last", h0)
+    save_checkpoint(wd, "sc_last", c0)
+    engine = ServeEngine.from_workdir(cfg, wd)
+    samples = make_request_samples(cfg, 8)
+    engine.warmup()
+    before, _, _ = engine.infer(samples["x"][:4])
+    # a better checkpoint lands (best beats last in tag discovery)
+    save_checkpoint(wd, "hdce_best", h1)
+    save_checkpoint(wd, "sc_best", c1)
+    rec = engine.swap_from_workdir(wd)
+    assert rec["tags"] == {"hdce": "hdce_best", "sc": "sc_best"}
+    assert rec["compile"] == ZERO
+    after, _, _ = engine.infer(samples["x"][:4])
+    assert np.max(np.abs(after - before)) > 0  # the deploy actually landed
+    assert engine.request_path_compiles() == ZERO
+
+
+# ---------------------------------------------------------------------------
+# Fleet loadgen over the sharded engine (the >=2-device dryrun in-suite)
+# ---------------------------------------------------------------------------
+
+
+def test_multi_replica_sharded_loadgen_fleet_summary(tmp_path):
+    """loadgen over a 2-replica pool on the 4-device data-parallel engine:
+    every request completes with parity, the serve_summary carries the fleet
+    block (replicas, workers, mesh topology, per-bucket sharding,
+    rps_per_replica), and the report gate consumes the record end to end."""
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.telemetry.report import EXIT_OK, report_main
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    cfg = _cfg(replicas=2)
+    mesh = serve_mesh(cfg)
+    hdce_vars, clf_vars = _vars(cfg)
+    engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
+    path = str(tmp_path / "fleet.metrics.jsonl")
+    logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+    summary = run_loadgen(
+        cfg, engine, rate=2000.0, n=48, deadline_ms=30000.0, logger=logger
+    )
+    logger.close()
+
+    assert summary["completed"] == 48 and summary["n_shed"] == 0
+    assert summary["compile_cache_after_warmup"] == ZERO
+    assert summary["parity_max_abs_err"] < 1e-4
+    assert summary["replicas"] == 2 and summary["workers"] == 2
+    assert summary["mesh"] == {
+        "devices": 4,
+        "axes": {"fed": 1, "data": 4, "model": 1},
+        "expert_sharding": False,
+    }
+    assert summary["bucket_sharding"] == {"4": "data", "8": "data"}
+    assert summary["rps_per_replica"] == pytest.approx(summary["rps"] / 2, abs=0.02)
+    assert summary["slo"]["attainment"] == 1.0
+    assert sum(summary["server_metrics"]["replica_completed"]) == 48
+
+    # the new gate consumes the fleet record: same artifact as its own
+    # baseline gates clean (rps, p50/p95/p99, slo all "ok")
+    rc = report_main([f"--current={path}", f"--baseline={path}"])
+    assert rc == EXIT_OK
