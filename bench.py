@@ -535,6 +535,204 @@ def _bench_qsc_scan(
     return out
 
 
+def _bench_qsc_scaling(
+    budget_s: float,
+    n_values=None,
+    n_layers: int = 3,
+    mps_chi: int = 16,
+    table_path: str | None = None,
+) -> dict:
+    """The qubit-scaling axis (``qsc_scaling``): one measured point per n in
+    the 4..24 grid — the autotuner races every impl eligible at that (n,
+    topology), the DISPATCHER's winner is timed as a train step (one jitted
+    ``value_and_grad`` over the circuit, the shape train loops dispatch), and
+    the point records steps/s, samples/s, XLA cost (flops / bytes / peak
+    temp memory), achieved roofline, the chosen ``quantum_impl``, the
+    ``mps_chi`` raced, and every candidate's micro-bench timings — so
+    BENCH_r06 can plot the impl crossover points straight off the artifact.
+
+    Candidate policy (every exclusion is RECORDED per point — a silent cap
+    would read as "covered everything"): the per-topology
+    ``autotune.eligible_impls`` set, minus the pallas kernels off-TPU (they
+    only run in interpret mode there: a pure-python emulation whose timings
+    say nothing about dispatch), minus ``sharded_statevector`` past n=16 on
+    the CPU harness (compiling grad-of-250-collectives programs over 8
+    virtual devices costs minutes per point; on real ICI hardware the
+    window stays open). Per-n batches shrink with the statevector footprint
+    (:func:`qdml_tpu.eval.sweep.scaling_batch`) — each n gates only against
+    itself, so cross-n batches need not match."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from qdml_tpu.eval.sweep import QUBIT_SCALING_GRID, scaling_batch, scaling_chi
+    from qdml_tpu.quantum import autotune as _at
+    from qdml_tpu.quantum.circuits import run_circuit
+    from qdml_tpu.telemetry import cost as _cost
+
+    platform = jax.default_backend()
+    devs = _at.model_axis_devices()
+    if table_path:
+        _at.set_table_path(table_path)
+    points = []
+    for n in n_values or QUBIT_SCALING_GRID:
+        batch = scaling_batch(n)
+        chi = scaling_chi(n, mps_chi)
+        impls = _at.eligible_impls(n, platform, devs)
+        excluded = []
+        if platform != "tpu":
+            excluded += [
+                {"impl": i, "reason": "pallas off-TPU runs in interpret mode"}
+                for i in impls
+                if i.startswith("pallas")
+            ]
+            if n > 16 and "sharded_statevector" in impls:
+                excluded.append(
+                    {
+                        "impl": "sharded_statevector",
+                        "reason": (
+                            "cpu-harness compile budget (grad of a ~250-"
+                            "collective program over virtual devices); "
+                            "window open on real ICI hardware"
+                        ),
+                    }
+                )
+        drop = {e["impl"] for e in excluded}
+        impls = [i for i in impls if i not in drop]
+        point: dict = {
+            "n_qubits": n,
+            "dim": 1 << n,
+            "batch": batch,
+            "candidates_raced": impls,
+        }
+        if excluded:
+            point["excluded"] = excluded
+        try:
+            entry = _at.ensure(
+                n,
+                n_layers,
+                batch,
+                path=table_path,
+                force=True,
+                impls=impls,
+                budget_s=budget_s,
+                mps_chi=chi,
+            )
+            winner = entry.get("best_train")
+            point["candidates"] = entry["candidates"]
+            if winner is None:
+                point["error"] = "no candidate ran (see candidates.*.error)"
+                points.append(point)
+                continue
+            point["quantum_impl"] = winner
+            # chi belongs to the mps run, not the point: attribute it to the
+            # winner only when mps won, and to the raced mps candidate
+            # otherwise — a tensor winner's row must not claim a bond dim
+            if winner == "mps":
+                point["mps_chi"] = chi
+            elif isinstance(entry["candidates"].get("mps"), dict):
+                entry["candidates"]["mps"].setdefault("mps_chi", chi)
+            # The winner's train step, timed and costed at this exact shape:
+            # the measured number IS best-of-impls (the dispatcher already
+            # raced the rest — their timings sit next to it in candidates).
+            rng = np.random.default_rng(0)
+            angles = jnp.asarray(
+                rng.uniform(-1, 1, (batch, n)).astype(np.float32)
+            )
+            weights = jnp.asarray(
+                rng.uniform(0, 2 * np.pi, (n_layers, n, 2)).astype(np.float32)
+            )
+            step = jax.jit(
+                jax.value_and_grad(
+                    lambda w, a: jnp.sum(
+                        run_circuit(
+                            a, w, n, n_layers, backend=winner, mps_chi=chi
+                        )
+                        ** 2
+                    )
+                )
+            )
+            cost_rec = _cost.analyze_jit(step, weights, angles)
+            # autotune's own median-of-reps timer: the point's number is
+            # measured the same way the candidates it beat were
+            ms = _at._time_callable(step, (weights, angles), budget_s, 30)
+            sps = 1e3 / ms
+            point["train_ms"] = round(ms, 4)
+            point["steps_per_sec"] = round(sps, 3)
+            point["samples_per_sec"] = round(sps * batch, 1)
+            point["cost"] = cost_rec
+            point["peak_temp_bytes"] = cost_rec.get("peak_temp_bytes")
+            point["roofline"] = _cost.achieved_roofline(cost_rec, sps)
+        except Exception as e:  # lint: disable=broad-except(point isolation: one n failing must not kill the sweep's other points; the error is recorded on the point)
+            point["error"] = f"{type(e).__name__}: {e}"
+        points.append(point)
+    return {
+        "points": points,
+        "n_layers": n_layers,
+        "devices_on_model": devs,
+        "platform": platform,
+        "mps_chi": mps_chi,
+        "table": _at.table_path(table_path),
+    }
+
+
+def run_scaling_child(out_path: str | None = None) -> int:
+    """The qubit-scaling sweep as its own child: compiles at n=20+ cost
+    minutes each on the CPU harness, so the sweep never rides the default
+    bench child's budget — ``bench.py --scaling`` (or
+    ``scripts/qubit_scaling_sweep.py``, which also forces the 8-virtual-
+    device topology) runs it deliberately. Prints one JSON record; with
+    ``out_path`` also writes the manifest-headed telemetry JSONL."""
+    import jax
+
+    from qdml_tpu.eval.sweep import impl_agreement, scaling_chi
+    from qdml_tpu.telemetry import run_manifest
+
+    budget = float(os.environ.get("QDML_SCALING_BUDGET_S", "2.0"))
+    table = os.environ.get("QDML_SCALING_TABLE") or None
+    grid = os.environ.get("QDML_SCALING_GRID")  # "4,14" (tests/smoke); default full
+    n_values = tuple(int(x) for x in grid.split(",")) if grid else None
+    scaling = _bench_qsc_scaling(budget, n_values=n_values, table_path=table)
+    # numerics cross-check per point (eval half of the axis): winner vs an
+    # independent formulation — dense/tensor where they exist, mps-vs-
+    # sharded past them (truncation error IS the number at small chi)
+    for p in scaling["points"]:
+        impl = p.get("quantum_impl")
+        if impl is None:
+            continue
+        try:
+            p["agreement"] = impl_agreement(
+                p["n_qubits"],
+                impl,
+                n_layers=scaling["n_layers"],
+                batch=min(4, p["batch"]),
+                mps_chi=scaling_chi(p["n_qubits"], scaling["mps_chi"]),
+            )
+        except Exception as e:  # lint: disable=broad-except(the agreement check annotates the perf point; its failure must not discard the measurement)
+            p["agreement"] = {"error": f"{type(e).__name__}: {e}"}
+    manifest = run_manifest(
+        argv=["bench.py", "--scaling"],
+        extra={"devices_on_model": scaling["devices_on_model"]},
+    )
+    non_dense = [
+        p["n_qubits"]
+        for p in scaling["points"]
+        if p.get("quantum_impl") not in (None, "dense", "dense_fused")
+    ]
+    record = {
+        "metric": "qsc_scaling_points",
+        "value": len([p for p in scaling["points"] if "samples_per_sec" in p]),
+        "unit": f"measured scaling points (of {len(scaling['points'])})",
+        "platform": jax.default_backend(),
+        "non_dense_points": non_dense,
+        "details": {"qsc_scaling": scaling},
+    }
+    print(json.dumps(record), flush=True)
+    if out_path:
+        _write_telemetry_jsonl(out_path, manifest, record)
+    return 0
+
+
 def _bench_serve_infer(max_steps: int, budget_s: float, bucket: int = 64) -> dict:
     """Request-path throughput of the online serving engine
     (:mod:`qdml_tpu.serve`): one warmed full-bucket ``infer`` per iteration —
@@ -614,6 +812,12 @@ def run_child(platform: str) -> int:
     from qdml_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
+
+    if platform == "scaling":
+        # the qubit-scaling sweep child (bench.py --scaling): its n=20+
+        # compiles cost minutes on the CPU harness, so it never rides the
+        # default child's budget — it IS the whole child here
+        return run_scaling_child(os.environ.get("QDML_SCALING_OUT") or None)
 
     on_tpu = platform != "cpu"
     max_steps = 50 if on_tpu else 6
@@ -1042,9 +1246,29 @@ def main() -> int:
         help="telemetry JSONL path (manifest header + record); the one-line "
         "stdout record is unchanged",
     )
+    ap.add_argument(
+        "--scaling",
+        action="store_true",
+        help="run the n=4..24 qubit-scaling sweep child (qsc_scaling record) "
+        "instead of the standard bench — honors the caller's JAX_PLATFORMS/"
+        "XLA_FLAGS topology (scripts/qubit_scaling_sweep.py forces the "
+        "8-virtual-device CPU harness)",
+    )
     args = ap.parse_args()
     if args.child:
         return run_child(args.child)
+    if args.scaling:
+        env = dict(os.environ)
+        if args.out:
+            env["QDML_SCALING_OUT"] = args.out
+        timeout = int(os.environ.get("QDML_SCALING_TIMEOUT_S", "3600"))
+        d = _run_bench_child(env, "scaling", timeout_s=timeout)
+        if d is None:
+            print(json.dumps({"metric": "qsc_scaling_points", "value": None,
+                              "error": "scaling child failed or timed out"}))
+            return 1
+        print(json.dumps(d))
+        return 0
 
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK_BF16.get(gen, _PEAK_BF16["v5e"])

@@ -81,6 +81,27 @@ COLLECTIVE_CALLS: frozenset[str] = frozenset(
 # Guard predicates that make a block primary-only.
 PRIMARY_GUARDS: frozenset[str] = frozenset({"is_primary", "process_index"})
 
+# Named-axis collectives/queries that only mean something inside a
+# ``shard_map`` region. In ``quantum/`` (the mesh-sharded statevector
+# subsystem) one of these traced OUTSIDE a shard_map-wrapped function is the
+# multihost-deadlock shape: an unbound-axis error at best, and in a pjit
+# program a collective some devices never join at worst (rule
+# collective-outside-shardmap).
+SHARD_AXIS_CALLS: frozenset[str] = frozenset(
+    {
+        "ppermute",
+        "pshuffle",
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "psum_scatter",
+        "all_gather",
+        "all_to_all",
+        "axis_index",
+    }
+)
+
 # Typed exceptions a broad except can swallow (rule broad-except's message
 # names them so the fix is obvious).
 TYPED_EXCEPTIONS: tuple[str, ...] = ("DivergenceError", "KeyboardInterrupt")

@@ -672,6 +672,90 @@ def rule_gate_matrix_in_loop(ctx: ModuleContext) -> list[Finding]:
     return out
 
 
+def rule_collective_outside_shardmap(ctx: ModuleContext) -> list[Finding]:
+    """A named-axis collective (``ppermute``/``psum``/``axis_index``/...,
+    project.SHARD_AXIS_CALLS) in ``quantum/`` traced outside a ``shard_map``
+    region. The mesh-sharded statevector keeps EVERY collective inside the
+    one ``shard_map`` region so XLA schedules the exchanges; the same call
+    reached from outside is the subsystem's multihost-deadlock shape — an
+    unbound-axis trace error at best, and inside a pjit program a collective
+    some devices never join at worst.
+
+    "Inside the region" is judged by local reachability: the functions
+    passed to ``shard_map(...)`` (directly or through ``functools.partial``)
+    seed a closure over same-module calls, and a collective in any function
+    OUTSIDE that closure — or at module level — is a finding. Deliberately
+    NOT caught: cross-module call chains (the sharded subsystem is
+    single-module by design — a helper that needs the axis lives next to the
+    region that binds it) and collectives under an explicit axis-bound
+    transform other than shard_map (``pmap`` is not used in quantum/)."""
+    path = ctx.path.replace("\\", "/")
+    if "quantum/" not in path and not path.startswith("quantum"):
+        return []
+
+    defs: dict[str, ast.AST] = {
+        node.name: node for node in ast.walk(ctx.tree) if isinstance(node, _FuncNode)
+    }
+
+    def fn_names_in(node: ast.AST):
+        """Local function names referenced by a shard_map argument: a bare
+        Name, or threaded through functools.partial(...)."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in defs:
+                yield sub.id
+
+    seeds: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        if callee.rsplit(".", 1)[-1] != "shard_map":
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            seeds.update(fn_names_in(arg))
+
+    # transitive closure over same-module calls from the seeded region bodies
+    region = set()
+    frontier = list(seeds)
+    while frontier:
+        name = frontier.pop()
+        if name in region:
+            continue
+        region.add(name)
+        for sub in ast.walk(defs[name]):
+            if isinstance(sub, ast.Call):
+                callee = dotted_name(sub.func) or ""
+                tail = callee.rsplit(".", 1)[-1]
+                if tail in defs and tail not in region:
+                    frontier.append(tail)
+
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        if callee.rsplit(".", 1)[-1] not in project.SHARD_AXIS_CALLS:
+            continue
+        fn = ctx.enclosing_function(node)
+        fn_name = getattr(fn, "name", None)
+        if fn_name in region:
+            continue
+        where = f"in {fn_name!r}" if fn_name else "at module level"
+        out.append(
+            ctx.finding(
+                "collective-outside-shardmap",
+                node,
+                f"named-axis collective {callee!r} {where}, outside every "
+                "shard_map region in this module — the axis name is unbound "
+                "there (trace error single-host, potential collective "
+                "deadlock multihost); move the call into a function the "
+                "shard_map region reaches (quantum/sharded.py keeps all "
+                "exchanges inside the one region)",
+            )
+        )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
@@ -728,6 +812,10 @@ RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
     "gate-matrix-in-loop": (
         rule_gate_matrix_in_loop,
         "per-gate jnp matrix construction inside a circuit layer loop",
+    ),
+    "collective-outside-shardmap": (
+        rule_collective_outside_shardmap,
+        "ppermute/psum in quantum/ outside a shard_map region (deadlock shape)",
     ),
     # "slow-marker" is data-driven (needs a --durations report) and lives in
     # qdml_tpu.analysis.slowmarkers; the CLI folds it in when given the data.

@@ -111,9 +111,16 @@ class QuantumConfig:
     noise_sweep: tuple[float, ...] = (0.0, 0.01, 0.05, 0.1)
     # Legacy simulator-backend knob: "auto" (default) defers to the
     # autotuned dispatcher below; an explicit value ("dense"/"dense_fused"/
-    # "tensor"/"pallas"/"pallas_circuit"/"sharded") forces that path
-    # everywhere (see qdml_tpu.quantum.circuits.resolve_impl / VALID_BACKENDS).
+    # "tensor"/"pallas"/"pallas_circuit"/"sharded_statevector"/"mps") forces
+    # that path everywhere (see qdml_tpu.quantum.circuits.resolve_impl /
+    # VALID_BACKENDS; "sharded" is the legacy alias for the mesh-sharded
+    # statevector).
     backend: str = "auto"
+    # Bond dimension for the "mps" impl (qdml_tpu.quantum.mps): chi >=
+    # 2^(n/2) is EXACT for this circuit class; smaller chi is a controlled
+    # approximation whose error is non-increasing in chi (docs/QUANTUM.md
+    # "scaling past 12 qubits" has the guidance table).
+    mps_chi: int = 8
     # Autotuned implementation dispatch (qdml_tpu.quantum.autotune,
     # docs/QUANTUM.md). impl: "auto" routes every circuit shape through the
     # measured selection table (falling back to XLA dense when no table

@@ -88,6 +88,7 @@ def make_sweep_step(
             n_classes=cfg.quantum.n_classes,
             backend=cfg.quantum.backend,
             impl=cfg.quantum.impl,
+            mps_chi=cfg.quantum.mps_chi,
             input_norm=cfg.quantum.input_norm,
         )
         if qsc_vars is not None
@@ -228,3 +229,90 @@ def run_snr_sweep(
         if logger is not None:
             logger.log(snr_db=float(snr), n_samples=sums["count"], **row)
     return {"snr": list(cfg.eval.snr_grid), "nmse_db": curves, "acc": accs}
+
+
+# ---------------------------------------------------------------------------
+# Qubit-scaling axis (the n = 4 ... 24 sweep, docs/QUANTUM.md)
+# ---------------------------------------------------------------------------
+
+# The scaling grid: the paper's published 4/6/8-qubit regime, the dense and
+# pallas windows' edges (10/12), the tensor crossover (14), and the
+# compressed/partitioned-only regime (16/20/24) nothing dense-shaped reaches.
+QUBIT_SCALING_GRID = (4, 6, 8, 10, 12, 14, 16, 20, 24)
+
+
+def scaling_batch(n_qubits: int) -> int:
+    """Per-point circuit batch for the scaling sweep: the full-statevector
+    footprint is ``batch * 2^n`` amplitudes, so the batch shrinks as n grows
+    to keep every point runnable on the CPU virtual-device harness (and
+    comparable run-to-run — the per-n batch is deterministic, and each n only
+    ever gates against itself)."""
+    if n_qubits <= 16:
+        return 64
+    if n_qubits <= 20:
+        return 8
+    return 2
+
+
+def scaling_chi(n_qubits: int, chi: int) -> int:
+    """The mps bond dimension a scaling point actually runs: ``chi`` capped
+    at the exactness bound 2^(n/2) — a larger chi buys nothing (the chain's
+    Schmidt rank can't exceed the bound) and would just pad the SVDs."""
+    return max(2, min(int(chi), 1 << (n_qubits // 2)))
+
+
+def impl_agreement(
+    n_qubits: int,
+    impl: str,
+    n_layers: int = 3,
+    batch: int = 4,
+    mps_chi: int | None = None,
+    seed: int = 0,
+) -> dict:
+    """Numerics cross-check for one scaling point: how far ``impl``'s
+    per-wire <Z> sits from an INDEPENDENT formulation at the same
+    (angles, weights).
+
+    The reference is dense (n <= 12) or the gate-wise tensor path (n <= 14)
+    — past every full-statevector window the compressed (mps) and
+    partitioned (sharded_statevector) states check each OTHER when the
+    topology offers both (two formulations sharing no code path), and a
+    point with no second formulation reports ``reference: null`` rather
+    than a vacuous self-check. Returns ``{reference, max_abs_delta}``."""
+    import numpy as np
+
+    from qdml_tpu.quantum import autotune
+    from qdml_tpu.quantum.circuits import run_circuit
+
+    reference: str | None = None
+    if impl != "dense" and n_qubits <= 12:
+        reference = "dense"
+    elif impl == "tensor":
+        # tensor winning the 13-14 crossover window: mps is the independent
+        # formulation there (dense is past its wall, and a full-chi mps is
+        # exact for this circuit class)
+        reference = "mps"
+    elif impl != "tensor" and n_qubits <= 14:
+        reference = "tensor"
+    elif impl == "mps" and autotune.model_axis_devices() >= 2:
+        reference = "sharded_statevector"
+    elif impl == "sharded_statevector":
+        reference = "mps"
+    if reference is None:
+        return {"reference": None, "max_abs_delta": None}
+    rng = np.random.default_rng(seed)
+    angles = jnp.asarray(rng.uniform(-1, 1, (batch, n_qubits)).astype(np.float32))
+    weights = jnp.asarray(
+        rng.uniform(0, 2 * np.pi, (n_layers, n_qubits, 2)).astype(np.float32)
+    )
+    chi = scaling_chi(n_qubits, mps_chi or 16)
+    out = run_circuit(
+        angles, weights, n_qubits, n_layers, backend=impl, mps_chi=chi
+    )
+    ref = run_circuit(
+        angles, weights, n_qubits, n_layers, backend=reference, mps_chi=chi
+    )
+    return {
+        "reference": reference,
+        "max_abs_delta": round(float(jnp.max(jnp.abs(out - ref))), 8),
+    }

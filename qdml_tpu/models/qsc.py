@@ -46,6 +46,9 @@ class QSCP128(nn.Module):
     # measured selection table per shape/platform, falling back to dense;
     # an explicit impl wins over the table AND the legacy backend knob
     impl: str = "auto"
+    # Bond dimension when the "mps" impl runs (quantum.mps_chi): exact at
+    # chi >= 2^(n/2), a controlled approximation below (docs/QUANTUM.md)
+    mps_chi: int = 8
     # Per-sample RMS normalization of the pilot image before the CNN. OFF by
     # default (reference parity: QSC_P128 consumes raw pilots). The raw-pilot
     # angle encoding is scale-sensitive — a classifier trained at SNR 10
@@ -121,6 +124,7 @@ class QSCP128(nn.Module):
                 self.backend,
                 impl=self.impl,
                 mode="train" if train else "infer",
+                mps_chi=self.mps_chi,
             )
         logits = nn.Dense(self.n_classes)(expz)
         return nn.log_softmax(logits, axis=-1)

@@ -1,8 +1,9 @@
 """Autotuned implementation dispatch for the quantum circuit hot path.
 
-The repo carries FOUR interchangeable circuit implementations (XLA dense,
-whole-circuit fused Pallas, VMEM-resident multi-layer Pallas, gate-wise
-tensor — plus the mesh-sharded statevector) and its own bench history proves
+The repo carries SEVEN interchangeable circuit implementations (XLA dense and
+its gate-matrix-fused twin, whole-circuit fused Pallas, VMEM-resident
+multi-layer Pallas, gate-wise tensor, the bond-chi MPS tensor network, and
+the mesh-sharded statevector) and its own bench history proves
 the winner is shape- and platform-dependent: BENCH_r05 shows ``qsc_pallas``
 LOSING the train step to ``qsc_dense`` (9.76k vs 10.4k sps) at the very shape
 the old static heuristic promoted the kernel for. Nothing structural
@@ -45,6 +46,16 @@ ENV_TABLE = "QDML_QSC_AUTOTUNE_TABLE"
 # this module directly (circuits.resolve_impl calls in at TRACE time, where
 # the selection is a static, deliberately-baked-in decision).
 _CACHE: dict[str, dict] = {}
+# How the cached entries were obtained: "ok" | "missing" (no file — the
+# normal cold state, not a pathology) | "corrupt" (unparseable JSON) |
+# "alien" (parsed, but not a selection table) | "unreadable" (I/O error).
+# Everything except ok/missing is a SILENT-FALLBACK hazard the dispatcher
+# surfaces as an `autotune_fallback` telemetry record (emit_fallback).
+_STATUS: dict[str, str] = {}
+# (table, key, reason) triples already reported — the lookup fires once per
+# circuit trace, and one structured record per distinct pathology is signal
+# where one per trace would be noise.
+_FALLBACK_EMITTED: set[tuple] = set()
 # Process-wide active table location, installed by prewarm() from
 # quantum.autotune_table. The trace-time lookup has no config in scope (it
 # fires deep inside model.apply), so a configured custom path must become
@@ -53,12 +64,96 @@ _CACHE: dict[str, dict] = {}
 # on the dense fallback.
 _ACTIVE_PATH: str | None = None
 
-# Winners a table entry may name: concrete, single-host-dispatchable impls
-# only. "auto" would recurse through the resolver; "sharded" needs a
-# multi-device mesh the tuner deliberately never assumes (eligible_impls).
+# Winners a table entry may name: concrete impls only — "auto" would recurse
+# through the resolver. "sharded_statevector" is dispatchable but carries a
+# topology precondition (>= 2 devices on the model axis); lookup() re-checks
+# it at READ time so a table written on an 8-device mesh degrades to the
+# heuristic on a 1-device process instead of dispatching a collective program
+# with nobody to exchange with.
 _DISPATCHABLE = frozenset(
-    {"dense", "dense_fused", "pallas", "pallas_circuit", "pallas_tensor", "tensor"}
+    {
+        "dense",
+        "dense_fused",
+        "pallas",
+        "pallas_circuit",
+        "pallas_tensor",
+        "tensor",
+        "mps",
+        "sharded",
+        "sharded_statevector",
+    }
 )
+
+# Windows past which a full-statevector formulation stops being a sane
+# candidate: the dense 2^n x 2^n unitary build caps at DENSE_MAX_QUBITS, the
+# gate-wise tensor path at TENSOR_MAX_QUBITS (2^n amplitudes per sample
+# still), and past that only the compressed (mps) / partitioned
+# (sharded_statevector) states remain (docs/QUANTUM.md eligibility matrix).
+DENSE_MAX_QUBITS = 12
+TENSOR_MAX_QUBITS = 14
+SHARDED_MIN_QUBITS = 10
+MPS_MIN_QUBITS = 13
+
+
+class ImplIneligibleError(ValueError):
+    """A pinned circuit impl cannot run at this qubit count / topology.
+
+    Raised where a configuration or checkpoint FORCES an impl (rather than
+    letting the dispatcher choose) that :func:`impl_eligible` rejects — e.g.
+    ``sharded_statevector`` restored on a single-device process, or ``dense``
+    pinned at n > 12. Typed so restore/startup paths can fail with the
+    eligibility reason instead of a KeyError (or a collective program with
+    nobody to exchange with) deep in dispatch."""
+
+
+def model_axis_devices() -> int:
+    """Devices the default model mesh would span: the largest power of two
+    <= the local device count (mirrors ``sharded._default_model_mesh``).
+    1 on a single-device process — i.e. "no sharded candidate"."""
+    import jax
+
+    n = jax.device_count()
+    k = 1
+    while k * 2 <= n:
+        k *= 2
+    return k
+
+
+def impl_eligible(
+    impl: str, n_qubits: int, devices_on_model: int | None = None
+) -> tuple[bool, str | None]:
+    """Hard runnability of ``impl`` at this qubit count/topology: ``(ok,
+    reason_when_not)``. This is the CAPACITY check (can this impl execute at
+    all without an absurd footprint or a missing mesh), not the latency race
+    — ``eligible_impls`` layers the worth-timing windows on top. Used by the
+    checkpoint reconcile to turn "restored a sharded_statevector pin on one
+    device" into a typed error instead of a downstream crash."""
+    from qdml_tpu.quantum.circuits import canonical_impl
+
+    impl = canonical_impl(impl)  # unknown names raise ValueError here
+    if impl in ("dense", "dense_fused", "pallas") and n_qubits > DENSE_MAX_QUBITS:
+        return False, (
+            f"the dense (2^n x 2^n) unitary build is capped at n <= "
+            f"{DENSE_MAX_QUBITS}; n={n_qubits}"
+        )
+    if impl == "pallas_circuit" and n_qubits > DENSE_MAX_QUBITS:
+        return False, (
+            f"the VMEM-resident kernel window ends at n <= {DENSE_MAX_QUBITS}; "
+            f"n={n_qubits}"
+        )
+    if impl == "tensor" and n_qubits > TENSOR_MAX_QUBITS:
+        return False, (
+            f"the full 2^n statevector per sample is capped at n <= "
+            f"{TENSOR_MAX_QUBITS}; n={n_qubits} needs mps or sharded_statevector"
+        )
+    if impl == "sharded_statevector":
+        devs = model_axis_devices() if devices_on_model is None else devices_on_model
+        if devs < 2:
+            return False, (
+                "sharded_statevector partitions the amplitudes over the mesh's "
+                f"model axis and needs >= 2 devices; this topology has {devs}"
+            )
+    return True, None
 
 
 def set_table_path(path: str | None) -> None:
@@ -91,10 +186,21 @@ def table_key(
     return f"{platform}/n{n_qubits}/L{n_layers}/b{bucket}/{dtype}"
 
 
-def eligible_impls(n_qubits: int, platform: str) -> list[str]:
-    """Implementations worth timing at this qubit count/platform.
+def eligible_impls(
+    n_qubits: int, platform: str, devices_on_model: int | None = None
+) -> list[str]:
+    """Implementations worth timing at this qubit count/platform/topology.
 
-    - ``dense``: always (the safe fallback is always a candidate);
+    ``platform`` keys the caller's table entries but deliberately does NOT
+    filter the pallas kernels here: off-TPU they run in interpret mode, and
+    the equivalence/dispatch tests race them there on purpose. Callers with
+    a timing budget to protect (the qubit-scaling sweep) exclude them at
+    their own layer with a recorded per-point ``excluded`` reason —
+    exclusion is an artifact policy, not an eligibility fact.
+
+    - ``dense``: n <= 12 — the 2^n x 2^n unitary build is the wall past
+      that (it used to be "always"; the scaling subsystem made the cap
+      explicit so every n > 12 candidate set is non-dense by construction);
     - ``dense_fused`` (gate-matrix-cached / layer-fused unitary build,
       ``circuits.fused_ansatz_unitary``): wherever dense is — it races the
       unfused twin so the table PROVES where the fused build wins instead of
@@ -104,20 +210,31 @@ def eligible_impls(n_qubits: int, platform: str) -> list[str]:
     - ``pallas_circuit`` (VMEM-resident multi-layer kernel): 128 <= dim <=
       4096 — below one lane tile it falls back to the XLA twin anyway, so
       timing it would just re-measure dense math;
-    - ``tensor``: n >= 9, where the dense 2^n x 2^n unitary build starts to
-      dominate (at small n it has never been competitive on any backend);
-    - ``sharded`` is excluded: it needs a multi-device mesh the tuner cannot
-      assume (and its win condition — n >= 14 — is a capacity decision, not
-      a latency race). Select it explicitly via ``quantum.impl=sharded``.
+    - ``tensor``: 9 <= n <= 14 — where the dense unitary build dominates but
+      a full per-sample statevector still fits;
+    - ``mps`` (bond-chi tensor network): n >= 13 — it races ``tensor`` over
+      the 13-14 crossover window and is the ONLY single-device candidate
+      past n = 14, where every full-statevector formulation is out;
+    - ``sharded_statevector``: n >= 10 AND ``devices_on_model`` >= 2 — the
+      amplitude-partitioned statevector only exists on a multi-device mesh,
+      so the tuner includes it exactly when the caller proves the topology
+      (pass :func:`model_axis_devices`; ``None`` keeps the topology-blind
+      behavior and excludes it).
     """
     dim = 1 << n_qubits
-    impls = ["dense", "dense_fused"]
+    impls = []
+    if n_qubits <= DENSE_MAX_QUBITS:
+        impls += ["dense", "dense_fused"]
     if dim <= 256:
         impls.append("pallas")
     if 128 <= dim <= 4096:
         impls.append("pallas_circuit")
-    if n_qubits >= 9:
+    if 9 <= n_qubits <= TENSOR_MAX_QUBITS:
         impls.append("tensor")
+    if n_qubits >= MPS_MIN_QUBITS:
+        impls.append("mps")
+    if devices_on_model is not None and devices_on_model >= 2 and n_qubits >= SHARDED_MIN_QUBITS:
+        impls.append("sharded_statevector")
     return impls
 
 
@@ -144,20 +261,40 @@ def autotune_enabled(setting: str, platform: str | None = None) -> bool:
 
 def load_table(path: str | None = None) -> dict:
     """entries dict for the table at ``path``; {} on missing/corrupt/alien
-    files — a broken table must degrade to the dense fallback, not raise."""
+    files — a broken table must degrade to the dense fallback, not raise.
+    WHY it degraded is remembered per path (:func:`table_status`) so the
+    dispatcher can tell a normal cold start from a pathology worth a
+    structured ``autotune_fallback`` record."""
     p = table_path(path)
     if p in _CACHE:
         return _CACHE[p]
     entries: dict = {}
+    status = "ok"
     try:
         with open(p) as fh:
             data = json.load(fh)
         if isinstance(data, dict) and isinstance(data.get("entries"), dict):
             entries = data["entries"]
-    except (OSError, json.JSONDecodeError, ValueError, TypeError):
-        entries = {}
+        else:
+            status = "alien"
+    except FileNotFoundError:
+        status = "missing"
+    except json.JSONDecodeError:
+        status = "corrupt"
+    except OSError:
+        status = "unreadable"
+    except (ValueError, TypeError):
+        status = "corrupt"
     _CACHE[p] = entries
+    _STATUS[p] = status
     return entries
+
+
+def table_status(path: str | None = None) -> str:
+    """How the table at ``path`` loaded: "ok" / "missing" / "corrupt" /
+    "alien" / "unreadable" (loads + caches on first ask)."""
+    load_table(path)
+    return _STATUS.get(table_path(path), "ok")
 
 
 def save_table(entries: dict, path: str | None = None) -> str:
@@ -182,6 +319,7 @@ def save_table(entries: dict, path: str | None = None) -> str:
     except OSError:
         pass
     _CACHE[p] = entries
+    _STATUS[p] = "ok"
     return p
 
 
@@ -189,6 +327,8 @@ def invalidate_cache() -> None:
     """Drop the in-process table cache AND the installed table-path override
     (tests, or after an external edit)."""
     _CACHE.clear()
+    _STATUS.clear()
+    _FALLBACK_EMITTED.clear()
     set_table_path(None)
 
 
@@ -223,10 +363,14 @@ def measure(
     impls: Sequence[str] | None = None,
     budget_s: float = 0.25,
     max_reps: int = 30,
+    mps_chi: int | None = None,
 ) -> dict[str, dict[str, Any]]:
     """Time forward and forward+backward for each candidate at this exact
     shape. A candidate that fails to compile/run is recorded with its error
-    and excluded from selection — one broken kernel must not kill tuning."""
+    and excluded from selection — one broken kernel must not kill tuning.
+    ``mps_chi`` parameterizes the ``mps`` candidate (the timing — and the
+    numerics it buys — is chi-dependent; the entry records which chi was
+    raced)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -244,7 +388,9 @@ def measure(
         rec: dict[str, Any] = {}
         try:
             fwd = jax.jit(
-                lambda a, w, b=impl: run_circuit(a, w, n_qubits, n_layers, backend=b)
+                lambda a, w, b=impl: run_circuit(
+                    a, w, n_qubits, n_layers, backend=b, mps_chi=mps_chi
+                )
             )
             rec["fwd_ms"] = round(_time_callable(fwd, (angles, weights), budget_s, max_reps), 4)
             # train metric = ONE value_and_grad (what a train step actually
@@ -254,7 +400,10 @@ def measure(
             step = jax.jit(
                 jax.value_and_grad(
                     lambda w, a, b=impl: jnp.sum(
-                        run_circuit(a, w, n_qubits, n_layers, backend=b) ** 2
+                        run_circuit(
+                            a, w, n_qubits, n_layers, backend=b, mps_chi=mps_chi
+                        )
+                        ** 2
                     )
                 )
             )
@@ -280,11 +429,16 @@ def ensure(
     path: str | None = None,
     force: bool = False,
     budget_s: float = 0.25,
+    impls: Sequence[str] | None = None,
+    mps_chi: int | None = None,
 ) -> dict:
     """Return this shape's table entry, micro-benchmarking and persisting it
     first if absent (or ``force``). Host-side and eager — call it where
     compiles are already expected (train-loop startup, serve warmup, bench),
-    NEVER from a traced function or the serve request path."""
+    NEVER from a traced function or the serve request path. ``impls``
+    overrides the candidate set (the qubit-scaling sweep uses it to bound
+    per-point compile budgets); the default is this topology's
+    :func:`eligible_impls`."""
     import jax
 
     platform = jax.default_backend()
@@ -294,7 +448,11 @@ def ensure(
     entry = entries.get(key)
     if not force and isinstance(entry, dict) and entry.get("best_train"):
         return entry
-    cands = measure(n_qubits, n_layers, bucket, budget_s=budget_s)
+    if impls is None:
+        impls = eligible_impls(n_qubits, platform, model_axis_devices())
+    cands = measure(
+        n_qubits, n_layers, bucket, impls=impls, budget_s=budget_s, mps_chi=mps_chi
+    )
     entry = {
         "key": key,
         "platform": platform,
@@ -307,9 +465,59 @@ def ensure(
         "best_train": _pick(cands, "train_ms"),
         "ts": round(time.time(), 3),
     }
+    if "mps" in cands:
+        from qdml_tpu.quantum.mps import DEFAULT_CHI
+
+        entry["mps_chi"] = int(mps_chi or DEFAULT_CHI)
     entries[key] = entry
     save_table(entries, path)
     return entry
+
+
+def lookup_reason(
+    n_qubits: int,
+    n_layers: int,
+    batch: int,
+    dtype: str = "float32",
+    mode: str = "train",
+    path: str | None = None,
+) -> tuple[str | None, str | None]:
+    """``(selection, fallback_reason)`` for this shape.
+
+    ``selection`` is the tuned impl or ``None`` (caller falls back to the
+    static heuristic). ``fallback_reason`` is ``None`` for the NORMAL misses
+    (no table yet, shape not tuned) and a short slug for the pathologies a
+    run artifact should show: ``table-corrupt`` / ``table-alien`` /
+    ``table-unreadable`` (the file exists but is not a usable table),
+    ``entry-alien`` (the entry's winner names an impl this build cannot
+    dispatch), ``entry-ineligible`` (the winner cannot run on this topology,
+    e.g. a sharded_statevector selection read on one device). Never raises,
+    never benchmarks, never touches the table file beyond one cached read —
+    safe at trace time."""
+    try:
+        import jax
+
+        platform = jax.default_backend()
+        entries = load_table(path)
+        status = table_status(path)
+        reason = f"table-{status}" if status in ("corrupt", "alien", "unreadable") else None
+        entry = entries.get(
+            table_key(platform, n_qubits, n_layers, batch_bucket(batch), dtype)
+        )
+        if not isinstance(entry, dict):
+            return None, reason
+        sel = entry.get("best_fwd" if mode == "infer" else "best_train")
+        if not isinstance(sel, str) or sel not in _DISPATCHABLE:
+            return None, "entry-alien" if sel is not None else reason
+        from qdml_tpu.quantum.circuits import canonical_impl
+
+        sel = canonical_impl(sel)
+        ok, _why = impl_eligible(sel, n_qubits)
+        if not ok:
+            return None, "entry-ineligible"
+        return sel, None
+    except Exception:  # lint: disable=broad-except(dispatch lookup must degrade to the dense fallback on ANY table pathology — a tuner can speed dispatch up, never crash it)
+        return None, None
 
 
 def lookup(
@@ -321,23 +529,48 @@ def lookup(
     path: str | None = None,
 ) -> str | None:
     """The tuned implementation for this shape, or ``None`` when the table
-    has nothing trustworthy (caller falls back to the static heuristic /
-    dense). Never raises, never benchmarks, never touches the table file
-    beyond one cached read — safe at trace time."""
+    has nothing trustworthy (back-compat view of :func:`lookup_reason`)."""
+    return lookup_reason(n_qubits, n_layers, batch, dtype, mode, path)[0]
+
+
+def emit_fallback(
+    reason: str,
+    n_qubits: int,
+    n_layers: int,
+    batch: int,
+    mode: str,
+    fallback: str,
+) -> dict | None:
+    """One structured ``autotune_fallback`` record into the active telemetry
+    sink (``qdml_tpu.telemetry.get_sink``) for a PATHOLOGICAL dispatch
+    fallback — corrupt/alien table, undispatchable entry. De-duplicated per
+    (table, shape-key, reason): the lookup fires on every circuit trace and
+    a record per trace would bury the signal. Returns the record (even with
+    no sink attached — callers/tests can assert on it), ``None`` when this
+    pathology was already reported."""
     try:
         import jax
 
         platform = jax.default_backend()
-        entries = load_table(path)
-        entry = entries.get(
-            table_key(platform, n_qubits, n_layers, batch_bucket(batch), dtype)
-        )
-        if not isinstance(entry, dict):
-            return None
-        sel = entry.get("best_fwd" if mode == "infer" else "best_train")
-        return sel if isinstance(sel, str) and sel in _DISPATCHABLE else None
-    except Exception:  # lint: disable=broad-except(dispatch lookup must degrade to the dense fallback on ANY table pathology — a tuner can speed dispatch up, never crash it)
+    except Exception:  # lint: disable=broad-except(fallback reporting must never take down dispatch — a record with an unknown platform beats an exception on the hot path)
+        platform = "unknown"
+    p = table_path()
+    key = table_key(platform, n_qubits, n_layers, batch_bucket(batch))
+    tok = (p, key, reason)
+    if tok in _FALLBACK_EMITTED:
         return None
+    _FALLBACK_EMITTED.add(tok)
+    rec = {"reason": reason, "table": p, "key": key, "mode": mode, "fallback": fallback}
+    from qdml_tpu.telemetry import get_sink
+
+    sink = get_sink()
+    if sink is not None and getattr(sink, "active", False):
+        sink.emit("autotune_fallback", **rec)
+    else:
+        # no sink (bare script / library use): still one visible line —
+        # "silent" was the bug this record exists to kill
+        print(f"autotune_fallback: {reason} table={p} key={key} -> {fallback}")
+    return rec
 
 
 def prewarm(cfg, batch: int, force: bool = False) -> dict | None:
@@ -364,5 +597,10 @@ def prewarm(cfg, batch: int, force: bool = False) -> dict | None:
     if not autotune_enabled(q.autotune):
         return None
     return ensure(
-        q.n_qubits, q.n_layers, batch, path=q.autotune_table or None, force=force
+        q.n_qubits,
+        q.n_layers,
+        batch,
+        path=q.autotune_table or None,
+        force=force,
+        mps_chi=q.mps_chi,
     )

@@ -38,6 +38,13 @@ Execution paths:
   (:func:`qdml_tpu.quantum.pallas_kernels.fused_circuit_expvals`). Scales
   past the dense unitary build (n ~ 7-12). ``pallas_tensor`` is the
   deprecated pre-v2 alias.
+- ``sharded_statevector``: the 2^n amplitudes partitioned over the mesh's
+  ``model`` axis inside one ``shard_map`` region; gates on sharded qubits
+  are ``ppermute`` partner exchanges, ``<Z>`` one ``psum``
+  (:mod:`qdml_tpu.quantum.sharded`; ``sharded`` is the deprecated alias).
+- ``mps``: bond-dimension-chi matrix-product-state simulation — O(n * chi^2)
+  state per sample instead of 2^n, exact at chi >= 2^(n/2), the capacity
+  impl past every statevector window (:mod:`qdml_tpu.quantum.mps`).
 
 All paths are pure jittable functions of ``(angles, weights)`` and
 differentiable by JAX AD; they agree to float32 precision (tested against an
@@ -58,11 +65,30 @@ VALID_BACKENDS = (
     "tensor",
     "dense",
     "dense_fused",
-    "sharded",
+    "sharded",  # deprecated alias for sharded_statevector (pre-scaling name)
+    "sharded_statevector",
+    "mps",
     "pallas",
     "pallas_circuit",
     "pallas_tensor",  # deprecated alias for pallas_circuit (pre-v2 name)
 )
+
+# Deprecated impl names -> their canonical spelling. Aliases stay accepted
+# everywhere (configs, checkpoints, autotune tables) but every resolution
+# funnels through here so the rest of the engine sees ONE name per impl.
+_IMPL_ALIASES = {"pallas_tensor": "pallas_circuit", "sharded": "sharded_statevector"}
+
+
+def canonical_impl(name: str) -> str:
+    """Normalize an impl/backend name to its canonical spelling.
+
+    Raises ``ValueError`` on names outside :data:`VALID_BACKENDS` — the one
+    choke point where a config/checkpoint/table naming an impl this build
+    does not know produces a diagnosable error instead of a downstream
+    ``KeyError`` deep in dispatch."""
+    if name not in VALID_BACKENDS:
+        raise ValueError(f"unknown circuit impl {name!r}; want one of {VALID_BACKENDS}")
+    return _IMPL_ALIASES.get(name, name)
 
 
 def rot_gate(w_ry: jnp.ndarray, w_rz: jnp.ndarray) -> CArr:
@@ -198,10 +224,12 @@ def resolve_backend(backend: str, n_qubits: int) -> str:
     """Resolve ``auto`` to a concrete execution path WITHOUT measurements.
 
     This is the static fallback: the dense per-ansatz unitary (MXU matmuls)
-    up to ~10 qubits, the gate-wise tensor path past that (its 2^n x 2^n
-    unitary build dominates); from ~14 qubits the statevector should be
-    mesh-sharded instead (select "sharded" explicitly — it needs a
-    multi-device mesh this helper cannot assume).
+    up to ~10 qubits, the gate-wise tensor path to ~14 (its 2^n x 2^n
+    unitary build dominates dense there), and the bond-chi MPS simulator
+    past that — the full statevector itself is the wall at n > ~14, and the
+    MPS impl is the one candidate that runs anywhere (the mesh-sharded
+    statevector needs a multi-device mesh this helper cannot assume; the
+    autotuner offers it where the topology allows, docs/QUANTUM.md).
 
     The kernel-vs-XLA choice is deliberately NOT made here anymore. The old
     static TPU promotion of the whole-circuit Pallas kernel rested on one
@@ -215,7 +243,9 @@ def resolve_backend(backend: str, n_qubits: int) -> str:
     """
     if backend != "auto":
         return backend
-    return "dense" if n_qubits <= 10 else "tensor"
+    if n_qubits <= 10:
+        return "dense"
+    return "tensor" if n_qubits <= 14 else "mps"
 
 
 def resolve_impl(
@@ -234,16 +264,25 @@ def resolve_impl(
     batch-bucket, mode)``; then :func:`resolve_backend`'s static heuristic.
     A missing/corrupt/unpopulated table degrades to the heuristic (which
     bottoms out at XLA dense in the small-n regime) — never an exception and
-    never an unmeasured kernel promotion.
+    never an unmeasured kernel promotion. A fallback caused by a table
+    PATHOLOGY (corrupt/alien file, entry naming an impl this build cannot
+    dispatch) is no longer invisible: it emits one structured
+    ``autotune_fallback`` telemetry record per (table, shape, reason) into
+    the active sink, so run JSONLs show WHY the heuristic ran.
     """
     if impl not in ("", "auto"):
-        return "pallas_circuit" if impl == "pallas_tensor" else impl
+        return canonical_impl(impl)
     if backend != "auto":
-        return "pallas_circuit" if backend == "pallas_tensor" else backend
+        return canonical_impl(backend)
     from qdml_tpu.quantum import autotune
 
-    sel = autotune.lookup(n_qubits, n_layers, batch, mode=mode)
-    return sel if sel is not None else resolve_backend("auto", n_qubits)
+    sel, reason = autotune.lookup_reason(n_qubits, n_layers, batch, mode=mode)
+    if sel is not None:
+        return sel
+    fallback = resolve_backend("auto", n_qubits)
+    if reason is not None:
+        autotune.emit_fallback(reason, n_qubits, n_layers, batch, mode, fallback)
+    return fallback
 
 
 def run_circuit(
@@ -254,6 +293,7 @@ def run_circuit(
     backend: str = "dense",
     impl: str = "auto",
     mode: str = "train",
+    mps_chi: int | None = None,
 ) -> jnp.ndarray:
     """Full reference circuit: angles (..., n) -> per-wire <Z> (..., n).
 
@@ -262,7 +302,9 @@ def run_circuit(
     table picks the implementation for this exact shape (``mode`` selects
     the forward-only vs forward+backward winner). Shapes are static under
     jit, so the lookup is a trace-time decision baked into the compiled
-    program — exactly once per (shape, impl) compilation.
+    program — exactly once per (shape, impl) compilation. ``mps_chi``
+    (``quantum.mps_chi``) only matters when the ``mps`` impl runs: the bond
+    dimension of its truncated tensor-network state.
     """
     import numpy as _np
 
@@ -294,10 +336,19 @@ def run_circuit(
 
         u = ansatz_unitary(weights, n_qubits, n_layers)
         return fused_qsc_expvals(angles, u, n_qubits)
-    if backend == "sharded":
+    if backend == "sharded_statevector":
         from qdml_tpu.quantum.sharded import run_circuit_sharded
 
         return run_circuit_sharded(angles, weights, n_qubits, n_layers)
+    if backend == "mps":
+        # Bond-chi MPS simulation (quantum/mps.py): the capacity impl past
+        # the dense/pallas windows — O(n * chi^2) state per sample instead
+        # of 2^n amplitudes, exact when chi >= 2^(n/2).
+        from qdml_tpu.quantum.mps import DEFAULT_CHI, mps_circuit
+
+        return mps_circuit(
+            angles, weights, n_qubits, n_layers, chi=mps_chi or DEFAULT_CHI
+        )
     if backend in ("pallas_circuit", "pallas_tensor"):
         # Whole-circuit VMEM-resident kernel: in-kernel embedding + L-layer
         # rotation/entangler chain in ONE pallas_call per batch tile, adjoint

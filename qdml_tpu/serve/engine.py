@@ -97,6 +97,7 @@ class ServeEngine:
                 n_classes=cfg.quantum.n_classes,
                 backend=cfg.quantum.backend,
                 impl=cfg.quantum.impl,
+                mps_chi=cfg.quantum.mps_chi,
                 input_norm=cfg.quantum.input_norm,
             )
         else:
@@ -416,6 +417,8 @@ class ServeEngine:
                             q.impl, q.backend, q.n_qubits, q.n_layers, b, mode="infer"
                         )
                     }
+                    if rec_impl["impl"] == "mps":
+                        rec_impl["mps_chi"] = int(q.mps_chi)
                     if entry is not None:
                         rec_impl["autotuned"] = True
                         rec_impl["candidates"] = entry["candidates"]

@@ -34,21 +34,69 @@ def reconcile_quantum_cfg(cfg, meta: dict):
     with an opaque error. ``backend`` is different: it is a numerically
     equivalent execution strategy, not an architecture fact, so the eval
     config (and any explicit CLI override) wins — a checkpoint trained with
-    ``backend='sharded'`` must remain evaluable on a single host. Every
-    qsc-checkpoint consumer should pass its restored meta through here.
-    No-op when the checkpoint predates the meta (or came from a source that
-    has none)."""
+    ``backend='sharded'`` must remain evaluable on a single host — the
+    dispatcher re-resolves for the eval topology. The exception is an
+    EXPLICIT eval-config pin (``quantum.impl`` / legacy ``quantum.backend``
+    not "auto") that cannot run at the checkpoint's qubit count on this
+    topology: that raises a typed
+    :class:`~qdml_tpu.quantum.autotune.ImplIneligibleError` naming the
+    eligibility reason (e.g. ``sharded_statevector`` pinned and restored on
+    one device) instead of a partnerless-collective hang or shape error deep
+    in the first forward. Every qsc-checkpoint consumer should pass its
+    restored meta through here. No-op when the checkpoint predates the meta
+    (or came from a source that has none)."""
     import dataclasses
 
     stored = (meta or {}).get("quantum")
     if not stored:
         return cfg
+    from qdml_tpu.quantum.autotune import ImplIneligibleError, impl_eligible
+    from qdml_tpu.quantum.circuits import canonical_impl
+
     stored = dict(stored)
     trained_backend = stored.pop("backend", None)
     # like backend, the dispatcher override is an execution strategy, not an
-    # architecture fact — provenance only, never folded into the eval config
-    stored.pop("impl", None)
+    # architecture fact — provenance only, never folded into the eval config.
+    # It still goes through the canonical choke point: a checkpoint naming an
+    # impl this build does not know (or a deprecated alias) must produce a
+    # diagnosable ValueError here, not a KeyError downstream.
+    trained_impl = stored.pop("impl", None)
+    if trained_impl not in (None, "", "auto"):
+        trained_impl = canonical_impl(trained_impl)
+    # chi is an mps execution knob (numerics-relevant but param-free) — the
+    # eval config's value wins, same rule as backend/impl
+    stored.pop("mps_chi", None)
     n_q = stored.get("n_qubits", cfg.quantum.n_qubits)
+    # The impl that will actually dispatch at eval is the config's explicit
+    # pin (impl > legacy backend; "auto" lets the dispatcher re-resolve for
+    # THIS topology and never needs a check). A pin that cannot run here —
+    # the checkpoint-and-config pair pinning sharded_statevector restored on
+    # a 1-device host, or dense at a 16-qubit checkpoint's n — fails NOW,
+    # typed and with the eligibility reason, instead of as a shape error or
+    # a partnerless collective deep in the restored model's first forward.
+    pinned = (
+        cfg.quantum.impl
+        if cfg.quantum.impl not in ("", "auto")
+        else (cfg.quantum.backend if cfg.quantum.backend != "auto" else None)
+    )
+    if pinned is not None:
+        pinned = canonical_impl(pinned)
+        ok, why = impl_eligible(pinned, n_q)
+        if not ok:
+            raise ImplIneligibleError(
+                f"checkpoint (n_qubits={n_q}) pins circuit impl {pinned!r}, "
+                f"which cannot run on this topology: {why}"
+            )
+    elif trained_impl not in (None, "", "auto"):
+        ok, why = impl_eligible(trained_impl, n_q)
+        if not ok:
+            # provenance-only pin that no longer runs here: the dispatcher
+            # will re-resolve, but say so — silent was the bug class
+            print(
+                f"note: checkpoint was trained with circuit impl "
+                f"{trained_impl!r}, ineligible on this topology ({why}); "
+                "the dispatcher re-resolves for this host"
+            )
     if trained_backend is not None:
         # Compare RESOLVED execution paths: with "auto" in play, the stored
         # and configured strings can differ while naming the identical path

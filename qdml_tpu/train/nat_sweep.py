@@ -57,6 +57,7 @@ def build_sweep_model(cfg: ExperimentConfig) -> QSCP128:
         use_quantumnat=False,
         backend=cfg.quantum.backend,
         impl=cfg.quantum.impl,
+        mps_chi=cfg.quantum.mps_chi,
         input_norm=cfg.quantum.input_norm,
     )
 

@@ -49,6 +49,7 @@ def build_classifier(cfg: ExperimentConfig, quantum: bool) -> nn.Module:
             noise_level=cfg.quantum.noise_level,
             backend=cfg.quantum.backend,
             impl=cfg.quantum.impl,
+            mps_chi=cfg.quantum.mps_chi,
             input_norm=cfg.quantum.input_norm,
         )
     return SCP128(n_classes=cfg.quantum.n_classes)
@@ -338,6 +339,9 @@ def train_classifier(
                     # dispatcher provenance (execution strategy, reconcile
                     # pops it like backend): "auto" = autotuned per shape
                     "impl": cfg.quantum.impl,
+                    # mps execution knob (numerics-relevant, param-free);
+                    # provenance only, the eval config's chi wins
+                    "mps_chi": cfg.quantum.mps_chi,
                     "input_norm": cfg.quantum.input_norm,
                 }
                 # provenance, not architecture (reconcile ignores it): which

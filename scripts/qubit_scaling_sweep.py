@@ -1,0 +1,109 @@
+"""The n=4..24 qubit-scaling sweep on the virtual-device harness (ISSUE 8).
+
+The scaling twin of the serve-fleet dryrun: force an 8-virtual-device CPU
+backend (``utils.platform.force_cpu`` — the XLA_FLAGS device-count pattern),
+run ``bench.py``'s ``qsc_scaling`` child over the full grid (the autotuner
+races every impl eligible at each (n, topology) and the dispatcher's winner
+is timed + costed per point), and round-trip the artifact through the
+``qdml-tpu report`` gate. Writes ``results/qubit_scaling/``:
+
+- ``qubit_scaling.jsonl`` — manifest-headed telemetry: the ``qsc_scaling``
+  record (per-n winner, candidates, mps_chi, steps/s, XLA cost, roofline,
+  numerics agreement vs an independent formulation);
+- ``autotune_table.json`` — the selection table the sweep wrote: the
+  committed PROOF of which impl the dispatcher picks per n on this harness;
+- ``report_scaling.md`` — the rendered report (per-n best-of-impls gate rows
+  + the qubit-scaling crossover section);
+- ``QUBIT_SCALING.json`` — the headline (n -> impl/sps map, the n>12
+  non-dense check, the report exit code).
+
+Run: ``python scripts/qubit_scaling_sweep.py [--devices=8] [--budget=2.0]``
+(~30 min on a CPU host: the n>=14 points compile grad programs with dozens
+of SVDs / hundreds of collectives). Virtual-device timings measure XLA:CPU
+execution, not ICI scaling — the artifact is the wiring-and-dispatch proof
+(every n>12 point served by a non-dense impl, table -> record -> report gate
+round-trip), the TPU re-run is the hardware headline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+
+def main(argv: list[str]) -> int:
+    devices = int(
+        next((a.split("=", 1)[1] for a in argv if a.startswith("--devices=")), 8)
+    )
+    budget = next((a.split("=", 1)[1] for a in argv if a.startswith("--budget=")), None)
+    force_cpu(devices)
+    if budget is not None:
+        os.environ["QDML_SCALING_BUDGET_S"] = budget
+
+    import bench
+
+    out_dir = os.path.join("results", "qubit_scaling")
+    os.makedirs(out_dir, exist_ok=True)
+    table = os.path.join(out_dir, "autotune_table.json")
+    jsonl = os.path.join(out_dir, "qubit_scaling.jsonl")
+    if os.path.exists(table):
+        os.remove(table)  # the committed table must be THIS run's selections
+    os.environ["QDML_SCALING_TABLE"] = table
+
+    rc = bench.run_scaling_child(out_path=jsonl)
+    if rc != 0:
+        print(f"scaling child failed rc={rc}", file=sys.stderr)
+        return rc
+
+    with open(jsonl) as fh:
+        record = [json.loads(ln) for ln in fh if ln.strip()][-1]
+    points = record["details"]["qsc_scaling"]["points"]
+
+    # the artifact must round-trip the regression gate: self-vs-self is the
+    # committed wiring proof (exit 0); later runs gate against THIS file
+    from qdml_tpu.telemetry.report import report_main
+
+    report_rc = report_main(
+        [
+            f"--current={jsonl}",
+            f"--baseline={jsonl}",
+            f"--out={os.path.join(out_dir, 'report_scaling.md')}",
+        ]
+    )
+
+    non_dense_ok = all(
+        p.get("quantum_impl") not in (None, "dense", "dense_fused")
+        for p in points
+        if p.get("n_qubits", 0) > 12
+    )
+    headline = {
+        "devices": devices,
+        "impl_per_n": {
+            str(p["n_qubits"]): {
+                "impl": p.get("quantum_impl"),
+                "mps_chi": p.get("mps_chi"),
+                "samples_per_sec": p.get("samples_per_sec"),
+                "train_ms": p.get("train_ms"),
+                "agreement": p.get("agreement"),
+                "error": p.get("error"),
+            }
+            for p in points
+        },
+        "non_dense_past_12": non_dense_ok,
+        "report_exit": report_rc,
+        "table": table,
+    }
+    with open(os.path.join(out_dir, "QUBIT_SCALING.json"), "w") as fh:
+        json.dump(headline, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(headline, indent=2))
+    return 0 if (report_rc == 0 and non_dense_ok) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

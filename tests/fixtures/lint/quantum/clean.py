@@ -1,0 +1,26 @@
+"""Lint fixture: collective-outside-shardmap NEGATIVES (no findings).
+
+Every named-axis call is reachable from a function handed to ``shard_map``
+(directly or through ``functools.partial``) — including transitively through
+same-module helpers, the shape ``quantum/sharded.py`` actually uses.
+"""
+
+from functools import partial
+
+import jax
+
+
+def _exchange(x):
+    return jax.lax.ppermute(x, "model", [(0, 1)])
+
+
+def _local(x):
+    y = _exchange(x)  # transitive: still inside the region's closure
+    return jax.lax.psum(y + jax.lax.axis_index("model"), "model")
+
+
+def run(x, mesh):
+    from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(partial(_local), mesh=mesh, in_specs=None, out_specs=None)
+    return fn(x)

@@ -1,0 +1,33 @@
+"""Lint fixture: collective-outside-shardmap POSITIVES.
+
+Lives under a ``quantum/`` path segment on purpose — the rule only scans the
+mesh-sharded quantum subsystem. Each stray named-axis call below is the
+multihost-deadlock shape the rule exists to catch: an axis name used where
+no ``shard_map`` region binds it.
+"""
+
+from functools import partial
+
+import jax
+
+
+def _inside(x):
+    # fine: reached from the shard_map region seeded in run()
+    return jax.lax.psum(x, "model")
+
+
+def run(x, mesh):
+    from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(partial(_inside), mesh=mesh, in_specs=None, out_specs=None)
+    return fn(x)
+
+
+def stray_exchange(x):
+    # collective-outside-shardmap: ppermute with no region binding "model"
+    return jax.lax.ppermute(x, "model", [(0, 1)])
+
+
+def stray_axis_query():
+    # collective-outside-shardmap: axis_index outside every region
+    return jax.lax.axis_index("model")

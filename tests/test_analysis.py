@@ -64,6 +64,32 @@ def test_clean_fixture_is_silent():
     assert findings == [], _rules_found(findings)
 
 
+def test_collective_outside_shardmap_fixtures():
+    """The scaling subsystem's deadlock-shape rule: stray named-axis calls in
+    quantum/ are findings; everything reachable from a shard_map region
+    (directly or transitively through same-module helpers) is clean; paths
+    outside quantum/ are out of scope; and the real sharded subsystem passes
+    its own rule."""
+    from qdml_tpu.analysis.rules import rule_collective_outside_shardmap
+
+    engine = LintEngine(REPO)
+    findings, err = engine.lint_file(f"{FIXDIR}/quantum/violations.py")
+    assert err is None
+    assert _rules_found(findings) == {"collective-outside-shardmap": 2}
+    assert {f.line for f in findings} == {28, 33}
+    findings, err = engine.lint_file(f"{FIXDIR}/quantum/clean.py")
+    assert err is None
+    assert findings == [], _rules_found(findings)
+    # scope: the identical source under a non-quantum path never fires
+    with open(f"{FIXDIR}/quantum/violations.py") as fh:
+        src = fh.read()
+    assert rule_collective_outside_shardmap(_ctx(src, "qdml_tpu/serve/x.py")) == []
+    # the subsystem the rule protects is itself clean
+    findings, err = engine.lint_file("qdml_tpu/quantum/sharded.py")
+    assert err is None
+    assert not [f for f in findings if f.rule == "collective-outside-shardmap"]
+
+
 def test_lock_discipline_rule_uses_project_map():
     """The lock map keys on real repo paths, so the rule is exercised with an
     inline module presented under the mapped path."""

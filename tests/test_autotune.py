@@ -70,15 +70,35 @@ def test_missing_and_corrupt_table_fall_back_to_dense():
     autotune.invalidate_cache()
     assert autotune.lookup(6, 3, 256) is None
     assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"
-    # structurally alien payloads and garbage winners are rejected too —
-    # including winners that are valid BACKEND strings but not dispatchable
-    # selections ("auto" would recurse; "sharded" needs a mesh the tuner
-    # never assumes)
-    for bad in ("not-a-backend", "auto", "sharded"):
+    # structurally alien payloads and garbage winners are rejected too
+    # ("auto" would recurse through the resolver)
+    for bad in ("not-a-backend", "auto"):
         with open(path, "w") as fh:
             json.dump({"entries": {"cpu/n6/L3/b256/float32": {"best_train": bad}}}, fh)
         autotune.invalidate_cache()
         assert autotune.lookup(6, 3, 256) is None
+        assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"
+    # "sharded" is no longer garbage: the scaling subsystem made it a
+    # first-class selection, canonicalized and re-checked against THIS
+    # topology at read time — the 8-virtual-device harness dispatches it...
+    with open(path, "w") as fh:
+        json.dump(
+            {"entries": {"cpu/n6/L3/b256/float32": {"best_train": "sharded"}}}, fh
+        )
+    autotune.invalidate_cache()
+    assert autotune.lookup(6, 3, 256) == "sharded_statevector"
+    # ...and a single-device process degrades to the heuristic instead of
+    # dispatching a collective program with nobody to exchange with
+    autotune.invalidate_cache()
+    with open(path, "w") as fh:
+        json.dump(
+            {"entries": {"cpu/n6/L3/b256/float32": {"best_train": "sharded"}}}, fh
+        )
+    from unittest import mock
+
+    with mock.patch.object(autotune, "model_axis_devices", return_value=1):
+        sel, reason = autotune.lookup_reason(6, 3, 256)
+        assert sel is None and reason == "entry-ineligible"
         assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"
 
 
@@ -250,3 +270,88 @@ def test_serve_warmup_autotunes_with_zero_request_path_compiles():
         h, pred, bucket = engine.infer(x)
         assert h.shape[0] == 3 and bucket == 4
     assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+
+
+def test_serve_mps_impl_baked_into_aot_bucket_zero_compiles():
+    """A scaling impl pinned into the engine: warmup AOT-compiles the mps
+    circuit (chi from quantum.mps_chi, recorded per bucket) and the request
+    path still provably never compiles — the PR-5 pin survives the new
+    subsystem."""
+    from qdml_tpu.serve import ServeEngine
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        quantum=QuantumConfig(n_qubits=3, n_layers=1, impl="mps", mps_chi=4),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        serve=ServeConfig(max_batch=4, buckets=(4,), max_wait_ms=1.0, max_queue=32),
+    )
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, qsc_state = init_sc_state(cfg, quantum=True, steps_per_epoch=4)
+    engine = ServeEngine(cfg, hdce_vars, {"params": qsc_state.params}, quantum=True)
+    warm = engine.warmup()
+    assert warm["quantum_impl"]["4"]["impl"] == "mps"
+    assert warm["quantum_impl"]["4"]["mps_chi"] == 4
+    x = np.random.default_rng(0).standard_normal((3, *cfg.image_hw, 2)).astype(np.float32)
+    for _ in range(3):
+        h, pred, bucket = engine.infer(x)
+        assert h.shape[0] == 3 and bucket == 4
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+
+
+# ---------------------------------------------------------------------------
+# The autotune_fallback record: a table pathology is never silent
+# ---------------------------------------------------------------------------
+
+
+def test_fallback_record_emitted_once_per_pathology(tmp_path):
+    """A corrupt table degrades to the heuristic AND leaves one structured
+    autotune_fallback record in the active telemetry sink — deduplicated per
+    (table, shape, reason), so tracing the same circuit twice reports once."""
+    from qdml_tpu.telemetry.core import Telemetry
+    from qdml_tpu.telemetry.spans import set_sink
+
+    path = autotune.table_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("{definitely not json")
+    autotune.invalidate_cache()
+
+    jsonl = tmp_path / "run.jsonl"
+    sink = Telemetry(str(jsonl))
+    set_sink(sink)
+    try:
+        assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"
+        assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"  # dedup
+        # a DIFFERENT pathology at the same shape is its own record
+        with open(path, "w") as fh:
+            json.dump(
+                {"entries": {autotune.table_key("cpu", 6, 3, 256): {"best_train": "nope"}}},
+                fh,
+            )
+        # reload the table but keep the emitted-set (same process lifetime)
+        autotune._CACHE.clear()
+        autotune._STATUS.clear()
+        assert resolve_impl("auto", "auto", 6, 3, 256) == "dense"
+    finally:
+        set_sink(None)
+        sink.close()
+
+    recs = [json.loads(ln) for ln in jsonl.read_text().splitlines() if ln.strip()]
+    falls = [r for r in recs if r.get("kind") == "autotune_fallback"]
+    assert len(falls) == 2, falls
+    assert falls[0]["reason"] == "table-corrupt"
+    assert falls[1]["reason"] == "entry-alien"
+    for r in falls:
+        assert r["table"] == path and r["fallback"] == "dense"
+        assert r["key"].endswith("/n6/L3/b256/float32")
+
+
+def test_fallback_missing_table_is_not_a_pathology():
+    """The normal cold start (no table yet) must NOT emit a fallback record
+    — only corrupt/alien/undispatchable states are report-worthy."""
+    sel, reason = autotune.lookup_reason(6, 3, 256)
+    assert sel is None and reason is None

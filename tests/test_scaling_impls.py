@@ -1,0 +1,430 @@
+"""Scalable-simulation subsystem (breaking the 12-qubit wall): equivalence
+pins for the bond-chi MPS and mesh-sharded statevector impls vs dense at
+n <= 12 (values AND grads, f32/bf16, jit/vmap, QuantumNAT stream
+impl-invariant), the 8-virtual-device sharded pins, chi-truncation
+monotonicity, the n/topology eligibility windows with their typed
+ineligibility errors, checkpoint-meta reconcile of the new impl names, the
+qubit-scaling helpers, and the report's qsc_scaling section round-trip.
+
+The conftest harness forces 8 virtual CPU devices, so the sharded impl's
+shard_map program (k=3 global qubits, ppermute partner exchanges, one psum)
+runs exactly as it would on an 8-chip mesh slice.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qdml_tpu.quantum import autotune
+from qdml_tpu.quantum.circuits import canonical_impl, run_circuit
+
+
+def _rand_inputs(n, layers, batch, seed=0):
+    rng = np.random.default_rng(seed)
+    angles = jnp.asarray(rng.uniform(-2, 2, (batch, n)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(0, 2 * np.pi, (layers, n, 2)).astype(np.float32))
+    return angles, w
+
+
+def _full_chi(n):
+    # the chain's Schmidt rank can never exceed 2^(n//2): exact simulation
+    return 1 << (n // 2)
+
+
+# ---------------------------------------------------------------------------
+# MPS equivalence vs dense (n <= 12 window)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,layers", [(4, 2), (6, 3), (8, 2)])
+def test_mps_values_match_dense_at_full_chi(n, layers):
+    angles, w = _rand_inputs(n, layers, batch=4, seed=1)
+    dense = run_circuit(angles, w, n, layers, "dense")
+    mps = run_circuit(angles, w, n, layers, "mps", mps_chi=_full_chi(n))
+    np.testing.assert_allclose(np.asarray(mps), np.asarray(dense), atol=1e-5)
+
+
+def test_mps_grads_match_dense():
+    """AD through the truncated-SVD splits (the custom projector-gauge
+    backward) must reproduce the dense path's weight gradients at full chi."""
+    n, layers = 6, 2
+    angles, w = _rand_inputs(n, layers, batch=3, seed=2)
+
+    def loss(weights, backend, chi=None):
+        out = run_circuit(angles, weights, n, layers, backend, mps_chi=chi)
+        return jnp.sum(out**2)
+
+    g_dense = jax.grad(loss)(w, "dense")
+    g_mps = jax.grad(loss)(w, "mps", _full_chi(n))
+    np.testing.assert_allclose(np.asarray(g_mps), np.asarray(g_dense), atol=2e-4)
+
+
+def test_mps_bf16_inputs_track_dense():
+    """bf16 angle/weight inputs: the mps path computes complex64 internally
+    and returns f32; it must sit within bf16 resolution of the f32 dense
+    reference."""
+    n, layers = 6, 2
+    angles, w = _rand_inputs(n, layers, batch=4, seed=3)
+    dense = run_circuit(angles, w, n, layers, "dense")
+    mps = run_circuit(
+        angles.astype(jnp.bfloat16),
+        w.astype(jnp.bfloat16),
+        n,
+        layers,
+        "mps",
+        mps_chi=_full_chi(n),
+    )
+    assert mps.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(mps), np.asarray(dense), atol=3e-2)
+
+
+def test_mps_jit_vmap_and_lead_shapes():
+    n, layers = 5, 2
+    angles, w = _rand_inputs(n, layers, batch=6, seed=4)
+    lead = angles.reshape(2, 3, n)
+    fn = jax.jit(
+        lambda a, w: run_circuit(a, w, n, layers, "mps", mps_chi=_full_chi(n))
+    )
+    out = fn(lead, w)
+    assert out.shape == (2, 3, n)
+    flat = run_circuit(angles, w, n, layers, "dense")
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(6, n), np.asarray(flat), atol=1e-5
+    )
+    # single-sample (no lead) shape round-trips too
+    one = run_circuit(angles[0], w, n, layers, "mps", mps_chi=_full_chi(n))
+    assert one.shape == (n,)
+    np.testing.assert_allclose(np.asarray(one), np.asarray(flat[0]), atol=1e-5)
+
+
+def test_mps_chi_truncation_error_non_increasing():
+    """chi is a controlled approximation knob: error vs dense must be
+    non-increasing in chi, and exact (<= 1e-5) at chi >= 2^(n/2)."""
+    n, layers = 8, 3
+    angles, w = _rand_inputs(n, layers, batch=3, seed=5)
+    dense = np.asarray(run_circuit(angles, w, n, layers, "dense"))
+    errs = []
+    for chi in (2, 4, 8, 16):
+        out = np.asarray(run_circuit(angles, w, n, layers, "mps", mps_chi=chi))
+        errs.append(float(np.max(np.abs(out - dense))))
+    for lo, hi in zip(errs[1:], errs[:-1]):
+        assert lo <= hi + 1e-7, errs
+    assert errs[-1] <= 1e-5, errs  # chi = 16 = 2^(8/2): nothing to truncate
+    assert errs[0] > errs[-1], errs  # chi=2 genuinely truncates this circuit
+
+
+def test_mps_rejects_degenerate_chi():
+    angles, w = _rand_inputs(4, 1, batch=2)
+    with pytest.raises(ValueError, match="mps_chi"):
+        run_circuit(angles, w, 4, 1, "mps", mps_chi=1)
+
+
+# ---------------------------------------------------------------------------
+# Sharded statevector pins (8-virtual-device harness)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_values_and_grads_match_dense():
+    """k = log2(8) = 3 global qubits on the forced-CPU harness: the ppermute
+    exchange program must reproduce dense <Z> exactly (f32), and AD must flow
+    through the collectives to the same weight grads. One jitted
+    value_and_grad program pins both — compiling AD through an 8-way
+    shard_map on CPU costs tens of seconds, so the value-only and grad-only
+    variants would double the bill for no extra coverage."""
+    n, layers = 5, 2
+    angles, w = _rand_inputs(n, layers, batch=3, seed=6)
+
+    def loss_and_out(weights, backend):
+        out = run_circuit(angles, weights, n, layers, backend)
+        return jnp.sum(out**2), out
+
+    (l_d, out_d), g_d = jax.value_and_grad(
+        lambda w: loss_and_out(w, "dense"), has_aux=True
+    )(w)
+    (l_s, out_s), g_s = jax.jit(
+        jax.value_and_grad(
+            lambda w: loss_and_out(w, "sharded_statevector"), has_aux=True
+        )
+    )(w)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_d), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_s), np.asarray(g_d), atol=2e-5)
+    np.testing.assert_allclose(float(l_s), float(l_d), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_sharded_bf16_inputs_track_dense():
+    n, layers = 5, 2
+    angles, w = _rand_inputs(n, layers, batch=4, seed=8)
+    dense = run_circuit(angles, w, n, layers, "dense")
+    shard = run_circuit(
+        angles.astype(jnp.bfloat16),
+        w.astype(jnp.bfloat16),
+        n,
+        layers,
+        "sharded_statevector",
+    )
+    np.testing.assert_allclose(np.asarray(shard), np.asarray(dense), atol=3e-2)
+
+
+def _quantumnat_logprobs(impl, x, key):
+    from qdml_tpu.models.qsc import QSCP128
+
+    m = QSCP128(
+        n_qubits=4,
+        n_layers=2,
+        use_quantumnat=True,
+        noise_level=0.3,
+        impl=impl,
+        mps_chi=_full_chi(4),
+    )
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    return np.asarray(m.apply(variables, x, train=True, rngs={"quantumnat": key}))
+
+
+def test_quantumnat_noise_stream_invariant_mps():
+    """Switching to a scaling impl may not perturb which noisy point the
+    QuantumNAT stream evaluates: same key => same log-probs as dense."""
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((3, 16, 8, 2)).astype(np.float32))
+    key = jax.random.PRNGKey(11)
+    np.testing.assert_allclose(
+        _quantumnat_logprobs("dense", x, key),
+        _quantumnat_logprobs("mps", x, key),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+@pytest.mark.slow
+def test_quantumnat_noise_stream_invariant_sharded():
+    """The sharded leg of the invariance pin (compiling the model apply over
+    the 8-way shard_map dominates tier-1 budget, so it rides the slow lane)."""
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((3, 16, 8, 2)).astype(np.float32))
+    key = jax.random.PRNGKey(11)
+    np.testing.assert_allclose(
+        _quantumnat_logprobs("dense", x, key),
+        _quantumnat_logprobs("sharded_statevector", x, key),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Eligibility windows / typed ineligibility / canonical names
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_impl_aliases_and_unknown():
+    assert canonical_impl("sharded") == "sharded_statevector"
+    assert canonical_impl("pallas_tensor") == "pallas_circuit"
+    assert canonical_impl("mps") == "mps"
+    with pytest.raises(ValueError, match="unknown circuit impl"):
+        canonical_impl("qiskit")
+
+
+def test_eligible_impls_scaling_windows():
+    # crossover window: tensor races mps at 13..14
+    assert autotune.eligible_impls(13, "cpu") == ["tensor", "mps"]
+    assert autotune.eligible_impls(14, "cpu") == ["tensor", "mps"]
+    # past every full-statevector window, mps is the only 1-device candidate
+    assert autotune.eligible_impls(16, "cpu") == ["mps"]
+    assert autotune.eligible_impls(24, "cpu", devices_on_model=1) == ["mps"]
+    # a >= 2-device model axis adds the partitioned statevector from n = 10
+    assert autotune.eligible_impls(16, "cpu", devices_on_model=8) == [
+        "mps",
+        "sharded_statevector",
+    ]
+    assert "sharded_statevector" in autotune.eligible_impls(
+        10, "cpu", devices_on_model=2
+    )
+    assert "sharded_statevector" not in autotune.eligible_impls(
+        9, "cpu", devices_on_model=8
+    )
+    # topology-blind callers (devices_on_model=None) never see sharded
+    assert "sharded_statevector" not in autotune.eligible_impls(16, "tpu")
+    # dense never appears past its wall
+    for n in (13, 16, 24):
+        assert "dense" not in autotune.eligible_impls(n, "cpu", 8)
+
+
+def test_impl_eligible_reasons():
+    ok, why = autotune.impl_eligible("dense", 16)
+    assert not ok and "n <= 12" in why
+    ok, why = autotune.impl_eligible("tensor", 16)
+    assert not ok and "mps or sharded_statevector" in why
+    ok, why = autotune.impl_eligible("sharded_statevector", 10, devices_on_model=1)
+    assert not ok and ">= 2 devices" in why
+    # the alias funnels through the same check
+    ok, _ = autotune.impl_eligible("sharded", 10, devices_on_model=8)
+    assert ok
+    ok, _ = autotune.impl_eligible("mps", 24)
+    assert ok
+    with pytest.raises(ValueError):
+        autotune.impl_eligible("qiskit", 8)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint meta reconcile: new impl names + typed topology errors
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_accepts_scaling_impl_provenance():
+    """A checkpoint trained with mps/sharded reconciles cleanly when the eval
+    config lets the dispatcher re-resolve (impl provenance is popped, chi is
+    an execution knob the eval config owns)."""
+    from qdml_tpu.config import ExperimentConfig
+    from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
+
+    cfg = ExperimentConfig()
+    out = reconcile_quantum_cfg(
+        cfg, {"quantum": {"n_qubits": 6, "impl": "mps", "mps_chi": 64}}
+    )
+    assert out.quantum.n_qubits == 6
+    assert out.quantum.impl == cfg.quantum.impl  # provenance, not folded in
+    assert out.quantum.mps_chi == cfg.quantum.mps_chi
+    # the deprecated alias is accepted as provenance too
+    out = reconcile_quantum_cfg(
+        cfg, {"quantum": {"n_qubits": 6, "impl": "sharded"}}
+    )
+    assert out.quantum.n_qubits == 6
+
+
+def test_reconcile_rejects_ineligible_pin_typed():
+    """An EXPLICIT eval-config pin that cannot run at the checkpoint's qubit
+    count / this topology raises the typed error, not a KeyError (or a
+    partnerless collective) deep in the first forward."""
+    from unittest import mock
+
+    from qdml_tpu.config import ExperimentConfig, QuantumConfig
+    from qdml_tpu.quantum.autotune import ImplIneligibleError
+    from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
+
+    # dense pinned, checkpoint says n=16: past the dense wall
+    cfg = ExperimentConfig(quantum=QuantumConfig(impl="dense"))
+    with pytest.raises(ImplIneligibleError, match="n <= 12"):
+        reconcile_quantum_cfg(cfg, {"quantum": {"n_qubits": 16}})
+    # sharded_statevector pinned (via the legacy backend knob, alias form),
+    # restored on a single-device process
+    cfg = ExperimentConfig(quantum=QuantumConfig(backend="sharded"))
+    with mock.patch.object(autotune, "model_axis_devices", return_value=1):
+        with pytest.raises(ImplIneligibleError, match=">= 2 devices"):
+            reconcile_quantum_cfg(cfg, {"quantum": {"n_qubits": 10}})
+    # same pin on the 8-device harness topology: fine
+    out = reconcile_quantum_cfg(cfg, {"quantum": {"n_qubits": 10}})
+    assert out.quantum.n_qubits == 10
+
+
+def test_reconcile_unknown_impl_name_is_diagnosable():
+    from qdml_tpu.config import ExperimentConfig
+    from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
+
+    with pytest.raises(ValueError, match="unknown circuit impl"):
+        reconcile_quantum_cfg(
+            ExperimentConfig(), {"quantum": {"n_qubits": 4, "impl": "qiskit"}}
+        )
+
+
+def test_reconcile_ineligible_provenance_only_notes(capsys):
+    """A provenance-only impl (no eval pin) that can't run here must NOT
+    raise — the dispatcher re-resolves — but it says so."""
+    from unittest import mock
+
+    from qdml_tpu.config import ExperimentConfig
+    from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
+
+    with mock.patch.object(autotune, "model_axis_devices", return_value=1):
+        out = reconcile_quantum_cfg(
+            ExperimentConfig(),
+            {"quantum": {"n_qubits": 10, "impl": "sharded_statevector"}},
+        )
+    assert out.quantum.n_qubits == 10
+    assert "ineligible on this topology" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Qubit-scaling helpers + report section round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_scaling_grid_helpers():
+    from qdml_tpu.eval.sweep import (
+        QUBIT_SCALING_GRID,
+        scaling_batch,
+        scaling_chi,
+    )
+
+    assert QUBIT_SCALING_GRID[0] == 4 and QUBIT_SCALING_GRID[-1] == 24
+    assert all(b == 64 for b in map(scaling_batch, (4, 12, 16)))
+    assert scaling_batch(20) == 8 and scaling_batch(24) == 2
+    # chi caps at the exactness bound: more buys nothing
+    assert scaling_chi(6, 16) == 8  # 2^(6//2)
+    assert scaling_chi(13, 16) == 16
+    assert scaling_chi(4, 1) == 2  # floor
+
+
+def test_impl_agreement_uses_independent_reference():
+    from qdml_tpu.eval.sweep import impl_agreement
+
+    agr = impl_agreement(6, "mps", n_layers=2, batch=3, mps_chi=8)
+    assert agr["reference"] == "dense"
+    assert agr["max_abs_delta"] is not None and agr["max_abs_delta"] <= 1e-5
+
+
+def test_report_extracts_and_gates_qsc_scaling(tmp_path):
+    """Each scaling point becomes its own throughput gate key (n=16
+    regressing cannot hide behind n=6 improving) and the crossover section
+    renders impl/chi/margin/agreement."""
+    from qdml_tpu.telemetry.report import extract, report_main
+
+    rec = {
+        "metric": "qsc_scaling_points",
+        "value": 2,
+        "platform": "cpu",
+        "details": {
+            "qsc_scaling": {
+                "points": [
+                    {
+                        "n_qubits": 4,
+                        "quantum_impl": "dense_fused",
+                        "samples_per_sec": 1000.0,
+                        "batch": 64,
+                        "candidates": {
+                            "dense": {"train_ms": 2.0},
+                            "dense_fused": {"train_ms": 1.0},
+                        },
+                        "agreement": {"reference": "dense", "max_abs_delta": 1e-7},
+                    },
+                    {
+                        "n_qubits": 16,
+                        "quantum_impl": "mps",
+                        "mps_chi": 16,
+                        "samples_per_sec": 5.0,
+                        "batch": 8,
+                        "candidates": {"mps": {"train_ms": 100.0}},
+                        "agreement": {"reference": None, "max_abs_delta": None},
+                    },
+                ],
+                "devices_on_model": 8,
+                "platform": "cpu",
+            }
+        },
+    }
+    p = tmp_path / "scaling.jsonl"
+    p.write_text(json.dumps(rec) + "\n")
+    src = extract(str(p))
+    assert src["throughput"]["qsc_scaling.n04.best_of_impls"] == 1000.0
+    assert src["throughput"]["qsc_scaling.n16.best_of_impls"] == 5.0
+    out = tmp_path / "report.md"
+    rc = report_main(
+        [f"--current={p}", f"--baseline={p}", f"--out={out}"]
+    )
+    assert rc == 0
+    md = out.read_text()
+    assert "qubit scaling (best-of-impls per n)" in md
+    assert "qsc_scaling.n16.best_of_impls" in md
+    assert "2.00x vs dense" in md  # the crossover margin, straight off the race
+    assert "| 16 | mps | 16 |" in md
