@@ -733,6 +733,167 @@ def run_scaling_child(out_path: str | None = None) -> int:
     return 0
 
 
+def _bench_scenario_scaling(
+    budget_s: float,
+    s_values=None,
+    batch: int | None = None,
+    capacity_factor: float = 1.25,
+    features: int = 16,
+    table_path: str | None = None,
+) -> dict:
+    """The scenario-scaling axis (``scenario_scaling``): one measured point
+    per S in the 3..64 grid — the routing dispatcher races dense-all-trunks
+    vs capacity-bucketed sparse at that (S, batch) (``ops/dispatch_autotune``,
+    same pattern as the qubit axis's impl race), the DISPATCHER's winner is
+    timed as the routing-stage forward serving actually dispatches, and the
+    point records rows/s, XLA cost, achieved roofline, the chosen mode, every
+    candidate's timings, and a sparse-vs-dense value-agreement check — so the
+    crossover table comes straight off the artifact.
+
+    Candidate policy mirrors the qubit sweep: exclusions are RECORDED per
+    point (sparse below its S >= 6 eligibility window carries the window
+    reason — dense wins those points by construction, which is the committed
+    proof that the reference grid keeps its dense path). The model geometry
+    is reduced (16x8x4 pilots, ``features`` conv channels) so the S = 64
+    dense candidate — deliberately ~S x the sparse work — stays timeable on
+    the CPU harness; every S gates only against itself, so the reduced
+    geometry never leaks into another axis's numbers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from qdml_tpu.eval.sweep import (
+        SCENARIO_SCALING_GRID,
+        dispatch_agreement,
+        scenario_batch,
+    )
+    from qdml_tpu.ops import dispatch_autotune as _da
+    from qdml_tpu.ops.routing import expert_capacity
+    from qdml_tpu.telemetry import cost as _cost
+    from qdml_tpu.train.hdce import HDCE
+
+    platform = jax.default_backend()
+    if table_path:
+        _da.set_table_path(table_path)
+    hw = (8, 4)  # reduced n_sub x n_beam pilot image
+    points = []
+    for s in s_values or SCENARIO_SCALING_GRID:
+        b = batch or scenario_batch(s)
+        point: dict = {
+            "n_scenarios": s,
+            "batch": b,
+            "capacity_factor": capacity_factor,
+            "capacity": expert_capacity(b, s, capacity_factor),
+            "candidates_raced": _da.eligible_modes(s),
+        }
+        try:
+            rng = np.random.default_rng(0)
+            model = HDCE(n_scenarios=s, features=features, out_dim=256)
+            x = jnp.asarray(rng.standard_normal((b, *hw, 2)).astype(np.float32))
+            vars_ = model.init(
+                jax.random.PRNGKey(0),
+                jnp.broadcast_to(x[None], (s,) + x.shape),
+                train=False,
+            )
+
+            def apply_trunks(xs, _m=model, _v=vars_):
+                return _m.apply(_v, xs, train=False)
+
+            # force=True: the committed artifact's race timings must come
+            # from THIS window, never a previous session's table entry
+            entry = _da.ensure_route(
+                apply_trunks,
+                x,
+                s,
+                capacity_factor=capacity_factor,
+                path=table_path,
+                force=True,
+                budget_s=budget_s,
+            )
+            winner = entry.get("best_infer")
+            point["candidates"] = entry["candidates"]
+            if entry.get("excluded"):
+                point["excluded"] = entry["excluded"]
+            if winner is None:
+                point["error"] = "no candidate ran (see candidates.*.error)"
+                points.append(point)
+                continue
+            point["dispatch"] = winner
+            # the winner's routing-stage forward at this exact shape: the
+            # point's number IS the race's own measurement when a race ran
+            # (same timer, same shape — re-jitting a fresh closure would
+            # compile and time the identical program a second time per
+            # point); only window-only winners (never timed) pay a timing
+            # window here. Cost comes from the lowering (traces, never
+            # compiles).
+            fn, args = _da.route_candidates(
+                apply_trunks, x, s, capacity_factor
+            )[winner]
+            cost_rec = _cost.analyze_jit(fn, *args)
+            raced_ms = (entry["candidates"].get(winner) or {}).get("infer_ms")
+            if isinstance(raced_ms, (int, float)):
+                ms = float(raced_ms)
+            else:
+                from qdml_tpu.quantum.autotune import _time_callable
+
+                ms = _time_callable(fn, args, budget_s, 30)
+            point["infer_ms"] = round(ms, 4)
+            point["samples_per_sec"] = round(1e3 / ms * b, 1)
+            point["cost"] = cost_rec
+            point["roofline"] = _cost.achieved_roofline(cost_rec, 1e3 / ms)
+            # batch >= S so the balanced leg touches EVERY expert (a
+            # high-index packing defect must not hide behind a small
+            # agreement batch at exactly the scale-out points)
+            point["agreement"] = dispatch_agreement(
+                s, batch=b, features=8, capacity_factor=capacity_factor
+            )
+        except Exception as e:  # lint: disable=broad-except(point isolation: one S failing must not kill the sweep's other points; the error is recorded on the point)
+            point["error"] = f"{type(e).__name__}: {e}"
+        points.append(point)
+    return {
+        "points": points,
+        "platform": platform,
+        "batch": batch,
+        "capacity_factor": capacity_factor,
+        "features": features,
+        "image_hw": list(hw),
+        "table": _da.table_path(table_path),
+    }
+
+
+def run_scenario_scaling_child(out_path: str | None = None) -> int:
+    """The scenario-scaling sweep as its own child (``bench.py
+    --scenario-scaling`` / ``scripts/scenario_scaling_sweep.py``): the S=64
+    dense candidate is deliberately ~50x the sparse work, so the sweep never
+    rides the default bench child's budget. Prints one JSON record; with
+    ``out_path`` also writes the manifest-headed telemetry JSONL."""
+    import jax
+
+    from qdml_tpu.telemetry import run_manifest
+
+    budget = float(os.environ.get("QDML_SCENARIO_BUDGET_S", "1.0"))
+    table = os.environ.get("QDML_SCENARIO_TABLE") or None
+    grid = os.environ.get("QDML_SCENARIO_GRID")  # "3,16" (tests); default full
+    s_values = tuple(int(v) for v in grid.split(",")) if grid else None
+    scaling = _bench_scenario_scaling(budget, s_values=s_values, table_path=table)
+    manifest = run_manifest(argv=["bench.py", "--scenario-scaling"])
+    sparse_points = [
+        p["n_scenarios"] for p in scaling["points"] if p.get("dispatch") == "sparse"
+    ]
+    record = {
+        "metric": "scenario_scaling_points",
+        "value": len([p for p in scaling["points"] if "samples_per_sec" in p]),
+        "unit": f"measured scaling points (of {len(scaling['points'])})",
+        "platform": jax.default_backend(),
+        "sparse_points": sparse_points,
+        "details": {"scenario_scaling": scaling},
+    }
+    print(json.dumps(record), flush=True)
+    if out_path:
+        _write_telemetry_jsonl(out_path, manifest, record)
+    return 0
+
+
 def _bench_serve_infer(max_steps: int, budget_s: float, bucket: int = 64) -> dict:
     """Request-path throughput of the online serving engine
     (:mod:`qdml_tpu.serve`): one warmed full-bucket ``infer`` per iteration —
@@ -818,6 +979,12 @@ def run_child(platform: str) -> int:
         # compiles cost minutes on the CPU harness, so it never rides the
         # default child's budget — it IS the whole child here
         return run_scaling_child(os.environ.get("QDML_SCALING_OUT") or None)
+    if platform == "scenario_scaling":
+        # the scenario-scaling sweep child (bench.py --scenario-scaling):
+        # the S=64 dense race entrant alone outweighs the default budget
+        return run_scenario_scaling_child(
+            os.environ.get("QDML_SCENARIO_OUT") or None
+        )
 
     on_tpu = platform != "cpu"
     max_steps = 50 if on_tpu else 6
@@ -1254,9 +1421,29 @@ def main() -> int:
         "XLA_FLAGS topology (scripts/qubit_scaling_sweep.py forces the "
         "8-virtual-device CPU harness)",
     )
+    ap.add_argument(
+        "--scenario-scaling",
+        action="store_true",
+        help="run the S=3..64 scenario-scaling sweep child (scenario_scaling "
+        "record: per-S dense-vs-sparse dispatch race + XLA cost) instead of "
+        "the standard bench (scripts/scenario_scaling_sweep.py forces the "
+        "8-virtual-device CPU harness)",
+    )
     args = ap.parse_args()
     if args.child:
         return run_child(args.child)
+    if args.scenario_scaling:
+        env = dict(os.environ)
+        if args.out:
+            env["QDML_SCENARIO_OUT"] = args.out
+        timeout = int(os.environ.get("QDML_SCENARIO_TIMEOUT_S", "3600"))
+        d = _run_bench_child(env, "scenario_scaling", timeout_s=timeout)
+        if d is None:
+            print(json.dumps({"metric": "scenario_scaling_points", "value": None,
+                              "error": "scenario-scaling child failed or timed out"}))
+            return 1
+        print(json.dumps(d))
+        return 0
     if args.scaling:
         env = dict(os.environ)
         if args.out:

@@ -45,9 +45,17 @@ LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
     "qdml_tpu/serve/server.py": {"ExitCoordinator": {"_live": "_lock"}},
     # hot-swap epoch state: the live (hdce, clf) param tuple and its epoch
     # counter swap atomically between batches — a read outside the lock can
-    # see a torn checkpoint mid-swap
+    # see a torn checkpoint mid-swap. The sparse-dispatch overflow counters
+    # are incremented by every worker thread's infer() and read by
+    # dispatch_summary(): unlocked access would drop counts under the same
+    # multi-worker interleaving the PR-2 soak test caught.
     "qdml_tpu/serve/engine.py": {
-        "ServeEngine": {"_live": "_swap_lock", "_swap_epoch": "_swap_lock"}
+        "ServeEngine": {
+            "_live": "_swap_lock",
+            "_swap_epoch": "_swap_lock",
+            "_overflow_rows": "_dispatch_lock",
+            "_routed_rows": "_dispatch_lock",
+        }
     },
 }
 
@@ -145,6 +153,25 @@ TRACING_ENTRY_POINTS: frozenset[str] = frozenset(
 # Train-step maker naming convention: these must audit their jit for
 # donate_argnums/static_* (eval-step makers are exempt — nothing to donate).
 TRAIN_MAKER_PATTERN = r"^make_\w*(train|scan)\w*step"
+
+# jnp calls whose OUTPUT SHAPE depends on input VALUES: under jit these
+# either raise (nonzero/unique without a static size=) or silently force a
+# host fallback/concretization — the hazard class capacity-bucketed sparse
+# dispatch exists to avoid (rule data-dependent-shape-in-jit). Matched on the
+# callee's last attribute segment under the jax.numpy namespace; jnp.where is
+# handled separately (only its ONE-argument nonzero form is data-dependent).
+DATA_DEP_SHAPE_CALLS: frozenset[str] = frozenset(
+    {
+        "nonzero",
+        "flatnonzero",
+        "argwhere",
+        "unique",
+        "unique_all",
+        "unique_counts",
+        "unique_inverse",
+        "unique_values",
+    }
+)
 
 # Per-gate matrix constructors (quantum/circuits.py, quantum/statevector.py):
 # calling one of these inside a host-side Python loop over layers/gates

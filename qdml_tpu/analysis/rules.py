@@ -672,6 +672,96 @@ def rule_gate_matrix_in_loop(ctx: ModuleContext) -> list[Finding]:
     return out
 
 
+def rule_data_dependent_shape_in_jit(ctx: ModuleContext) -> list[Finding]:
+    """A value-dependent-shape op inside a jit-reachable function: the shape
+    of ``jnp.nonzero``/``jnp.unique``/one-arg ``jnp.where`` (and of
+    boolean-mask indexing, which lowers to nonzero+gather) depends on runtime
+    VALUES, which XLA's static-shape compilation cannot express — a
+    ConcretizationTypeError at best, a silent host fallback at worst. The
+    hazard class capacity-bucketed sparse dispatch (``ops/routing.py``) is
+    built to avoid: rank with a one-hot cumsum, pack into FIXED-capacity
+    buckets, scatter/gather by computed slots.
+
+    Three shapes are caught: (a) calls to the ``project.DATA_DEP_SHAPE_CALLS``
+    jnp functions, (b) ``jnp.where`` with exactly one argument (the nonzero
+    form — the 3-arg select is the FIX, never flagged), (c) subscripts whose
+    index is a comparison (``x[y > 0]``) or a local assigned from one
+    (``mask = y > 0; x[mask]``). Deliberately NOT caught: the same ops in
+    host-side code (eval scripts aggregate with np.unique legitimately),
+    integer-array gathers (``x[idx]`` is shape-static), and masks consumed
+    by ``jnp.where``/arithmetic (masking VALUES is fine; masking SHAPE is
+    the bug)."""
+    out: list[Finding] = []
+    for fn in ctx.traced:
+        # locals assigned from a bare comparison: the mask-indexing feeders
+        mask_locals: set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Compare):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        mask_locals.add(t.id)
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                callee = ctx.canonical(sub.func) or ""
+                if callee.startswith("jax.numpy."):
+                    tail = callee.rsplit(".", 1)[-1]
+                    if tail in project.DATA_DEP_SHAPE_CALLS and any(
+                        kw.arg == "size" for kw in sub.keywords
+                    ):
+                        # jnp.nonzero(x, size=k) / jnp.unique(x, size=k):
+                        # jax's documented static-shape escape hatch — the
+                        # output shape is the literal k, not a runtime value
+                        continue
+                    if tail in project.DATA_DEP_SHAPE_CALLS:
+                        out.append(
+                            ctx.finding(
+                                "data-dependent-shape-in-jit",
+                                sub,
+                                f"{callee} inside jit-reachable "
+                                f"{ctx.qualname(fn)!r}: its output shape "
+                                "depends on runtime values — XLA needs static "
+                                "shapes; pack into fixed-capacity buckets "
+                                "with computed slots instead "
+                                "(ops/routing.sparse_dispatch is the worked "
+                                "example)",
+                            )
+                        )
+                    elif (
+                        tail == "where"
+                        and len(sub.args) == 1
+                        and not sub.keywords
+                    ):
+                        out.append(
+                            ctx.finding(
+                                "data-dependent-shape-in-jit",
+                                sub,
+                                "one-argument jnp.where (the nonzero form) "
+                                f"inside jit-reachable {ctx.qualname(fn)!r} "
+                                "returns value-dependent shapes — use the "
+                                "3-argument select, or fixed-capacity "
+                                "slot packing",
+                            )
+                        )
+            elif isinstance(sub, ast.Subscript):
+                idx = sub.slice
+                masked = isinstance(idx, ast.Compare) or (
+                    isinstance(idx, ast.Name) and idx.id in mask_locals
+                )
+                if masked:
+                    out.append(
+                        ctx.finding(
+                            "data-dependent-shape-in-jit",
+                            sub,
+                            "boolean-mask indexing inside jit-reachable "
+                            f"{ctx.qualname(fn)!r} lowers to nonzero+gather "
+                            "(value-dependent shape) — select with "
+                            "jnp.where(mask, a, b), or pack fixed-capacity "
+                            "buckets (ops/routing.sparse_dispatch)",
+                        )
+                    )
+    return out
+
+
 def rule_collective_outside_shardmap(ctx: ModuleContext) -> list[Finding]:
     """A named-axis collective (``ppermute``/``psum``/``axis_index``/...,
     project.SHARD_AXIS_CALLS) in ``quantum/`` traced outside a ``shard_map``
@@ -812,6 +902,10 @@ RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
     "gate-matrix-in-loop": (
         rule_gate_matrix_in_loop,
         "per-gate jnp matrix construction inside a circuit layer loop",
+    ),
+    "data-dependent-shape-in-jit": (
+        rule_data_dependent_shape_in_jit,
+        "jnp.nonzero/unique/bool-mask indexing in jitted hot paths (value-dependent shapes)",
     ),
     "collective-outside-shardmap": (
         rule_collective_outside_shardmap,

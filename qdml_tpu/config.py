@@ -33,7 +33,11 @@ class DataConfig:
     n_ant: int = 64          # BS ULA antennas; H is (n_ant, n_sub) complex
     n_sub: int = 16          # OFDM subcarriers
     n_beam: int = 8          # sounded DFT beams -> pilot_num = n_beam * n_sub
-    n_scenarios: int = 3     # propagation scenarios (reference: 3)
+    # Propagation scenario families (reference: 3). S > 3 appends derived
+    # UMa/UMi/InH-style families from data/channels.family_table — generated
+    # on device, no DeepMIMO files; rows 0..2 stay the frozen reference
+    # presets (bit-identical streams).
+    n_scenarios: int = 3
     n_users: int = 3         # users per scenario (reference: 3)
     data_len: int = 20000    # training samples per (scenario, user) cell
     snr_db: float = 10.0     # training SNR (reference SNRdb=10)
@@ -251,6 +255,17 @@ class ServeConfig:
     # parallelism for the all-trunks pass) — requires mesh.fed_axis ==
     # data.n_scenarios, exactly like federated training/eval placement.
     expert_sharding: bool = False
+    # Expert-routing dispatch for the fused forward (ops/routing.py,
+    # docs/SERVING.md): "auto" lets the measured dispatcher race pick
+    # dense-all-trunks vs capacity-bucketed sparse per AOT bucket at warmup
+    # (ops/dispatch_autotune.py — dense by construction below the sparse
+    # eligibility window, so the reference S=3 grid pays zero extra warmup
+    # compiles); "dense"/"sparse" force that path into every bucket.
+    dispatch: str = "auto"
+    # Sparse-dispatch per-expert bucket headroom: capacity = ceil(B*f/S).
+    # Larger f buys fewer overflow-fallback batches under skewed routing at
+    # ~f*B trunk-rows of compute; overflow is NEVER dropped (dense fallback).
+    capacity_factor: float = 1.25
     # Replica pool size: N ServeLoops sharing ONE warmup, ONE autotune table
     # and ONE MicroBatcher feed (serve/server.py ReplicaPool). Per-replica
     # ServeMetrics merge exactly via Histogram.merge.

@@ -5,8 +5,11 @@ loaded by a ``generate_data`` module that is MISSING from its snapshot (imported
 at ``Runner_P128_QuantumNAT_onchipQNN.py:16`` and ``Test.py:7``; contracts
 reconstructed in SURVEY.md §2.8). This module is the TPU-native replacement: a
 fully jittable, deterministic (seeded per sample index) geometric multipath
-generator with three propagation scenarios x three users, matching the
-reference's array contracts:
+generator — three frozen reference scenarios x three users by default, and a
+parameterized family synthesizer (:func:`family_table`) deriving S >> 3
+UMa/UMi/InH-style propagation families (delay spread / angular spread /
+K-factor / Doppler-mobility ladders) entirely on device for the scenario
+scale-out axis — matching the reference's array contracts:
 
 - ``Yp``: complex ``(N, 128)`` pilots (beam-major flattening of an
   ``(n_beam=8, n_sub=16)`` beam-sounding grid),
@@ -49,11 +52,70 @@ ensure_jax_compat()
 # for jit — no data-dependent Python control flow).
 MAX_PATHS = 20
 
-# Per-scenario propagation parameters: [LOS-dominant, moderate NLOS, rich scattering]
+# Per-scenario propagation parameters: [LOS-dominant, moderate NLOS, rich
+# scattering] — the 3GPP-flavored base presets (InH-LOS-like, UMi-like,
+# UMa-NLOS-like) every committed stream was generated from. These three rows
+# are FROZEN: family_table(3) returns exactly them, so the reference-parity
+# S=3 datasets stay bit-identical forever.
+FAMILY_PRESET_NAMES = ("inh_los", "umi_street", "uma_nlos")
 SCENARIO_N_PATHS = np.array([3, 8, 20], dtype=np.int32)
 SCENARIO_ANGLE_SPREAD = np.array([0.3 / 64, 0.8 / 64, 1.6 / 64], dtype=np.float32)
 SCENARIO_DELAY_SPREAD = np.array([0.6, 1.8, 3.5], dtype=np.float32)  # in samples
 SCENARIO_K_FACTOR = np.array([8.0, 2.0, 0.5], dtype=np.float32)  # LOS power boost
+# Per-preset mobility (Doppler phase spread, radians RMS per path). The base
+# presets carry 0.0 — mobility multiplies every path gain by exp(i*phi) with
+# phi ~ N(0, mobility^2), and exp(i*0) = 1 + 0i is an EXACT float identity,
+# so the committed S=3 streams are untouched down to the bit. Derived
+# families (s >= 3) get nonzero mobility: the pedestrian/vehicular axis that
+# makes S >> 3 families genuinely distinct, not re-seeded copies.
+SCENARIO_MOBILITY = np.array([0.0, 0.0, 0.0], dtype=np.float32)
+
+
+def family_table(n_scenarios: int) -> dict[str, np.ndarray]:
+    """Per-scenario propagation parameters for an S-family grid — the
+    on-device channel-family synthesizer's parameter bank (host numpy; the
+    geometry is a jit-static argument, so these become trace-time constants
+    inside the scan-fused step — S >> 3 costs no host transfer and no DeepMIMO
+    files, preserving the zero-host-transfer training pin).
+
+    Rows 0..2 are the frozen base presets (bit-identical S=3 streams); row
+    ``s >= 3`` derives family ``s`` from base preset ``s % 3`` at tier
+    ``s // 3``: each tier adds paths, widens the angular spread, stretches
+    the delay spread (capped at the CP-like n_sub/2 the sampler clips to),
+    bleeds K-factor toward Rayleigh, and turns on mobility — a deterministic
+    UMa/UMi/InH-style family ladder, so family s is the same physics on every
+    host and every run. Prefix property: ``family_table(S)[k] ==
+    family_table(S')[k]`` for every ``k < min(S, S')`` — growing the grid
+    never re-parameterizes existing scenarios (pinned in tests/test_data.py).
+    """
+    if n_scenarios < 1:
+        raise ValueError(f"n_scenarios must be >= 1, got {n_scenarios}")
+    idx = np.arange(n_scenarios)
+    base = idx % 3
+    tier = (idx // 3).astype(np.float32)
+    return {
+        "n_paths": np.clip(
+            SCENARIO_N_PATHS[base] + 2 * (idx // 3), 1, MAX_PATHS
+        ).astype(np.int32),
+        "angle_spread": (
+            SCENARIO_ANGLE_SPREAD[base] * (1.0 + 0.25 * tier)
+        ).astype(np.float32),
+        "delay_spread": np.clip(
+            SCENARIO_DELAY_SPREAD[base] * (1.0 + 0.3 * tier), 0.1, None
+        ).astype(np.float32),
+        "k_factor": (SCENARIO_K_FACTOR[base] / (1.0 + 0.5 * tier)).astype(
+            np.float32
+        ),
+        "mobility": (
+            SCENARIO_MOBILITY[base]
+            + np.where(tier > 0, 0.15 * np.sqrt(tier), 0.0)
+        ).astype(np.float32),
+        # plain python list (host metadata, never gathered on device)
+        "preset": [
+            FAMILY_PRESET_NAMES[b] + (f"+t{t:.0f}" if t else "")
+            for b, t in zip(base, tier)
+        ],
+    }
 # Per-user angular sector centres, in spatial-frequency units f = d/lambda*sin(theta).
 # Sector centres + 2-sigma truncated spreads stay strictly inside the sounded
 # beam span (max f = 4.2/64 + 2*1.6/64 = 7.4/64 < n_beam/64): the compressed
@@ -72,6 +134,11 @@ class ChannelGeometry:
     n_ant: int = 64
     n_sub: int = 16
     n_beam: int = 8
+    # Scenario-family count S: rows of family_table(S) the sampler can gather
+    # (the scenario id is a traced int; the TABLE is a trace-time constant of
+    # this static field). 3 = the frozen reference presets; S > 3 appends
+    # derived UMa/UMi/InH-style families without touching rows 0..2.
+    n_scenarios: int = 3
     # Full-pilot LS label noise scale: per-entry variance of the Hlabel/HLS
     # observation is ``label_noise_factor * 10**(-SNR/10)`` (unit channel-entry
     # power). 1.9 (= 10**0.28, i.e. a 2.8 dB pilot-overhead loss) calibrates
@@ -107,6 +174,7 @@ class ChannelGeometry:
             n_ant=cfg.n_ant,
             n_sub=cfg.n_sub,
             n_beam=cfg.n_beam,
+            n_scenarios=cfg.n_scenarios,
             label_noise_factor=cfg.label_noise_factor,
             rng_impl=cfg.rng_impl,
             trig_impl=cfg.trig_impl,
@@ -207,10 +275,11 @@ def sample_channel(
     s = scenario.astype(jnp.int32)
     u = user.astype(jnp.int32)
 
-    n_paths = jnp.asarray(SCENARIO_N_PATHS)[s]
-    spread = jnp.asarray(SCENARIO_ANGLE_SPREAD)[s]
-    dly = jnp.asarray(SCENARIO_DELAY_SPREAD)[s]
-    kfac = jnp.asarray(SCENARIO_K_FACTOR)[s]
+    fam = family_table(geom.n_scenarios)
+    n_paths = jnp.asarray(fam["n_paths"])[s]
+    spread = jnp.asarray(fam["angle_spread"])[s]
+    dly = jnp.asarray(fam["delay_spread"])[s]
+    kfac = jnp.asarray(fam["k_factor"])[s]
     center = jnp.asarray(USER_CENTER_F)[u]
 
     mask = (jnp.arange(MAX_PATHS) < n_paths).astype(jnp.float32)
@@ -231,6 +300,22 @@ def sample_channel(
     g = jax.random.normal(k_gain, (MAX_PATHS, 2))
     amp = jnp.sqrt(p / 2.0)
     alpha = CArr(amp * g[:, 0], amp * g[:, 1])  # (L,)
+
+    # Mobility (Doppler) phase spread: per-path gain rotated by exp(i*phi),
+    # phi ~ N(0, mobility^2). The key derives by fold_in — NOT another split
+    # of `key` — so k_f/k_tau/k_gain (and with them every committed stream)
+    # are byte-for-byte unchanged. The whole block is compiled OUT when no
+    # family in this (static) geometry is mobile — fam is a trace-time host
+    # constant, so the frozen S=3 reference grid pays zero extra ops, not
+    # just a bitwise-identity rotation (the sin/cos tail is the generator's
+    # stated VPU bottleneck). Mobile families at mobility = 0 would still be
+    # exact: cos 0 = 1, sin 0 = 0 make the multiply a float identity.
+    if np.any(fam["mobility"] > 0.0):
+        mobility = jnp.asarray(fam["mobility"])[s]
+        phi = mobility * jax.random.normal(
+            jax.random.fold_in(key, 7), (MAX_PATHS,)
+        )
+        alpha = alpha * cexp_i(phi)
 
     a = _steering(f, geom.n_ant, geom.trig_impl)  # (L, n_ant)
     b = _delay_response(tau, geom.n_sub, geom.trig_impl)  # (L, n_sub)

@@ -38,7 +38,7 @@ from qdml_tpu.data.channels import ChannelGeometry, label_noise_var
 from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.models.cnn import DCEP128, SCP128
 from qdml_tpu.models.qsc import QSCP128
-from qdml_tpu.ops.routing import select_expert
+from qdml_tpu.ops.routing import select_expert, sparse_dispatch
 from qdml_tpu.telemetry import span
 from qdml_tpu.train.hdce import HDCE
 from qdml_tpu.utils.metrics import nmse_db
@@ -57,10 +57,18 @@ def make_sweep_step(
     profile: jnp.ndarray,
     dce_vars: dict | None = None,
     mesh=None,
+    dispatch: str = "dense",
+    capacity_factor: float = 1.25,
 ):
     """Build the jitted per-batch sweep step: ``step(start, count_base,
     snr_db)`` returns a dict of error/power sums and correct-counts for one
     ``eval.batch_size`` batch.
+
+    ``dispatch`` selects the expert-routing formulation for the HDCE curves:
+    ``"dense"`` (run all trunks + gather — the default and the S=3 winner) or
+    ``"sparse"`` (capacity-bucketed top-1, ``routing.sparse_dispatch`` — the
+    S≫3 path the serve engine's dispatcher bakes in; value-equivalent, so
+    the NMSE curves are dispatch-invariant to float tolerance).
 
     With a ``mesh`` carrying a ``fed`` axis of size ``n_scenarios`` (and
     ``hdce_vars`` placed by
@@ -70,6 +78,8 @@ def make_sweep_step(
     routing gather is the one cross-slice collective. A ``data`` axis
     additionally shards the batch (and its on-device generation) within
     each slice."""
+    if dispatch not in ("dense", "sparse"):
+        raise ValueError(f"dispatch must be dense|sparse, got {dispatch!r}")
     hdce = HDCE(
         n_scenarios=cfg.data.n_scenarios,
         features=cfg.model.features,
@@ -119,18 +129,55 @@ def make_sweep_step(
         h_mmse = mmse_generic_estimate(h_ls, sigma2, geom)
         h_mmse_oracle = mmse_estimate(h_ls, sigma2, profile, geom)
 
-        # stacked-trunk HDCE outputs for every scenario hypothesis
-        xs = jnp.broadcast_to(x[None], (n_scen,) + x.shape)
-        if mesh is not None:
+        # stacked-trunk HDCE outputs for every scenario hypothesis — the
+        # dense formulation's all-hypotheses pass; the sparse formulation
+        # defers trunk work until each classifier's predictions exist
+        est_all = None
+        if dispatch == "dense":
+            xs = jnp.broadcast_to(x[None], (n_scen,) + x.shape)
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                fed = "fed" if mesh.shape.get("fed", 1) == n_scen else None
+                data = "data" if mesh.shape.get("data", 1) > 1 else None
+                xs = jax.lax.with_sharding_constraint(
+                    xs, NamedSharding(mesh, P(fed, data, *(None,) * (xs.ndim - 2)))
+                )
+            est_all = hdce.apply(hdce_vars, xs, train=False)  # (S, B, 2048)
+
+        def _pin_fed(xs: jnp.ndarray) -> jnp.ndarray:
+            """Pin a (S, ...) leading axis to ``fed`` — the serve engine's
+            ``_apply_trunks`` twin, so bucket/hypothesis s co-locates with
+            trunk s's weights under expert-sharded params on the sparse path
+            exactly as the dense branch's constraint guarantees."""
+            if mesh is None:
+                return xs
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
             fed = "fed" if mesh.shape.get("fed", 1) == n_scen else None
-            data = "data" if mesh.shape.get("data", 1) > 1 else None
-            xs = jax.lax.with_sharding_constraint(
-                xs, NamedSharding(mesh, P(fed, data, *(None,) * (xs.ndim - 2)))
+            return jax.lax.with_sharding_constraint(
+                xs, NamedSharding(mesh, P(fed, *(None,) * (xs.ndim - 1)))
             )
-        est_all = hdce.apply(hdce_vars, xs, train=False)  # (S, B, 2048)
+
+        def _route(pred: jnp.ndarray) -> jnp.ndarray:
+            if dispatch == "dense":
+                return select_expert(est_all, pred)
+
+            def dense_fb(xb, pb):
+                xsb = _pin_fed(jnp.broadcast_to(xb[None], (n_scen,) + xb.shape))
+                return select_expert(hdce.apply(hdce_vars, xsb, train=False), pb)
+
+            routed, _ = sparse_dispatch(
+                lambda buckets: hdce.apply(hdce_vars, _pin_fed(buckets), train=False),
+                dense_fb,
+                x,
+                pred,
+                n_scen,
+                capacity_factor,
+            )
+            return routed
 
         out: dict[str, jnp.ndarray] = {
             "pow": _sum_sq(h),
@@ -148,7 +195,7 @@ def make_sweep_step(
                 continue
             logp = model.apply(vars_, x, train=False)
             pred = jnp.argmax(logp, -1)
-            routed = select_expert(est_all, pred)  # (B, 2048)
+            routed = _route(pred)  # (B, 2048)
             out[f"err_hdce_{name}"] = _sum_sq(routed - label2)
             out[f"correct_{name}"] = jnp.sum(pred == batch["indicator"]).astype(jnp.float32)
         return out
@@ -191,6 +238,7 @@ def run_snr_sweep(
     logger=None,
     dce_vars: dict | None = None,
     mesh=None,
+    dispatch: str = "dense",
 ) -> dict[str, Any]:
     """Full sweep; returns ``{"snr": [...], "nmse_db": {curve: [...]}, "acc": {...}}``.
 
@@ -202,7 +250,9 @@ def run_snr_sweep(
     geom = ChannelGeometry.from_config(cfg.data)
     profile = beam_delay_profile(geom)
     step = make_sweep_step(
-        cfg, geom, hdce_vars, sc_vars, qsc_vars, profile, dce_vars=dce_vars, mesh=mesh
+        cfg, geom, hdce_vars, sc_vars, qsc_vars, profile, dce_vars=dce_vars,
+        mesh=mesh, dispatch=dispatch,
+        capacity_factor=cfg.serve.capacity_factor,
     )
     n_batches = max(cfg.eval.test_len // cfg.eval.batch_size, 1)
     sweep_one_snr = make_snr_scan(cfg, step, n_batches)
@@ -229,6 +279,74 @@ def run_snr_sweep(
         if logger is not None:
             logger.log(snr_db=float(snr), n_samples=sums["count"], **row)
     return {"snr": list(cfg.eval.snr_grid), "nmse_db": curves, "acc": accs}
+
+
+# ---------------------------------------------------------------------------
+# Scenario-scaling axis (the S = 3 ... 64 sweep, docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+# The scaling grid: the reference's 3-scenario grid (the dense anchor every
+# committed curve lives at), the sparse-eligibility edge's near side (4), the
+# first raced point (8), and the scale-out regime (16/32/64) where the dense
+# all-trunks pass burns O(S) compute for O(1) useful work.
+SCENARIO_SCALING_GRID = (3, 4, 8, 16, 32, 64)
+
+
+def scenario_batch(n_scenarios: int) -> int:
+    """Per-point request-batch for the scenario sweep: the serve engine's
+    largest default bucket, held constant across S — the scenario axis scales
+    EXPERT count, not batch, so every point routes the same 64 rows and the
+    per-S gates stay comparable run-to-run (each S only gates against
+    itself, mirroring ``scaling_batch``'s contract on the qubit axis)."""
+    return 64
+
+
+def dispatch_agreement(
+    n_scenarios: int,
+    batch: int = 32,
+    features: int = 8,
+    capacity_factor: float = 1.25,
+    seed: int = 0,
+) -> dict:
+    """Numerics cross-check for one scenario-scaling point: how far the
+    sparse routing stage sits from the dense formulation at the same
+    (params, inputs, predictions) — checked under BOTH a balanced load
+    (buckets fill evenly, pure sparse path) and a fully skewed one (every
+    row one expert, the overflow fallback IS the dense path). The two
+    formulations share no routing code, so a packing/unsort bug cannot
+    cancel out. Returns ``{"max_abs_delta", "overflow_balanced",
+    "overflow_skewed"}``."""
+    import numpy as np
+
+    from qdml_tpu.train.hdce import HDCE
+
+    s = int(n_scenarios)
+    rng = np.random.default_rng(seed)
+    model = HDCE(n_scenarios=s, features=features, out_dim=64)
+    x = jnp.asarray(rng.standard_normal((batch, 16, 8, 2)).astype(np.float32))
+    vars_ = model.init(
+        jax.random.PRNGKey(seed), jnp.broadcast_to(x[None], (s,) + x.shape), train=False
+    )
+
+    def dense_fb(xb, pb):
+        xs = jnp.broadcast_to(xb[None], (s,) + xb.shape)
+        return select_expert(model.apply(vars_, xs, train=False), pb)
+
+    def run_experts(buckets):
+        return model.apply(vars_, buckets, train=False)
+
+    out: dict[str, Any] = {"max_abs_delta": 0.0}
+    for name, pred in (
+        ("balanced", jnp.arange(batch, dtype=jnp.int32) % s),
+        ("skewed", jnp.zeros(batch, jnp.int32)),
+    ):
+        routed, ovf = sparse_dispatch(
+            run_experts, dense_fb, x, pred, s, capacity_factor
+        )
+        delta = float(jnp.max(jnp.abs(routed - dense_fb(x, pred))))
+        out["max_abs_delta"] = round(max(out["max_abs_delta"], delta), 8)
+        out[f"overflow_{name}"] = int(ovf)
+    return out
 
 
 # ---------------------------------------------------------------------------

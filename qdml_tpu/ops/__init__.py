@@ -1,3 +1,9 @@
 from qdml_tpu.ops.grad_prune import GradientPruneState, gradient_prune  # noqa: F401
 from qdml_tpu.ops.quantumnat import perturb  # noqa: F401
-from qdml_tpu.ops.routing import one_hot_dispatch, select_expert  # noqa: F401
+from qdml_tpu.ops.routing import (  # noqa: F401
+    bucket_ranks,
+    expert_capacity,
+    one_hot_dispatch,
+    select_expert,
+    sparse_dispatch,
+)

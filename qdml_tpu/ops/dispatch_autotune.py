@@ -1,0 +1,330 @@
+"""Autotuned dense-vs-sparse routing dispatch for the expert hot path.
+
+The quantum half learned this lesson first (``quantum/autotune.py``,
+BENCH_r05): nothing structural guarantees the "obviously faster" formulation
+actually wins at a given shape, so the winner must be MEASURED, cached, and
+dispatched from a table — never assumed. This module applies the identical
+pattern to the classical half's mirror of the qubit wall: at the reference's
+S=3 the run-all-trunks + gather path (``routing.select_expert``) is nearly
+free, but estimation FLOPs grow O(S), so somewhere past the paper's grid the
+capacity-bucketed sparse path (``routing.sparse_dispatch``) must take over.
+WHERE is an empirical property of the platform, the scenario count and the
+batch bucket — exactly what a ``(platform, S, bucket, dtype)``-keyed race
+answers.
+
+Contracts (mirroring the quantum dispatcher):
+
+- ``ensure_route()`` (the tuner) is HOST-side and eager: serve warmup calls
+  it per AOT bucket, the scenario-scaling bench per S point — never a traced
+  function, never the serve request path.
+- ``lookup()`` is read-only and cheap; any table pathology degrades to the
+  ``dense`` fallback (the S=3-correct default), never raises.
+- Eligibility windows bound what is worth timing: ``sparse`` only enters the
+  race at ``S >= SPARSE_MIN_SCENARIOS`` — below it the bucketing bookkeeping
+  cannot beat a 3-trunk fused pass, so the reference grid keeps its dense
+  path with ZERO tuning compiles (the exclusion is recorded in the entry, a
+  silent cap would read as "raced everything"). At eligible S the race is
+  real: dense must EARN the S=3 slot and sparse must PROVE the S>=16 one
+  (``results/scenario_scaling/`` is the committed proof).
+- The race times the ROUTING STAGE under balanced top-1 load (pred supplied,
+  ``i % S``): the classifier forward is identical in both candidates, and a
+  random-init classifier's degenerate argmax would force every sparse row
+  through the overflow fallback — measuring pathology, not dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable
+
+SCHEMA = 1
+DEFAULT_TABLE = os.path.join("results", "autotune", "routing_dispatch.json")
+ENV_TABLE = "QDML_ROUTING_AUTOTUNE_TABLE"
+
+# Below this scenario count the sparse path is not worth timing: S*C rows of
+# sparse trunk work ~= capacity_factor*B barely undercuts S*B while paying
+# rank/scatter/gather bookkeeping and a compiled-in fallback branch, and the
+# committed S=3 artifacts pin dense as the reference-grid winner. The window
+# keeps warmup at S=3 zero-extra-compile (only one eligible mode -> no race).
+SPARSE_MIN_SCENARIOS = 6
+
+_MODES = ("dense", "sparse")
+
+# In-process table cache: {abspath -> entries dict}; status mirrors
+# quantum.autotune ("ok"|"missing"|"corrupt"|"alien"|"unreadable").
+_CACHE: dict[str, dict] = {}
+_STATUS: dict[str, str] = {}
+_ACTIVE_PATH: str | None = None
+
+
+def set_table_path(path: str | None) -> None:
+    """Install (or clear) the process-wide routing-table location."""
+    global _ACTIVE_PATH
+    _ACTIVE_PATH = os.path.abspath(path) if path else None
+
+
+def table_path(path: str | None = None) -> str:
+    return os.path.abspath(
+        path or _ACTIVE_PATH or os.environ.get(ENV_TABLE) or DEFAULT_TABLE
+    )
+
+
+def table_key(
+    platform: str,
+    n_scenarios: int,
+    bucket: int,
+    dtype: str = "float32",
+    capacity_factor: float = 1.25,
+) -> str:
+    """Entry key. ``capacity_factor`` is part of the raced SHAPE, not
+    metadata: the sparse candidate does ~f·B rows of trunk work, so a winner
+    raced at f=1.25 says nothing about f=4.0 — a re-knobbed deployment must
+    re-race, never inherit a stale verdict."""
+    return f"{platform}/S{n_scenarios}/b{bucket}/f{capacity_factor:g}/{dtype}"
+
+
+def eligible_modes(n_scenarios: int) -> list[str]:
+    """Dispatch modes worth racing at this scenario count. ``dense`` always
+    (it is also the overflow fallback, so it must stay compiled-in anyway);
+    ``sparse`` from :data:`SPARSE_MIN_SCENARIOS` up."""
+    modes = ["dense"]
+    if n_scenarios >= SPARSE_MIN_SCENARIOS:
+        modes.append("sparse")
+    return modes
+
+
+def load_table(path: str | None = None) -> dict:
+    """entries dict; {} on missing/corrupt/alien — a broken table degrades to
+    dense, never raises (same contract as the quantum dispatcher)."""
+    p = table_path(path)
+    if p in _CACHE:
+        return _CACHE[p]
+    entries: dict = {}
+    status = "ok"
+    try:
+        with open(p) as fh:
+            data = json.load(fh)
+        if isinstance(data, dict) and isinstance(data.get("entries"), dict):
+            entries = data["entries"]
+        else:
+            status = "alien"
+    except FileNotFoundError:
+        status = "missing"
+    except json.JSONDecodeError:
+        status = "corrupt"
+    except OSError:
+        status = "unreadable"
+    except (ValueError, TypeError):
+        status = "corrupt"
+    _CACHE[p] = entries
+    _STATUS[p] = status
+    return entries
+
+
+def table_status(path: str | None = None) -> str:
+    load_table(path)
+    return _STATUS.get(table_path(path), "ok")
+
+
+def save_table(entries: dict, path: str | None = None) -> str:
+    """Atomically persist the manifest-headed table; best-effort (serving
+    must survive a read-only results dir)."""
+    p = table_path(path)
+    from qdml_tpu.telemetry import run_manifest
+
+    payload = {
+        "schema": SCHEMA,
+        "kind": "routing_dispatch_table",
+        "manifest": run_manifest(argv=["ops.dispatch_autotune"], include_jax=True),
+        "entries": entries,
+    }
+    try:
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, p)
+    except OSError:
+        pass
+    _CACHE[p] = entries
+    _STATUS[p] = "ok"
+    return p
+
+
+def invalidate_cache() -> None:
+    _CACHE.clear()
+    _STATUS.clear()
+    set_table_path(None)
+
+
+def lookup(
+    n_scenarios: int,
+    batch: int,
+    dtype: str = "float32",
+    path: str | None = None,
+    capacity_factor: float = 1.25,
+) -> str | None:
+    """The tuned dispatch mode for this shape, or ``None`` (caller falls back
+    to dense). Never raises, never benchmarks — safe anywhere."""
+    try:
+        import jax
+
+        from qdml_tpu.quantum.autotune import batch_bucket
+
+        entries = load_table(path)
+        entry = entries.get(
+            table_key(
+                jax.default_backend(),
+                n_scenarios,
+                batch_bucket(batch),
+                dtype,
+                capacity_factor,
+            )
+        )
+        if not isinstance(entry, dict):
+            return None
+        sel = entry.get("best_infer")
+        if sel not in _MODES:
+            return None
+        if sel == "sparse" and n_scenarios < SPARSE_MIN_SCENARIOS:
+            # an alien/hand-edited entry cannot force sparse below its window
+            return None
+        return sel
+    except Exception:  # lint: disable=broad-except(dispatch lookup must degrade to the dense fallback on ANY table pathology — tuning can speed routing up, never crash it)
+        return None
+
+
+def route_candidates(
+    apply_trunks: Callable,
+    x,
+    n_scenarios: int,
+    capacity_factor: float,
+) -> dict[str, tuple[Callable, tuple]]:
+    """Build the two routing-stage candidates at this exact shape.
+
+    ``apply_trunks``: ``(S, B', *feat) -> (S, B', D)`` — the stacked
+    trunk+head apply with params closed over (the serve engine passes its
+    live checkpoint; the bench a random init — routing cost is architecture-
+    dependent, not weight-dependent). Both candidates consume the SAME
+    balanced top-1 ``pred = i % S``: the load under which capacity buckets
+    fill evenly, i.e. the steady state the capacity factor is sized for.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from qdml_tpu.ops.routing import select_expert, sparse_dispatch
+
+    s = int(n_scenarios)
+    pred = jnp.arange(x.shape[0], dtype=jnp.int32) % s
+
+    def _dense(xx, pp):
+        xs = jnp.broadcast_to(xx[None], (s,) + xx.shape)
+        return select_expert(apply_trunks(xs), pp)
+
+    def _sparse(xx, pp):
+        out, _ = sparse_dispatch(
+            apply_trunks, _dense, xx, pp, s, capacity_factor
+        )
+        return out
+
+    return {
+        "dense": (jax.jit(_dense), (x, pred)),
+        "sparse": (jax.jit(_sparse), (x, pred)),
+    }
+
+
+def measure(
+    candidates: dict[str, tuple[Callable, tuple]],
+    budget_s: float = 0.2,
+    max_reps: int = 30,
+) -> dict[str, dict[str, Any]]:
+    """Median-of-reps wall ms per candidate (the quantum tuner's timer — the
+    two races must be comparable measurements). A candidate that fails to
+    compile/run is recorded with its error and excluded from selection."""
+    from qdml_tpu.quantum.autotune import _time_callable
+
+    out: dict[str, dict[str, Any]] = {}
+    for mode, (fn, args) in candidates.items():
+        rec: dict[str, Any] = {}
+        try:
+            rec["infer_ms"] = round(_time_callable(fn, args, budget_s, max_reps), 4)
+        except Exception as e:  # lint: disable=broad-except(candidate isolation: one mode failing to compile/run must not kill tuning for the other; the error is recorded in the table)
+            rec["error"] = f"{type(e).__name__}: {e}"
+        out[mode] = rec
+    return out
+
+
+def ensure_route(
+    apply_trunks: Callable,
+    x,
+    n_scenarios: int,
+    capacity_factor: float = 1.25,
+    dtype: str = "float32",
+    path: str | None = None,
+    force: bool = False,
+    budget_s: float = 0.2,
+) -> dict:
+    """Return this shape's table entry, racing and persisting it first if
+    absent (or ``force``). With only one eligible mode NOTHING is timed —
+    the entry records the winner-by-window with the exclusion reason, and
+    the S=3 path stays zero-extra-compile."""
+    import jax
+
+    from qdml_tpu.quantum.autotune import batch_bucket
+
+    platform = jax.default_backend()
+    bucket = batch_bucket(x.shape[0])
+    key = table_key(platform, n_scenarios, bucket, dtype, capacity_factor)
+    entries = dict(load_table(path))
+    entry = entries.get(key)
+    if not force and isinstance(entry, dict) and entry.get("best_infer"):
+        return entry
+    modes = eligible_modes(n_scenarios)
+    excluded = []
+    if "sparse" not in modes:
+        excluded.append(
+            {
+                "mode": "sparse",
+                "reason": (
+                    f"S={n_scenarios} < {SPARSE_MIN_SCENARIOS}: bucketing "
+                    "bookkeeping cannot beat a fused all-trunks pass this "
+                    "small (eligibility window, docs/SERVING.md)"
+                ),
+            }
+        )
+    raced = len(modes) > 1
+    if not raced:
+        cands: dict[str, dict[str, Any]] = {modes[0]: {"only_candidate": True}}
+        best = modes[0]
+    else:
+        all_c = route_candidates(apply_trunks, x, n_scenarios, capacity_factor)
+        cands = measure({m: all_c[m] for m in modes}, budget_s=budget_s)
+        timed = {
+            m: v["infer_ms"]
+            for m, v in cands.items()
+            if isinstance(v.get("infer_ms"), (int, float))
+        }
+        best = min(timed, key=timed.get) if timed else "dense"
+    entry = {
+        "key": key,
+        "platform": platform,
+        "n_scenarios": int(n_scenarios),
+        "batch_bucket": bucket,
+        "dtype": dtype,
+        "capacity_factor": float(capacity_factor),
+        "candidates": cands,
+        "best_infer": best,
+        "ts": round(time.time(), 3),
+    }
+    if excluded:
+        entry["excluded"] = excluded
+    if raced:
+        # window-only decisions carry no timings worth caching — persisting
+        # them would turn every reference-grid warmup (tests included) into
+        # a table write; the entry is still returned for the warmup record
+        entries[key] = entry
+        save_table(entries, path)
+    return entry

@@ -3,15 +3,39 @@
 The reference's evaluation partitions each batch by the classifier's PREDICTED
 scenario and feeds each partition through the matching ``Conv_P128`` trunk with
 Python-level boolean indexing (``Test.py:167-214``) — data-dependent control
-flow that would force host sync under XLA. The TPU-native expression (SURVEY.md
-§3.3, §7.3): run ALL trunks on the full batch (they are tiny and the stacked
-trunk is one batched conv) and gather each sample's row by its predicted id —
-a pure ``take_along_axis``, i.e. MoE-style hard routing with S=3 experts and
-top-1 dispatch.
+flow that would force host sync under XLA. Two TPU-native expressions live
+here, and WHICH one runs is the autotune dispatcher's measured decision
+(:mod:`qdml_tpu.ops.dispatch_autotune`), never a heuristic:
+
+- **dense**: run ALL trunks on the full batch (the stacked trunk is one
+  batched conv) and gather each sample's row by its predicted id — a pure
+  ``take_along_axis``, MoE-style hard routing with top-1 dispatch
+  (:func:`select_expert`). At the reference's S=3 the all-trunks pass is
+  nearly free and the zero-bookkeeping gather wins the race.
+- **sparse** (:func:`sparse_dispatch`): at S≫3 the dense pass stops being
+  viable — estimation FLOPs grow O(S) while useful work stays O(1), so at
+  S=64 it burns ~64x the compute it returns. The sparse path packs the batch
+  into fixed-capacity per-expert buckets (static shapes — a ``capacity_factor``
+  knob sizes them), runs ONLY the chosen trunk per bucket through the same
+  stacked-conv vmap, and unsorts. Work drops from ``S*B`` trunk-rows to
+  ``~capacity_factor*B`` regardless of S. Overflow rows (an expert offered
+  more rows than its bucket holds) are NEVER dropped: a ``lax.cond`` falls
+  back to the dense gather for exactly those rows, so the result is
+  value-equivalent to :func:`select_expert` on every path (pinned in
+  ``tests/test_routing_sparse.py``).
+
+All bookkeeping is shape-static (one-hot cumsum ranks + scatter/gather into a
+``(S, C)`` bucket tensor with a trash slot) — no ``jnp.nonzero`` / boolean
+masking / data-dependent shapes, the hazard class graftlint's
+``data-dependent-shape-in-jit`` rule exists to keep out of jitted hot paths.
 """
 
 from __future__ import annotations
 
+import math
+from typing import Callable
+
+import jax
 import jax.numpy as jnp
 
 
@@ -45,3 +69,103 @@ def one_hot_dispatch(stacked: jnp.ndarray, log_probs: jnp.ndarray) -> jnp.ndarra
         jnp.arange(stacked.shape[0])[:, None], pred[None, :]
     ).astype(stacked.dtype)  # (S, B)
     return jnp.einsum("sb,sbd->bd", onehot, stacked)
+
+
+# ---------------------------------------------------------------------------
+# Capacity-bucketed sparse top-1 dispatch
+# ---------------------------------------------------------------------------
+
+
+def expert_capacity(batch: int, n_experts: int, capacity_factor: float) -> int:
+    """Static per-expert bucket size: ``ceil(B * f / S)`` clamped to
+    ``[1, B]``. Total sparse trunk work is ``S * C ~= f * B`` rows — the
+    O(S)-to-O(1) reduction the sparse path exists for. ``f`` trades compute
+    headroom for overflow-fallback frequency under skewed routing."""
+    c = math.ceil(batch * float(capacity_factor) / max(1, int(n_experts)))
+    return max(1, min(int(c), int(batch)))
+
+
+def bucket_ranks(
+    pred: jnp.ndarray, n_experts: int, valid: jnp.ndarray | None = None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(clipped ids, within-expert arrival rank) for each row — the static
+    routing plan. Rank is the row's 0-based position among SAME-expert rows
+    in batch order (one-hot cumsum: O(B*S) int work, no sort, no
+    data-dependent shape). Rows with ``valid=False`` (padding) consume no
+    rank: their one-hot column is zeroed, so a padded batch packs its real
+    rows exactly like the unpadded batch would (padded-batch invariance)."""
+    pred_c = jnp.clip(pred.astype(jnp.int32), 0, n_experts - 1)
+    onehot = (pred_c[:, None] == jnp.arange(n_experts)[None, :]).astype(jnp.int32)
+    if valid is not None:
+        onehot = onehot * valid.astype(jnp.int32)[:, None]
+    rank = jnp.take_along_axis(
+        jnp.cumsum(onehot, axis=0) - 1, pred_c[:, None], axis=1
+    )[:, 0]
+    return pred_c, rank
+
+
+def sparse_dispatch(
+    run_experts: Callable[[jnp.ndarray], jnp.ndarray],
+    dense_fallback: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    x: jnp.ndarray,
+    pred: jnp.ndarray,
+    n_experts: int,
+    capacity_factor: float = 1.25,
+    valid: jnp.ndarray | None = None,
+    capacity: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Capacity-bucketed sparse top-1 dispatch, value-equivalent to
+    ``select_expert(all-trunks, pred)`` with ~``capacity_factor/S`` of its
+    trunk work.
+
+    ``run_experts``: ``(S, C, *feat) -> (S, C, D)`` — expert s applied to its
+    bucket rows only (the stacked-conv vmap on gathered buckets instead of a
+    broadcast batch). ``dense_fallback``: ``(x, pred) -> (B, D)`` — the
+    run-all-trunks + gather path, entered through ONE ``lax.cond`` only when
+    overflow actually occurred, so balanced traffic never pays it.
+    ``valid``: optional (B,) bool — padding rows consume no bucket capacity
+    and their (garbage) outputs are the caller's to slice off. ``capacity``
+    overrides the :func:`expert_capacity` default (which sizes off
+    ``x.shape[0]`` — i.e. off the PADDED bucket in the serve engine, where
+    the bucket size is the compiled static shape).
+
+    Returns ``(out (B, D), overflow (i32 scalar))`` where ``overflow`` counts
+    the valid rows served by the fallback. Mechanics, all shape-static:
+
+    1. rank rows within their predicted expert (:func:`bucket_ranks`);
+    2. scatter row i to flat slot ``pred[i]*C + rank[i]`` when ``rank < C``,
+       else to a trash slot — the bucket tensor is ``(S*C + 1, ...)`` so
+       overflow/padding rows can never corrupt a real bucket entry;
+    3. ``run_experts`` on the ``(S, C, ...)`` buckets; gather each row's
+       output back from its slot;
+    4. overflow rows take the ``dense_fallback`` value via ``jnp.where`` —
+       never dropped, bit-identical to the dense path (it IS the dense path).
+    """
+    b = x.shape[0]
+    s = int(n_experts)
+    c = capacity if capacity is not None else expert_capacity(b, s, capacity_factor)
+    pred_c, rank = bucket_ranks(pred, s, valid=valid)
+    fits = rank < c
+    if valid is not None:
+        fits = fits & valid
+    # flat slot per row; the trash slot s*c absorbs overflow AND padding
+    slot = jnp.where(fits, pred_c * c + rank, s * c)
+    buckets = jnp.zeros((s * c + 1,) + x.shape[1:], x.dtype).at[slot].set(x)
+    out_sc = run_experts(buckets[: s * c].reshape(s, c, *x.shape[1:]))
+    out_flat = out_sc.reshape(s * c, out_sc.shape[-1])
+    routed = jnp.take(out_flat, jnp.minimum(slot, s * c - 1), axis=0)
+    overflow = jnp.sum(
+        (~fits) if valid is None else ((~fits) & valid), dtype=jnp.int32
+    )
+
+    def _fallback(operand):
+        xx, pp = operand
+        return dense_fallback(xx, pp)
+
+    def _skip(operand):
+        return jnp.zeros_like(routed)
+
+    # traced both ways, EXECUTED only on overflow: the rare skewed batch pays
+    # the dense pass; the steady state pays one predicate
+    dense_out = jax.lax.cond(overflow > 0, _fallback, _skip, (x, pred_c))
+    return jnp.where(fits[:, None], routed, dense_out), overflow

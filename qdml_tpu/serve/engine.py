@@ -2,10 +2,14 @@
 compiles — sharded over the mesh, hot-swappable under live traffic.
 
 The online pipeline is the eval sweep's forward (``eval/sweep.py``) stripped
-to its serving core: scenario classifier -> argmax -> run ALL stacked
-``ConvP128`` trunks + shared ``FCP128`` head on the batch ->
-:func:`~qdml_tpu.ops.routing.select_expert` gather — MoE-style top-1 dispatch
-with no host round trip, one jitted function end to end.
+to its serving core: scenario classifier -> argmax -> expert trunks + shared
+``FCP128`` head -> top-1 route, one jitted function end to end with no host
+round trip. HOW the experts run is the measured dispatcher's per-bucket
+choice (``ops/dispatch_autotune.py``): dense (all trunks on the batch +
+:func:`~qdml_tpu.ops.routing.select_expert` gather — the S=3 winner) or
+capacity-bucketed sparse (:func:`~qdml_tpu.ops.routing.sparse_dispatch` —
+only the chosen trunk per bucket, the S≫3 winner; overflow rows fall back to
+the dense gather in-program, never dropped).
 
 Compilation is amortized entirely into :meth:`ServeEngine.warmup` (the
 Qandle gate-matrix-caching argument applied to XLA executables): every batch
@@ -58,7 +62,8 @@ from jax.sharding import PartitionSpec as P
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.models.cnn import SCP128
 from qdml_tpu.models.qsc import QSCP128
-from qdml_tpu.ops.routing import select_expert
+from qdml_tpu.ops import dispatch_autotune
+from qdml_tpu.ops.routing import select_expert, sparse_dispatch
 from qdml_tpu.serve.batcher import pick_bucket, power_of_two_buckets
 from qdml_tpu.telemetry import span
 from qdml_tpu.telemetry import cost as _cost
@@ -140,6 +145,23 @@ class ServeEngine:
         # AOT executable dispatches (autotuned at warmup — docs/QUANTUM.md),
         # plus the candidate timings when the tuner actually ran
         self.quantum_impl: dict[str, Any] = {}
+        # expert-routing dispatch per bucket ("dense" | "sparse") and the
+        # measured race entry behind each choice — warmup fills them exactly
+        # like quantum_impl (serve.dispatch "auto" -> dispatch_autotune race;
+        # an explicit mode is forced into every bucket, race skipped)
+        if cfg.serve.dispatch not in ("auto", "dense", "sparse"):
+            raise ValueError(
+                f"serve.dispatch must be auto|dense|sparse, got {cfg.serve.dispatch!r}"
+            )
+        self.dispatch_mode: dict[str, str] = {}
+        self.dispatch_race: dict[str, Any] = {}
+        # sparse-overflow accounting across worker threads (overflow rows are
+        # served by the dense fallback, never dropped — the RATE is the
+        # capacity_factor health signal serve_summary reports and the report
+        # gate watches)
+        self._dispatch_lock = threading.Lock()
+        self._overflow_rows = 0
+        self._routed_rows = 0
 
     # -- placement / sharding ------------------------------------------------
 
@@ -360,6 +382,71 @@ class ServeEngine:
         est_all = self.hdce.apply(hdce_vars, xs, train=False)  # (S, B, D)
         return select_expert(est_all, pred), pred
 
+    def _apply_trunks(self, hdce_vars: dict, xs: jnp.ndarray) -> jnp.ndarray:
+        """Stacked trunks+head on per-scenario inputs ``(S, B', ...) ->
+        (S, B', D)`` — the one sub-forward both dispatch modes share. With
+        expert sharding the leading axis pins to ``fed`` exactly like the
+        eval sweep's placement, so capacity buckets compose with the PR-7
+        mesh layout (bucket s's rows live with trunk s's weights)."""
+        if self.mesh is not None and self.cfg.serve.expert_sharding:
+            s = self.cfg.data.n_scenarios
+            fed = "fed" if self.mesh.shape.get("fed", 1) == s else None
+            xs = jax.lax.with_sharding_constraint(
+                xs,
+                NamedSharding(self.mesh, P(fed, *(None,) * (xs.ndim - 1))),
+            )
+        return self.hdce.apply(hdce_vars, xs, train=False)
+
+    def _forward_sparse(
+        self, hdce_vars: dict, clf_vars: dict, x: jnp.ndarray, n_valid: jnp.ndarray
+    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Capacity-bucketed twin of :meth:`_forward`: classify -> pack rows
+        into per-expert buckets -> run ONLY the chosen trunk per bucket ->
+        unsort (``routing.sparse_dispatch``). ``n_valid`` masks the zero-pad
+        tail out of bucket capacity (padding must not inflate overflow).
+        Returns ``(h, pred, overflow)`` — overflow rows were served by the
+        dense fallback inside the same program, never dropped."""
+        s = self.cfg.data.n_scenarios
+        logp = self.clf.apply(clf_vars, x, train=False)
+        pred = jnp.argmax(logp, -1)
+        valid = jnp.arange(x.shape[0]) < n_valid
+
+        def dense_fb(xb, predb):
+            xs = jnp.broadcast_to(xb[None], (s,) + xb.shape)
+            return select_expert(self._apply_trunks(hdce_vars, xs), predb)
+
+        h, overflow = sparse_dispatch(
+            lambda buckets: self._apply_trunks(hdce_vars, buckets),
+            dense_fb,
+            x,
+            pred,
+            s,
+            self.cfg.serve.capacity_factor,
+            valid=valid,
+        )
+        return h, pred, overflow
+
+    def _bucket_dispatch(self, b: int) -> str:
+        """Resolve bucket ``b``'s routing dispatch at warmup time: a forced
+        ``serve.dispatch`` wins outright; ``auto`` is the measured race
+        (``dispatch_autotune.ensure_route`` — table-cached per (platform, S,
+        bucket), so repeat warmups read, not re-time). With only one eligible
+        mode (S below the sparse window) nothing is timed and the reference
+        grid keeps its zero-extra-compile warmup."""
+        mode = self.cfg.serve.dispatch
+        if mode != "auto":
+            self.dispatch_race[str(b)] = {"forced": mode}
+            return mode
+        hdce_live, _ = self.live_vars()
+        entry = dispatch_autotune.ensure_route(
+            lambda xs: self._apply_trunks(hdce_live, xs),
+            jnp.zeros((b, *self.cfg.image_hw, 2), jnp.float32),
+            self.cfg.data.n_scenarios,
+            capacity_factor=self.cfg.serve.capacity_factor,
+        )
+        self.dispatch_race[str(b)] = entry
+        return entry.get("best_infer") or "dense"
+
     def offline_forward(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """The parity reference: the same fused forward jitted at the natural
         (unpadded, unbucketed) batch shape — numerically the offline eval
@@ -386,13 +473,11 @@ class ServeEngine:
         # program, so the request path still never compiles. OFF compiles
         # exactly the unwrapped program (byte-identical to the unflagged
         # build; pinned in tests/test_analysis.py).
-        fwd = self._forward
+        _checkify = checks = None
         if self._checkify:
             from jax.experimental import checkify as _checkify
 
             from qdml_tpu.telemetry.sanitizer import checks
-
-            fwd = _checkify.checkify(self._forward, errors=checks())
         hdce_live, clf_live = self.live_vars()
         var_specs = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
@@ -423,7 +508,26 @@ class ServeEngine:
                         rec_impl["autotuned"] = True
                         rec_impl["candidates"] = entry["candidates"]
                     self.quantum_impl[str(b)] = rec_impl
+                # the routing dispatch is decided here — measured (auto) or
+                # forced — and BAKED into the bucket's executable exactly
+                # like the sharding and the autotuned circuit impl; the
+                # race's own jits land inside the warmup compile window
+                mode = self._bucket_dispatch(b)
+                self.dispatch_mode[str(b)] = mode
+                base_fwd = self._forward_sparse if mode == "sparse" else self._forward
+                fwd = (
+                    _checkify.checkify(base_fwd, errors=checks())
+                    if self._checkify
+                    else base_fwd
+                )
                 x_spec = jax.ShapeDtypeStruct((b, *hw, 2), jnp.float32)
+                specs: list[Any] = [*var_specs, x_spec]
+                args: list[Any] = [hdce_live, clf_live, np.zeros((b, *hw, 2), np.float32)]
+                if mode == "sparse":
+                    # the valid-row count rides as a traced scalar, so one
+                    # executable serves every fill level of the bucket
+                    specs.append(jax.ShapeDtypeStruct((), jnp.int32))
+                    args.append(np.int32(b))
                 jit_kwargs: dict[str, Any] = {}
                 x_sh = self._x_sharding(b)
                 if x_sh is not None:
@@ -431,15 +535,19 @@ class ServeEngine:
                     # the autotuned impl: batch over `data` when it divides,
                     # params per the placement trees — one SPMD program per
                     # bucket, collectives on ICI, nothing decided per request
-                    jit_kwargs["in_shardings"] = (*self._var_shardings, x_sh)
+                    shardings: tuple = (*self._var_shardings, x_sh)
+                    if mode == "sparse":
+                        shardings = (*shardings, NamedSharding(self.mesh, P()))
+                    jit_kwargs["in_shardings"] = shardings
                     self.bucket_sharding[str(b)] = (
                         "data" if x_sh.spec else "replicated"
                     )
-                compiled = jax.jit(fwd, **jit_kwargs).lower(*var_specs, x_spec).compile()
+                compiled = jax.jit(fwd, **jit_kwargs).lower(*specs).compile()
                 # first execute outside the request path (XLA may lazily
                 # finalize; also faults in the params transfer)
-                out = compiled(hdce_live, clf_live, np.zeros((b, *hw, 2), np.float32))
-                h, pred = out[1] if self._checkify else out
+                out = compiled(*args)
+                res = out[1] if self._checkify else out
+                h, pred = res[0], res[1]
                 jax.block_until_ready((h, pred))
                 self._compiled[b] = compiled
                 # XLA cost accounting straight off the AOT executable (the
@@ -461,6 +569,11 @@ class ServeEngine:
             "buckets": self.buckets,
             "compile": {k: post[k] - pre.get(k, 0) for k in post},
             "cost": self.bucket_cost,
+            "dispatch": {
+                "mode": dict(self.dispatch_mode),
+                "capacity_factor": float(self.cfg.serve.capacity_factor),
+                "race": self.dispatch_race,
+            },
         }
         if self.mesh is not None:
             out["mesh"] = self.mesh_topology()
@@ -468,6 +581,25 @@ class ServeEngine:
         if self.quantum_impl:
             out["quantum_impl"] = self.quantum_impl
         return out
+
+    def dispatch_summary(self) -> dict:
+        """The serve_summary ``dispatch`` block: per-bucket routing modes
+        (collapsed to one word when uniform), the capacity factor, and the
+        observed sparse overflow-fallback rate over everything served so far
+        (``None`` until a sparse batch has been routed — a rate over zero
+        rows would read as perfect health that was never measured)."""
+        modes = set(self.dispatch_mode.values())
+        mode = modes.pop() if len(modes) == 1 else ("mixed" if modes else "dense")
+        with self._dispatch_lock:
+            routed, overflow = self._routed_rows, self._overflow_rows
+        return {
+            "mode": mode,
+            "per_bucket": dict(self.dispatch_mode),
+            "capacity_factor": float(self.cfg.serve.capacity_factor),
+            "overflow_rows": overflow,
+            "routed_rows": routed,
+            "overflow_rate": round(overflow / routed, 6) if routed else None,
+        }
 
     def request_path_compiles(self) -> dict:
         """Compile-cache counter deltas since warmup ended — all-zero iff
@@ -507,9 +639,14 @@ class ServeEngine:
         # one atomic read of the live checkpoint per batch: a swap that lands
         # mid-batch applies to the NEXT dequeue, never tears this one
         hdce_live, clf_live = self.live_vars()
-        out = self._compiled[b](hdce_live, clf_live, xp)
+        mode = self.dispatch_mode.get(str(b), "dense")
+        if mode == "sparse":
+            out = self._compiled[b](hdce_live, clf_live, xp, np.int32(n))
+        else:
+            out = self._compiled[b](hdce_live, clf_live, xp)
+        overflow = None
         if self._checkify:
-            err, (h, pred) = out
+            err, res = out
             # per-batch device->host error fetch: the sanitizer's contract
             # (out of host-sync-hot-path's sight — `.get` is far too generic
             # an attribute to track; the rule audits the unconditional syncs)
@@ -525,7 +662,19 @@ class ServeEngine:
                     "checkify",
                 )
         else:
-            h, pred = out
+            res = out
+        if mode == "sparse":
+            h, pred, overflow = res
+        else:
+            h, pred = res
+        if overflow is not None:
+            # overflow rides the same result fetch cadence (a 4-byte scalar
+            # next to the reply arrays) — the capacity-factor health signal
+            # serve_summary reports per window
+            ovf = int(np.asarray(jax.device_get(overflow)))  # lint: disable=host-sync-hot-path(4-byte overflow counter fetched with the reply it annotates — same dispatch, no extra stall)
+            with self._dispatch_lock:
+                self._overflow_rows += ovf
+                self._routed_rows += n
         return (
             np.asarray(jax.device_get(h))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
             np.asarray(jax.device_get(pred))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)

@@ -262,6 +262,13 @@ def run_loadgen(
         replicas=pool.n_replicas,
         workers=pool.workers,
         mesh=engine.mesh_topology(),
+        # scenario scale-out facts: how many expert families this fleet
+        # serves, which routing dispatch the race baked into the buckets,
+        # and the observed sparse overflow-fallback rate (the report gates
+        # a rate regression — a capacity factor sized for yesterday's
+        # traffic skew is a silent O(S) compute leak)
+        n_scenarios=cfg.data.n_scenarios,
+        dispatch=engine.dispatch_summary(),
         bucket_sharding=engine.bucket_sharding or None,
         warmup=warm,
         server_metrics=live_slim,

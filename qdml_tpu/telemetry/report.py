@@ -104,6 +104,9 @@ def _serving_from(obj: dict) -> dict | None:
         "platform": obj.get("platform"),
         "slo_attainment": None,
         "fleet": None,
+        "n_scenarios": None,
+        "dispatch": None,
+        "overflow_rate": None,
     }
     lat = obj.get("latency_ms") or {}
     for key in ("p50_ms", "p95_ms", "p99_ms"):
@@ -125,6 +128,20 @@ def _serving_from(obj: dict) -> dict | None:
     if isinstance(obj.get("rps_per_replica"), (int, float)):
         fleet["rps_per_replica"] = float(obj["rps_per_replica"])
     out["fleet"] = fleet or None
+    # scenario scale-out facts (sparse-dispatch PR): expert-family count,
+    # the routing mode the warmup race baked in, and the sparse
+    # overflow-fallback rate — a rising rate is an O(S) compute leak the
+    # gate must catch even while rps still looks healthy
+    if isinstance(obj.get("n_scenarios"), int):
+        out["n_scenarios"] = obj["n_scenarios"]
+    disp = obj.get("dispatch")
+    if isinstance(disp, dict):
+        out["dispatch"] = {
+            "mode": disp.get("mode"),
+            "capacity_factor": disp.get("capacity_factor"),
+        }
+        if isinstance(disp.get("overflow_rate"), (int, float)):
+            out["overflow_rate"] = float(disp["overflow_rate"])
     return out
 
 
@@ -144,6 +161,7 @@ def extract(path: str) -> dict:
         "host_transfers": {},
         "platform": None,
         "qsc_scaling": None,
+        "scenario_scaling": None,
     }
     for obj in _iter_objs(path):
         if not isinstance(obj, dict):
@@ -197,6 +215,22 @@ def extract(path: str) -> dict:
                     ):
                         nk = f"qsc_scaling.n{int(p['n_qubits']):02d}"
                         src["throughput"][f"{nk}.best_of_impls"] = float(
+                            p["samples_per_sec"]
+                        )
+                continue
+            if key == "scenario_scaling" and isinstance(d.get("points"), list):
+                # The scenario-scaling axis, gated exactly like the qubit
+                # one: each point's measured number is already
+                # best-of-dispatch AT THAT S (the routing race timed the
+                # loser too), so every S-bucket gates as its own metric —
+                # S=64 regressing cannot hide behind S=3 improving.
+                src["scenario_scaling"] = d
+                for p in d["points"]:
+                    if isinstance(p, dict) and isinstance(
+                        p.get("samples_per_sec"), (int, float)
+                    ):
+                        sk = f"scenario_scaling.s{int(p['n_scenarios']):02d}"
+                        src["throughput"][f"{sk}.best_of_dispatch"] = float(
                             p["samples_per_sec"]
                         )
                 continue
@@ -271,6 +305,12 @@ def _cost_deltas(base_cost: dict, cur_cost: dict) -> dict | None:
 # flagged "program change" — the regression may be MORE work, not slower
 # execution of the same work.
 PROGRAM_CHANGE_PCT = 1.0
+
+# Absolute slack on the sparse-dispatch overflow-fallback rate (fraction of
+# routed rows): healthy runs sit at/near 0.0, so the gate compares absolute
+# rates, not ratios — 2 points of new overflow is a capacity-factor misfit
+# worth failing on, whatever the baseline was.
+OVERFLOW_RATE_SLACK = 0.02
 
 
 def _lint_gate(lint_path: str | None) -> dict | None:
@@ -544,7 +584,8 @@ def build_report_data(
         # device and 4 replicas on 8 is scale-out, not speed-up — name the
         # topologies so the aggregate-rps gate reads attributably
         def _fleet_str(src):
-            f = (src.get("serving") or {}).get("fleet")
+            serving = src.get("serving") or {}
+            f = serving.get("fleet")
             if not f:
                 return None
             topo = [f"{f.get('replicas', '?')} replica(s)"]
@@ -553,6 +594,16 @@ def build_report_data(
             s = " x ".join(topo)
             if f.get("rps_per_replica") is not None:
                 s += f" ({f['rps_per_replica']:g} rps/replica)"
+            # scenario scale-out facts ride the fleet line: expert-family
+            # count, which routing dispatch the race baked in, and the
+            # sparse overflow-fallback rate when one was measured
+            if serving.get("n_scenarios") is not None:
+                s += f", S={serving['n_scenarios']}"
+            disp = serving.get("dispatch")
+            if disp and disp.get("mode"):
+                s += f" {disp['mode']}-dispatch"
+                if serving.get("overflow_rate") is not None:
+                    s += f" (overflow {serving['overflow_rate']:.2%})"
             return s
 
         base_fleet = _fleet_str(base)
@@ -661,6 +712,53 @@ def build_report_data(
                 f"- serving SLO attainment: {b_slo:g} -> {c_slo:g} "
                 + (f"({delta_pct:+.1f}%) " if delta_pct is not None else "")
                 + f"{status_md}"
+            )
+
+    # Sparse-dispatch overflow gate: the fraction of routed rows the
+    # capacity buckets could NOT hold (served by the dense fallback — never
+    # dropped, but each one is O(S) compute for O(1) work). An ABSOLUTE
+    # comparison, not a ratio: healthy baselines sit at/near 0.0 where a
+    # relative delta is undefined or explosive. Regression when the current
+    # rate exceeds the baseline by more than OVERFLOW_RATE_SLACK — the
+    # capacity factor no longer fits the traffic skew.
+    b_ovf = (base.get("serving") or {}).get("overflow_rate")
+    c_ovf = None
+    for c_src in curs:
+        v = (c_src.get("serving") or {}).get("overflow_rate")
+        if v is not None:
+            c_ovf = v
+    if b_ovf is not None or c_ovf is not None:
+        if b_ovf is None or c_ovf is None:
+            only = "current-only" if b_ovf is None else "baseline-only"
+            gates.append(
+                {"metric": "serve.overflow_rate", "kind": "dispatch",
+                 "baseline": b_ovf, "current": c_ovf, "delta_pct": None,
+                 "status": only}
+            )
+            lines.append(
+                f"- sparse-dispatch overflow rate: "
+                f"{'—' if b_ovf is None else f'{b_ovf:g}'} -> "
+                f"{'—' if c_ovf is None else f'{c_ovf:g}'} ({only})"
+            )
+        else:
+            if c_ovf > b_ovf + OVERFLOW_RATE_SLACK:
+                status_key, status_md = "regression", "**REGRESSION**"
+                regressions.append(
+                    {"metric": "serve.overflow_rate", "baseline": b_ovf,
+                     "current": c_ovf, "delta_pct": None}
+                )
+            elif c_ovf < b_ovf - OVERFLOW_RATE_SLACK:
+                status_key = status_md = "improved"
+            else:
+                status_key = status_md = "ok"
+            gates.append(
+                {"metric": "serve.overflow_rate", "kind": "dispatch",
+                 "baseline": b_ovf, "current": c_ovf, "delta_pct": None,
+                 "status": status_key}
+            )
+            lines.append(
+                f"- sparse-dispatch overflow rate: {b_ovf:g} -> {c_ovf:g} "
+                f"{status_md}"
             )
 
     # Roofline section: achieved-vs-roofline fraction per train sub-bench
@@ -777,6 +875,66 @@ def build_report_data(
             lines.append(
                 f"| {n} | {impl} | {chi} | {p.get('batch', '—')} | "
                 f"{sps if sps is not None else '—'} | {vs_next} | {agree} |"
+            )
+
+    # Scenario-scaling section: the S=3..64 axis (bench.py --scenario-scaling
+    # / scripts/scenario_scaling_sweep.py). The per-S GATES already sit in
+    # the throughput table (scenario_scaling.sNN.best_of_dispatch — each
+    # point is the routing race's measured winner at that S); this section is
+    # the human-facing crossover view: which dispatch won each S, at what
+    # capacity, and what it beat.
+    cur_sscaling = next(
+        (
+            c.get("scenario_scaling")
+            for c in reversed(curs)
+            if c.get("scenario_scaling")
+        ),
+        None,
+    )
+    if cur_sscaling is not None:
+        pts = [p for p in cur_sscaling.get("points", []) if isinstance(p, dict)]
+        lines += [
+            "",
+            "## scenario scaling (best-of-dispatch per S)",
+            "",
+            f"- platform {cur_sscaling.get('platform', '?')}, capacity factor "
+            f"{cur_sscaling.get('capacity_factor', '?')}",
+            "",
+            "| S | dispatch | capacity | batch | rows/s | vs other | agreement |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for p in sorted(pts, key=lambda p: p.get("n_scenarios", 0)):
+            s_n = p.get("n_scenarios", "?")
+            if "error" in p and "samples_per_sec" not in p:
+                lines.append(f"| {s_n} | — | — | — | — | — | error: {p['error']} |")
+                continue
+            mode = p.get("dispatch", "?")
+            cands = p.get("candidates") or {}
+            timed = {
+                k: v["infer_ms"]
+                for k, v in cands.items()
+                if isinstance(v, dict)
+                and isinstance(v.get("infer_ms"), (int, float))
+                and k != mode
+            }
+            if timed and isinstance(
+                (cands.get(mode) or {}).get("infer_ms"), (int, float)
+            ):
+                k2 = min(timed, key=timed.get)
+                vs = f"{timed[k2] / cands[mode]['infer_ms']:.2f}x vs {k2}"
+            else:
+                vs = "only candidate" if mode != "?" else "—"
+            agr = p.get("agreement") or {}
+            agree = (
+                f"{agr['max_abs_delta']:.2e}"
+                if isinstance(agr.get("max_abs_delta"), (int, float))
+                else "—"
+            )
+            sps = p.get("samples_per_sec")
+            lines.append(
+                f"| {s_n} | {mode} | {p.get('capacity', '—')} | "
+                f"{p.get('batch', '—')} | {sps if sps is not None else '—'} | "
+                f"{vs} | {agree} |"
             )
 
     # Steady-state host-transfer gate: the bench's timed loops are

@@ -1,0 +1,122 @@
+"""The S=3..64 scenario-scaling sweep on the virtual-device harness (ISSUE 9).
+
+The scenario twin of ``scripts/qubit_scaling_sweep.py``: force an
+8-virtual-device CPU backend (``utils.platform.force_cpu``), run ``bench.py``'s
+``scenario_scaling`` child over the full grid (the routing dispatcher races
+dense-all-trunks vs capacity-bucketed sparse at every S and the winner is
+timed + costed per point), and round-trip the artifact through the
+``qdml-tpu report`` gate. Writes ``results/scenario_scaling/``:
+
+- ``scenario_scaling.jsonl`` — manifest-headed telemetry: the
+  ``scenario_scaling`` record (per-S winner, candidate timings, capacity,
+  XLA cost, roofline, sparse-vs-dense value agreement);
+- ``routing_table.json`` — the selection table the sweep wrote: the committed
+  PROOF of which dispatch the race picks per (S, batch) on this harness;
+- ``report_scenario.md`` — the rendered report (per-S ``best_of_dispatch``
+  gate rows + the scenario-scaling crossover section);
+- ``SCENARIO_SCALING.json`` — the headline (S -> dispatch/rows-per-sec map,
+  the dense-at-S=3 and sparse-at-S>=16 checks, the report exit code).
+
+Run: ``python scripts/scenario_scaling_sweep.py [--devices=8] [--budget=1.0]``
+(a few minutes on a CPU host — the S=64 dense race entrant is deliberately
+~50x the sparse work). Virtual-device timings measure XLA:CPU execution, not
+ICI scaling — the artifact is the wiring-and-dispatch proof (dense must keep
+winning the reference's S=3, sparse must WIN the race from S=16 up, table ->
+record -> report gate round-trip at exit 0); the TPU re-run is the hardware
+headline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+
+def main(argv: list[str]) -> int:
+    devices = int(
+        next((a.split("=", 1)[1] for a in argv if a.startswith("--devices=")), 8)
+    )
+    budget = next((a.split("=", 1)[1] for a in argv if a.startswith("--budget=")), None)
+    force_cpu(devices)
+    if budget is not None:
+        os.environ["QDML_SCENARIO_BUDGET_S"] = budget
+
+    import bench
+
+    out_dir = os.path.join("results", "scenario_scaling")
+    os.makedirs(out_dir, exist_ok=True)
+    table = os.path.join(out_dir, "routing_table.json")
+    jsonl = os.path.join(out_dir, "scenario_scaling.jsonl")
+    if os.path.exists(table):
+        os.remove(table)  # the committed table must be THIS run's selections
+    os.environ["QDML_SCENARIO_TABLE"] = table
+
+    rc = bench.run_scenario_scaling_child(out_path=jsonl)
+    if rc != 0:
+        print(f"scenario-scaling child failed rc={rc}", file=sys.stderr)
+        return rc
+
+    with open(jsonl) as fh:
+        record = [json.loads(ln) for ln in fh if ln.strip()][-1]
+    points = record["details"]["scenario_scaling"]["points"]
+
+    # the artifact must round-trip the regression gate: self-vs-self is the
+    # committed wiring proof (exit 0); later runs gate against THIS file
+    from qdml_tpu.telemetry.report import report_main
+
+    report_rc = report_main(
+        [
+            f"--current={jsonl}",
+            f"--baseline={jsonl}",
+            f"--out={os.path.join(out_dir, 'report_scenario.md')}",
+        ]
+    )
+
+    # the two ends of the crossover the race must prove: dense still wins the
+    # reference grid, sparse wins the scale-out regime. A point only counts
+    # as proven when it was MEASURED (samples_per_sec present): the dispatch
+    # field is assigned before timing, so an errored point must fail the
+    # proof, not ride through on its pre-timing label.
+    def _proven(p, mode):
+        return p.get("dispatch") == mode and "samples_per_sec" in p
+
+    all_measured = all("samples_per_sec" in p for p in points)
+    dense_at_3 = all(
+        _proven(p, "dense") for p in points if p.get("n_scenarios") == 3
+    )
+    sparse_at_16 = all(
+        _proven(p, "sparse") for p in points if p.get("n_scenarios", 0) >= 16
+    ) and any(p.get("n_scenarios", 0) >= 16 for p in points)
+    headline = {
+        "devices": devices,
+        "dispatch_per_s": {
+            str(p["n_scenarios"]): {
+                "dispatch": p.get("dispatch"),
+                "capacity": p.get("capacity"),
+                "samples_per_sec": p.get("samples_per_sec"),
+                "infer_ms": p.get("infer_ms"),
+                "agreement": p.get("agreement"),
+                "error": p.get("error"),
+            }
+            for p in points
+        },
+        "all_points_measured": all_measured,
+        "dense_at_3": dense_at_3,
+        "sparse_at_16_plus": sparse_at_16,
+        "report_exit": report_rc,
+        "table": table,
+    }
+    with open(os.path.join(out_dir, "SCENARIO_SCALING.json"), "w") as fh:
+        json.dump(headline, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(headline, indent=2))
+    return 0 if (report_rc == 0 and dense_at_3 and sparse_at_16 and all_measured) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -122,3 +122,30 @@ def fused_layer_build(weights, n_layers, layer_unitaries):
     for l in range(1, n_layers):
         total = layer_unitaries[l] @ total
     return total, cos_t, sin_t
+
+
+@jax.jit
+def static_shape_routing(x, y, idx):
+    # data-dependent-shape-in-jit's legitimate twins: 3-arg jnp.where masks
+    # VALUES at a static shape, integer gathers are shape-static, and mask
+    # reductions consume the comparison without indexing by it
+    mask = y > 0
+    selected = jnp.where(mask, x, 0.0)
+    gathered = x[idx]  # integer-array gather: static shape
+    return selected, gathered, jnp.sum(mask)
+
+
+def host_side_unique(ids):
+    # the same ops OUTSIDE any traced function are host-side aggregation —
+    # np.unique over fetched results is how eval scripts summarize
+    import numpy as np
+
+    return np.unique(np.asarray(ids))
+
+
+@jax.jit
+def static_size_nonzero(x, ids):
+    # jax's static-size escape hatch: size= makes the output shape a literal,
+    # exactly what the data-dependent-shape rule asks callers to provide
+    (idx,) = jnp.nonzero(x > 0, size=4, fill_value=0)
+    return idx, jnp.unique(ids, size=4, fill_value=0)

@@ -138,3 +138,31 @@ def ansatz_unitary_per_gate(weights, n, n_layers):
         u = rot_gate(weights[l, 0, 0], weights[l, 0, 1])  # noqa: F821
         total = u if total is None else total @ u
     return total
+
+
+@jax.jit
+def nonzero_in_jit(x):
+    # data-dependent-shape-in-jit: output length depends on runtime values
+    (idx,) = jnp.nonzero(x > 0)
+    return idx
+
+
+@jax.jit
+def unique_in_jit(ids):
+    # data-dependent-shape-in-jit: jnp.unique cannot have a static shape
+    return jnp.unique(ids)
+
+
+@jax.jit
+def where_nonzero_form_in_jit(x):
+    # data-dependent-shape-in-jit: one-arg jnp.where IS nonzero
+    return jnp.where(x > 0)
+
+
+@jax.jit
+def bool_mask_index_in_jit(x, y):
+    # data-dependent-shape-in-jit: boolean-mask indexing, direct and via a
+    # mask local — both lower to nonzero+gather
+    direct = x[y > 0]
+    mask = y > 1
+    return direct, x[mask]

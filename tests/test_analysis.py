@@ -52,6 +52,9 @@ def test_violation_fixture_trips_every_rule():
     assert rules["pallas-host-loop"] == 1          # per-layer launch loop
     assert rules["pallas-interpret-literal"] == 1  # hardcoded interpret=True
     assert rules["gate-matrix-in-loop"] == 1       # per-gate build in layer loop
+    # nonzero + unique + 1-arg where + direct mask + mask-local (2 on 1 line
+    # dedup to their own lines: direct and via-local sit on separate lines)
+    assert rules["data-dependent-shape-in-jit"] == 5
     # every finding carries a usable anchor
     for f in findings:
         assert f.path.endswith("violations.py") and f.line > 0 and f.message

@@ -1,0 +1,628 @@
+"""Capacity-bucketed sparse expert dispatch: value-equivalence property
+tests (eager/jit, fp32/bf16, out-of-range ids, overflow fallback,
+padded-batch invariance), the dense-vs-sparse dispatcher race table, the
+sparse sweep path, and the scenario-scaling report gates (ISSUE 9)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qdml_tpu.ops import dispatch_autotune
+from qdml_tpu.ops.routing import (
+    bucket_ranks,
+    expert_capacity,
+    select_expert,
+    sparse_dispatch,
+)
+
+
+def _toy(s, din, d, seed=0, dtype=jnp.float32):
+    """Per-expert linear maps: the routing-level reference. Both formulations
+    reduce over the SAME per-row contraction (einsum over din), so any
+    disagreement is a packing/unsort bug, not float reassociation — fp32
+    equality is exact by construction."""
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.standard_normal((s, din, d)), dtype=dtype)
+
+    def run_experts(buckets):  # (S, C, Din) -> (S, C, D)
+        return jnp.einsum("scd,sde->sce", buckets, w)
+
+    def dense_fb(x, pred):
+        return select_expert(jnp.einsum("bd,sde->sbe", x, w), pred)
+
+    return run_experts, dense_fb
+
+
+def test_expert_capacity_bounds():
+    assert expert_capacity(64, 8, 1.25) == 10
+    assert expert_capacity(64, 64, 1.25) == 2
+    assert expert_capacity(64, 3, 0.0) == 1      # floor
+    assert expert_capacity(8, 1, 100.0) == 8     # ceil at batch
+    assert expert_capacity(1, 5, 1.0) == 1
+
+
+def test_bucket_ranks_are_within_expert_arrival_order():
+    pred = jnp.asarray([2, 0, 2, 1, 2, 0], jnp.int32)
+    ids, rank = bucket_ranks(pred, 3)
+    np.testing.assert_array_equal(np.asarray(ids), [2, 0, 2, 1, 2, 0])
+    np.testing.assert_array_equal(np.asarray(rank), [0, 0, 1, 0, 2, 1])
+    # invalid rows consume no rank
+    valid = jnp.asarray([True, True, False, True, True, True])
+    _, rank_v = bucket_ranks(pred, 3, valid=valid)
+    np.testing.assert_array_equal(np.asarray(rank_v)[[0, 4]], [0, 1])
+
+
+def test_sparse_matches_dense_eager_and_jit_fp32_exact():
+    """The tentpole equivalence pin: sparse == select_expert bit-for-bit in
+    fp32, eager and jitted, across S/B/D shapes and random routing."""
+    rng = np.random.default_rng(1)
+    for s, b, din, d in ((2, 8, 4, 3), (8, 64, 12, 7), (16, 32, 5, 5), (7, 13, 3, 2)):
+        run_experts, dense_fb = _toy(s, din, d, seed=s)
+        x = jnp.asarray(rng.standard_normal((b, din)).astype(np.float32))
+        pred = jnp.asarray(rng.integers(0, s, b).astype(np.int32))
+        ref = dense_fb(x, pred)
+        out, ovf = sparse_dispatch(run_experts, dense_fb, x, pred, s, 1.25)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        out_j, ovf_j = jax.jit(
+            lambda xx, pp, re=run_experts, df=dense_fb, ss=s: sparse_dispatch(
+                re, df, xx, pp, ss, 1.25
+            )
+        )(x, pred)
+        np.testing.assert_array_equal(np.asarray(out_j), np.asarray(ref))
+        assert int(ovf) == int(ovf_j)
+
+
+def test_sparse_bf16_tracks_dense():
+    rng = np.random.default_rng(2)
+    s, b, din, d = 8, 32, 6, 4
+    run_experts, dense_fb = _toy(s, din, d, dtype=jnp.bfloat16)
+    x = jnp.asarray(rng.standard_normal((b, din)), jnp.bfloat16)
+    pred = jnp.asarray(rng.integers(0, s, b).astype(np.int32))
+    out, _ = sparse_dispatch(run_experts, dense_fb, x, pred, s, 1.25)
+    ref = dense_fb(x, pred)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=0.05, rtol=0.05,
+    )
+
+
+def test_sparse_clips_out_of_range_ids_like_select_expert():
+    """Corrupted classifier ids degrade to the nearest valid expert on the
+    sparse path exactly as select_expert does — eager and jit identically."""
+    s, b, din, d = 4, 8, 3, 2
+    run_experts, dense_fb = _toy(s, din, d)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((b, din)).astype(np.float32))
+    pred = jnp.asarray([9, -4, 0, 3, 99, -1, 2, 1], jnp.int32)
+    ref = dense_fb(x, pred)  # select_expert clips internally
+    out, _ = sparse_dispatch(run_experts, dense_fb, x, pred, s, 1.25)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    out_j, _ = jax.jit(
+        lambda xx, pp: sparse_dispatch(run_experts, dense_fb, xx, pp, s, 1.25)
+    )(x, pred)
+    np.testing.assert_array_equal(np.asarray(out_j), np.asarray(ref))
+
+
+def test_overflow_at_low_capacity_falls_back_losslessly():
+    """Every row one expert at capacity 1: all but one row overflows; the
+    fallback rows take the dense path's values BIT-EXACTLY (the fallback IS
+    the dense path), and the overflow count is honest."""
+    s, b, din, d = 8, 16, 5, 3
+    run_experts, dense_fb = _toy(s, din, d)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((b, din)).astype(np.float32))
+    pred = jnp.full((b,), 3, jnp.int32)
+    out, ovf = sparse_dispatch(
+        run_experts, dense_fb, x, pred, s, 1.25, capacity=1
+    )
+    assert int(ovf) == b - 1
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(dense_fb(x, pred)))
+    # balanced load at sane capacity never enters the fallback
+    pred_b = jnp.arange(b, dtype=jnp.int32) % s
+    _, ovf_b = sparse_dispatch(run_experts, dense_fb, x, pred_b, s, 1.25)
+    assert int(ovf_b) == 0
+
+
+def test_padded_batch_invariance():
+    """Zero-padding the batch (the serve engine's bucket fill) must not
+    perturb real rows: with the valid mask, padding consumes no capacity and
+    real rows pack into the SAME slots — outputs bit-identical at a fixed
+    capacity."""
+    s, b, pad, din, d = 8, 24, 9, 5, 3
+    run_experts, dense_fb = _toy(s, din, d)
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((b, din)).astype(np.float32))
+    pred = jnp.asarray(rng.integers(0, s, b).astype(np.int32))
+    cap = expert_capacity(b, s, 1.25)
+    out, ovf = sparse_dispatch(
+        run_experts, dense_fb, x, pred, s, capacity=cap
+    )
+    xp = jnp.concatenate([x, jnp.zeros((pad, din), jnp.float32)])
+    pp = jnp.concatenate([pred, jnp.zeros((pad,), jnp.int32)])
+    valid = jnp.arange(b + pad) < b
+    out_p, ovf_p = sparse_dispatch(
+        run_experts, dense_fb, xp, pp, s, valid=valid, capacity=cap
+    )
+    assert int(ovf) == int(ovf_p)  # padding rows never count as overflow
+    np.testing.assert_array_equal(np.asarray(out_p)[:b], np.asarray(out))
+
+
+def test_sparse_matches_dense_through_real_hdce_trunks():
+    """Through the real conv trunks + shared head the two formulations agree
+    to float tolerance (XLA may tile the (S*C)-row and (S*B)-row batches
+    differently — ulp-level reassociation, nothing structural)."""
+    from qdml_tpu.train.hdce import HDCE
+
+    s, b = 8, 32
+    rng = np.random.default_rng(6)
+    model = HDCE(n_scenarios=s, features=8, out_dim=64)
+    x = jnp.asarray(rng.standard_normal((b, 16, 8, 2)).astype(np.float32))
+    pred = jnp.asarray(rng.integers(0, s, b).astype(np.int32))
+    v = model.init(
+        jax.random.PRNGKey(0), jnp.broadcast_to(x[None], (s,) + x.shape), train=False
+    )
+
+    def dense_fb(xb, pb):
+        xs = jnp.broadcast_to(xb[None], (s,) + xb.shape)
+        return select_expert(model.apply(v, xs, train=False), pb)
+
+    out, _ = jax.jit(
+        lambda xx, pp: sparse_dispatch(
+            lambda bk: model.apply(v, bk, train=False), dense_fb, xx, pp, s, 1.25
+        )
+    )(x, pred)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_fb(x, pred)), atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher race table
+# ---------------------------------------------------------------------------
+
+
+def test_eligible_modes_window():
+    assert dispatch_autotune.eligible_modes(3) == ["dense"]
+    assert dispatch_autotune.eligible_modes(5) == ["dense"]
+    assert dispatch_autotune.eligible_modes(6) == ["dense", "sparse"]
+    assert dispatch_autotune.eligible_modes(64) == ["dense", "sparse"]
+
+
+def test_ensure_route_below_window_skips_race_and_writes_nothing(tmp_path):
+    """S=3: only dense is eligible — nothing is timed (zero extra compiles
+    for the reference grid), the exclusion reason is recorded, and NO table
+    is written (a window-only decision carries no timings worth caching:
+    every reference-grid warmup would otherwise write files)."""
+    table = str(tmp_path / "routing.json")
+    dispatch_autotune.invalidate_cache()
+    calls = []
+
+    def apply_trunks(xs):  # must never run below the window
+        calls.append(1)
+        return jnp.zeros(xs.shape[:2] + (4,))
+
+    x = jnp.zeros((16, 8, 4, 2), jnp.float32)
+    entry = dispatch_autotune.ensure_route(apply_trunks, x, 3, path=table)
+    assert entry["best_infer"] == "dense"
+    assert entry["candidates"]["dense"] == {"only_candidate": True}
+    assert "sparse" in entry["excluded"][0]["mode"]
+    assert calls == []
+    assert not os.path.exists(table)
+    dispatch_autotune.invalidate_cache()
+
+
+def test_ensure_route_races_and_lookup_survives_pathologies(tmp_path):
+    """S=8: both modes race for real; the winner persists; corrupt/alien
+    tables and an out-of-window sparse entry all degrade to None/dense."""
+    table = str(tmp_path / "routing.json")
+    dispatch_autotune.invalidate_cache()
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((8, 4 * 2 * 2, 16)).astype(np.float32))
+
+    def apply_trunks(xs):  # (S, B', 4, 2, 2) -> (S, B', 16)
+        flat = xs.reshape(xs.shape[0], xs.shape[1], -1)
+        return jnp.einsum("sbd,sde->sbe", flat, w)
+
+    x = jnp.asarray(rng.standard_normal((32, 4, 2, 2)).astype(np.float32))
+    entry = dispatch_autotune.ensure_route(apply_trunks, x, 8, path=table)
+    assert entry["best_infer"] in ("dense", "sparse")
+    assert {"dense", "sparse"} <= set(entry["candidates"])
+    assert all(
+        isinstance(c.get("infer_ms"), float) for c in entry["candidates"].values()
+    )
+    assert dispatch_autotune.lookup(8, 32, path=table) == entry["best_infer"]
+    # cached ensure returns without re-measuring
+    again = dispatch_autotune.ensure_route(apply_trunks, x, 8, path=table)
+    assert again["ts"] == entry["ts"]
+
+    # corrupt file -> lookup None, never raises
+    dispatch_autotune.invalidate_cache()
+    with open(table, "w") as fh:
+        fh.write("{not json")
+    assert dispatch_autotune.lookup(8, 32, path=table) is None
+    assert dispatch_autotune.table_status(table) == "corrupt"
+
+    # a hand-edited sparse selection below the window cannot force sparse
+    dispatch_autotune.invalidate_cache()
+    import jax as _jax
+
+    key = dispatch_autotune.table_key(_jax.default_backend(), 3, 32)
+    with open(table, "w") as fh:
+        json.dump({"entries": {key: {"best_infer": "sparse"}}}, fh)
+    assert dispatch_autotune.lookup(3, 32, path=table) is None
+    dispatch_autotune.invalidate_cache()
+
+
+# ---------------------------------------------------------------------------
+# Serve engine: sparse AOT buckets
+# ---------------------------------------------------------------------------
+
+
+def _mini_cfg(n_scenarios=8, dispatch="sparse", buckets=(8, 16)):
+    from qdml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        ServeConfig,
+        TrainConfig,
+    )
+
+    return ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, n_scenarios=n_scenarios),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=8, n_epochs=1),
+        serve=ServeConfig(max_batch=max(buckets), buckets=buckets, dispatch=dispatch),
+    )
+
+
+def _mini_engine(cfg):
+    from qdml_tpu.serve import ServeEngine
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    _, hs = init_hdce_state(cfg, steps_per_epoch=10)
+    _, ss = init_sc_state(cfg, quantum=False, steps_per_epoch=10)
+    return ServeEngine(
+        cfg, {"params": hs.params, "batch_stats": hs.batch_stats}, {"params": ss.params}
+    )
+
+
+def test_serve_sparse_buckets_zero_compiles_and_parity():
+    """The acceptance pin: sparse baked into every AOT bucket, offline-parity
+    to float tolerance, ZERO request-path compiles across warmup + traffic,
+    and honest overflow accounting in dispatch_summary."""
+    cfg = _mini_cfg()
+    eng = _mini_engine(cfg)
+    x = np.random.default_rng(0).standard_normal((11, 8, 4, 2)).astype(np.float32)
+    off_h, off_p = eng.offline_forward(x)
+    warm = eng.warmup()
+    assert set(warm["dispatch"]["mode"].values()) == {"sparse"}
+    for _ in range(3):
+        h, p, b = eng.infer(x)
+    np.testing.assert_array_equal(p, off_p)
+    np.testing.assert_allclose(h, off_h, atol=1e-5)
+    assert all(v == 0 for v in eng.request_path_compiles().values())
+    summ = eng.dispatch_summary()
+    assert summ["mode"] == "sparse"
+    assert summ["routed_rows"] == 3 * 11
+    assert summ["overflow_rate"] is not None
+    assert summ["capacity_factor"] == cfg.serve.capacity_factor
+
+
+def test_serve_auto_dispatch_below_window_stays_dense_no_race():
+    """S=3 + dispatch=auto: the race is skipped (window), dense serves, and
+    the dispatch block says so — the reference grid's warmup is unchanged."""
+    cfg = _mini_cfg(n_scenarios=3, dispatch="auto", buckets=(8,))
+    eng = _mini_engine(cfg)
+    warm = eng.warmup()
+    assert warm["dispatch"]["mode"] == {"8": "dense"}
+    race = warm["dispatch"]["race"]["8"]
+    assert race["candidates"]["dense"] == {"only_candidate": True}
+    x = np.random.default_rng(1).standard_normal((5, 8, 4, 2)).astype(np.float32)
+    h, p, b = eng.infer(x)
+    assert h.shape == (5, cfg.h_out_dim)
+    assert eng.dispatch_summary()["mode"] == "dense"
+    assert eng.dispatch_summary()["overflow_rate"] is None  # nothing sparse ran
+
+
+def test_serve_auto_dispatch_races_above_window(tmp_path):
+    """S=8 + dispatch=auto: a real measured race picks the bucket's mode and
+    the entry (with both candidates timed) lands in the warmup record."""
+    dispatch_autotune.invalidate_cache()
+    dispatch_autotune.set_table_path(str(tmp_path / "routing.json"))
+    try:
+        cfg = _mini_cfg(n_scenarios=8, dispatch="auto", buckets=(16,))
+        eng = _mini_engine(cfg)
+        warm = eng.warmup()
+        entry = warm["dispatch"]["race"]["16"]
+        assert {"dense", "sparse"} <= set(entry["candidates"])
+        assert warm["dispatch"]["mode"]["16"] == entry["best_infer"]
+    finally:
+        dispatch_autotune.invalidate_cache()
+
+
+def test_sweep_sparse_dispatch_matches_dense():
+    """The eval sweep's HDCE curves are dispatch-invariant: the sparse sweep
+    step produces the same error sums as the dense one to float tolerance."""
+    from qdml_tpu.config import (
+        DataConfig,
+        EvalConfig,
+        ExperimentConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from qdml_tpu.data.baselines import beam_delay_profile
+    from qdml_tpu.data.channels import ChannelGeometry
+    from qdml_tpu.eval.sweep import make_sweep_step
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64, n_scenarios=8),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        eval=EvalConfig(snr_grid=(10.0,), test_len=32, batch_size=32),
+    )
+    geom = ChannelGeometry.from_config(cfg.data)
+    profile = beam_delay_profile(geom)
+    _, hs = init_hdce_state(cfg, steps_per_epoch=10)
+    hdce_vars = {"params": hs.params, "batch_stats": hs.batch_stats}
+    _, ss = init_sc_state(cfg, quantum=False, steps_per_epoch=10)
+    sc_vars = {"params": ss.params}
+    outs = {}
+    for dispatch in ("dense", "sparse"):
+        step = make_sweep_step(
+            cfg, geom, hdce_vars, sc_vars, None, profile, dispatch=dispatch
+        )
+        outs[dispatch] = step(jnp.asarray(0), jnp.asarray(0), jnp.float32(10.0))
+    for key in outs["dense"]:
+        np.testing.assert_allclose(
+            float(outs["dense"][key]), float(outs["sparse"][key]), rtol=1e-5,
+            err_msg=key,
+        )
+
+
+def test_sweep_rejects_unknown_dispatch():
+    from qdml_tpu.eval.sweep import make_sweep_step
+
+    with pytest.raises(ValueError, match="dispatch"):
+        make_sweep_step(None, None, None, None, None, None, dispatch="magic")
+
+
+# ---------------------------------------------------------------------------
+# Report: scenario-scaling gates + serving dispatch fields
+# ---------------------------------------------------------------------------
+
+
+def _scenario_record(sps_by_s, dispatch_by_s=None):
+    dispatch_by_s = dispatch_by_s or {}
+    return {
+        "kind": "bench_record",
+        "metric": "scenario_scaling_points",
+        "value": len(sps_by_s),
+        "platform": "cpu",
+        "details": {
+            "scenario_scaling": {
+                "platform": "cpu",
+                "capacity_factor": 1.25,
+                "points": [
+                    {
+                        "n_scenarios": s,
+                        "batch": 64,
+                        "capacity": 10,
+                        "dispatch": dispatch_by_s.get(s, "sparse"),
+                        "samples_per_sec": v,
+                        "infer_ms": 1.0,
+                        "candidates": {
+                            "dense": {"infer_ms": 2.0},
+                            "sparse": {"infer_ms": 1.0},
+                        },
+                        "agreement": {"max_abs_delta": 0.0},
+                    }
+                    for s, v in sps_by_s.items()
+                ],
+            }
+        },
+    }
+
+
+def test_report_extracts_and_gates_scenario_scaling(tmp_path):
+    """Every S-bucket is its own best_of_dispatch gate: S=32 regressing fails
+    CI even while S=3 improves, and the crossover section renders."""
+    from qdml_tpu.telemetry.report import build_report_data, report_main
+
+    base = tmp_path / "base.jsonl"
+    cur = tmp_path / "cur.jsonl"
+    base.write_text(json.dumps(_scenario_record({3: 100.0, 32: 1000.0})) + "\n")
+    cur.write_text(json.dumps(_scenario_record({3: 200.0, 32: 500.0})) + "\n")
+    data = build_report_data([str(cur)], str(base))
+    assert data["gate_armed"]
+    regressed = {r["metric"] for r in data["regressions"]}
+    assert "scenario_scaling.s32.best_of_dispatch" in regressed
+    assert "scenario_scaling.s03.best_of_dispatch" not in regressed
+    assert "## scenario scaling" in data["markdown"]
+    assert "2.00x vs dense" in data["markdown"]
+    rc = report_main([f"--current={cur}", f"--baseline={base}"])
+    assert rc == 3
+    # self-vs-self is clean
+    assert report_main([f"--current={cur}", f"--baseline={cur}"]) == 0
+
+
+def test_report_serving_dispatch_fields_and_overflow_gate(tmp_path):
+    """serve_summary's n_scenarios/dispatch/overflow fields reach the fleet
+    line, and an overflow-rate jump beyond the absolute slack fails the
+    gate while an equal-rate run passes."""
+    from qdml_tpu.telemetry.report import build_report_data
+
+    def summ(rate):
+        return {
+            "kind": "serve_summary",
+            "platform": "cpu",
+            "rps": 100.0,
+            "latency_ms": {"p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0},
+            "replicas": 2,
+            "n_scenarios": 16,
+            "dispatch": {
+                "mode": "sparse",
+                "capacity_factor": 1.25,
+                "overflow_rate": rate,
+            },
+        }
+
+    base = tmp_path / "base.jsonl"
+    ok = tmp_path / "ok.jsonl"
+    bad = tmp_path / "bad.jsonl"
+    base.write_text(json.dumps(summ(0.01)) + "\n")
+    ok.write_text(json.dumps(summ(0.02)) + "\n")
+    bad.write_text(json.dumps(summ(0.25)) + "\n")
+    good = build_report_data([str(ok)], str(base))
+    assert not any(r["metric"] == "serve.overflow_rate" for r in good["regressions"])
+    assert "S=16" in good["markdown"] and "sparse-dispatch" in good["markdown"]
+    failed = build_report_data([str(bad)], str(base))
+    assert any(r["metric"] == "serve.overflow_rate" for r in failed["regressions"])
+
+
+# ---------------------------------------------------------------------------
+# Channel families (the S >> 3 data axis)
+# ---------------------------------------------------------------------------
+
+
+def test_family_table_prefix_property_and_base_presets():
+    """Rows 0..2 are the frozen reference presets, and growing S never
+    re-parameterizes existing families (the committed-stream contract)."""
+    from qdml_tpu.data import channels
+
+    t3 = channels.family_table(3)
+    np.testing.assert_array_equal(t3["n_paths"], channels.SCENARIO_N_PATHS)
+    np.testing.assert_array_equal(t3["k_factor"], channels.SCENARIO_K_FACTOR)
+    np.testing.assert_array_equal(t3["mobility"], [0.0, 0.0, 0.0])
+    t16 = channels.family_table(16)
+    t64 = channels.family_table(64)
+    for key in ("n_paths", "angle_spread", "delay_spread", "k_factor", "mobility"):
+        np.testing.assert_array_equal(t16[key], t64[key][:16], err_msg=key)
+        np.testing.assert_array_equal(t3[key], t64[key][:3], err_msg=key)
+    assert all(1 <= p <= channels.MAX_PATHS for p in t64["n_paths"])
+    assert all(m > 0 for m in t64["mobility"][3:])  # derived tiers move
+    assert t64["preset"][0] == "inh_los" and "+t" in t64["preset"][5]
+    with pytest.raises(ValueError):
+        channels.family_table(0)
+
+
+def test_family_samples_base_scenarios_bit_identical_across_s():
+    """Sampling scenario s < 3 from an S=16 geometry is bit-identical to the
+    S=3 geometry: the family axis EXTENDS the dataset, never forks it."""
+    from qdml_tpu.data.channels import ChannelGeometry, generate_samples
+
+    i = jnp.arange(12)
+    kw = dict(n_ant=16, n_sub=8, n_beam=4)
+    out3 = generate_samples(
+        jnp.uint32(7), i % 3, i % 3, i, jnp.float32(10.0), ChannelGeometry(**kw)
+    )
+    out16 = generate_samples(
+        jnp.uint32(7), i % 3, i % 3, i, jnp.float32(10.0),
+        ChannelGeometry(n_scenarios=16, **kw),
+    )
+    for key in ("yp", "h_perf", "h_ls"):
+        np.testing.assert_array_equal(
+            np.asarray(out3[key].re), np.asarray(out16[key].re), err_msg=key
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out3[key].im), np.asarray(out16[key].im), err_msg=key
+        )
+
+
+def test_family_samples_distinct_and_normalized_at_high_s():
+    """Derived families produce distinct, unit-energy channels on device —
+    the S >> 3 grid is real data, not re-seeded copies of the base three."""
+    from qdml_tpu.data.channels import ChannelGeometry, generate_samples
+
+    geom = ChannelGeometry(n_ant=16, n_sub=8, n_beam=4, n_scenarios=12)
+    n = 48
+    i = jnp.arange(n)
+    scen = i % 12
+    out = generate_samples(jnp.uint32(3), scen, i % 3, i // 12, jnp.float32(10.0), geom)
+    h = out["h_perf"]
+    energy = np.asarray(jnp.sum(h.abs2(), axis=-1))
+    np.testing.assert_allclose(energy.mean(), geom.h_dim, rtol=0.35)
+    # same index, different family -> different realisations
+    a = np.asarray(out["h_perf"].re)
+    assert not np.allclose(a[3], a[4])
+
+
+def test_scenario_scaling_grid_helpers():
+    from qdml_tpu.eval.sweep import (
+        SCENARIO_SCALING_GRID,
+        dispatch_agreement,
+        scenario_batch,
+    )
+
+    assert SCENARIO_SCALING_GRID[0] == 3 and SCENARIO_SCALING_GRID[-1] == 64
+    assert scenario_batch(64) == scenario_batch(3) == 64
+    agr = dispatch_agreement(6, batch=12, features=4)
+    assert agr["max_abs_delta"] < 1e-5
+    assert agr["overflow_balanced"] == 0
+    assert agr["overflow_skewed"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Committed artifact smoke (the wiring proof stays re-readable)
+# ---------------------------------------------------------------------------
+
+ARTIFACT = os.path.join("results", "scenario_scaling", "scenario_scaling.jsonl")
+TABLE = os.path.join("results", "scenario_scaling", "routing_table.json")
+
+
+def test_committed_scenario_scaling_artifact_round_trips_report_gate(tmp_path):
+    """The committed sweep artifact re-reads through the report gate at exit
+    0 (self-vs-self), extracts one best_of_dispatch gate per S, and shows the
+    crossover the acceptance criteria name: dense still winning S=3, sparse
+    proven (raced and won) at S >= 16."""
+    from qdml_tpu.telemetry.report import build_report_data, extract, report_main
+
+    assert os.path.exists(ARTIFACT), "commit scripts/scenario_scaling_sweep.py output"
+    src = extract(ARTIFACT)
+    keys = {k for k in src["throughput"] if k.startswith("scenario_scaling.s")}
+    assert {
+        "scenario_scaling.s03.best_of_dispatch",
+        "scenario_scaling.s16.best_of_dispatch",
+        "scenario_scaling.s64.best_of_dispatch",
+    } <= keys
+    by_s = {
+        p["n_scenarios"]: p for p in src["scenario_scaling"]["points"]
+    }
+    assert by_s[3]["dispatch"] == "dense"
+    for s in (16, 32, 64):
+        assert by_s[s]["dispatch"] == "sparse", s
+        # proven = raced and measured faster, not picked by heuristic
+        cands = by_s[s]["candidates"]
+        assert cands["sparse"]["infer_ms"] < cands["dense"]["infer_ms"]
+        # and value-equivalent to the dense formulation at that S
+        assert by_s[s]["agreement"]["max_abs_delta"] < 1e-5
+    # dense at S=3 is the recorded window exclusion, not an accident
+    assert by_s[3]["excluded"][0]["mode"] == "sparse"
+    rc = report_main(
+        [f"--current={ARTIFACT}", f"--baseline={ARTIFACT}",
+         f"--out={tmp_path / 'r.md'}"]
+    )
+    assert rc == 0
+    data = build_report_data([ARTIFACT], ARTIFACT)
+    assert "## scenario scaling" in data["markdown"]
+
+
+def test_committed_routing_table_dispatches_sparse_at_scale():
+    """The committed selection table round-trips through lookup(): the
+    dispatcher on this (cpu) harness serves sparse at the scale-out shapes
+    and None/dense below the window — the table IS the proof the serve
+    warmup reads."""
+    dispatch_autotune.invalidate_cache()
+    try:
+        assert dispatch_autotune.lookup(16, 64, path=TABLE) == "sparse"
+        assert dispatch_autotune.lookup(64, 64, path=TABLE) == "sparse"
+        assert dispatch_autotune.lookup(3, 64, path=TABLE) in (None, "dense")
+    finally:
+        dispatch_autotune.invalidate_cache()
