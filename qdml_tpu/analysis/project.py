@@ -39,10 +39,6 @@ DEFAULT_PATHS: tuple[str, ...] = (
 # is exempt: construction happens-before any sharing).
 LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
     "qdml_tpu/serve/batcher.py": {"MicroBatcher": {"_q": "_lock"}},
-    # pool-wide worker-exit accounting: every replica's workers share one
-    # coordinator, and an unlocked read is exactly the "crashed worker sheds
-    # a queue its peers are draining" race the counter exists to prevent
-    "qdml_tpu/serve/server.py": {"ExitCoordinator": {"_live": "_lock"}},
     # hot-swap epoch state: the live (hdce, clf) param tuple and its epoch
     # counter swap atomically between batches — a read outside the lock can
     # see a torn checkpoint mid-swap. The sparse-dispatch overflow counters
@@ -56,6 +52,32 @@ LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
             "_overflow_rows": "_dispatch_lock",
             "_routed_rows": "_dispatch_lock",
         }
+    },
+    # pool-wide worker-exit accounting: every replica's workers share one
+    # coordinator, and an unlocked read is exactly the "crashed worker sheds
+    # a queue its peers are draining" race the counter exists to prevent.
+    # The elastic replica list: resized by the autoscaler thread while
+    # loadgen/metrics threads iterate it — an unlocked read can see a
+    # half-popped list exactly like the PR-2 queue race (retired replicas
+    # ride the same lock: merged_metrics must never miss a scale-down's
+    # served history)
+    "qdml_tpu/serve/server.py": {
+        "ExitCoordinator": {"_live": "_lock"},
+        "ReplicaPool": {"_replicas": "_pool_lock", "_retired": "_pool_lock"},
+    },
+    # fleet-control shared state (docs/CONTROL.md): the controller tick
+    # thread writes these while status/report paths read them
+    "qdml_tpu/control/drift.py": {
+        # detector windows: per-(scenario, signal) PH state + debounce/latch
+        "DriftMonitor": {"_windows": "_lock"},
+    },
+    "qdml_tpu/control/autoscale.py": {
+        # the autoscaler's current target replica count (hysteresis state)
+        "Autoscaler": {"_target": "_lock"},
+    },
+    "qdml_tpu/control/deploy.py": {
+        # the post-deploy rollback watch window
+        "Deployer": {"_watch": "_lock"},
     },
 }
 

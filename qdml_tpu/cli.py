@@ -31,9 +31,19 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
                                   # replica pool, JSON/TCP loop ({"op":
                                   # "metrics"} live counters; {"op": "swap"}
                                   # zero-downtime checkpoint hot-swap)
-    python -m qdml_tpu.cli loadgen [--rate=RPS] [--n=N]    # open-loop traffic
+    python -m qdml_tpu.cli loadgen [--rate=RPS] [--n=N] [--drift-at=K]
+                                  # open-loop traffic
                                   # (--serve.arrival=poisson|bursty|diurnal)
-                                  # vs an in-process warmed engine/pool
+                                  # vs an in-process warmed engine/pool;
+                                  # --drift-at injects channel-family drift
+                                  # (--serve.drift_step / drift_scenario)
+                                  # into the offered stream from index K
+    python -m qdml_tpu.cli control [--ticks=N] [--control.dry_run=true ...]
+                                  # fleet control plane (docs/CONTROL.md):
+                                  # attach to the running serve endpoint,
+                                  # detect per-scenario drift, fine-tune the
+                                  # drifted trunk, canary-gate + hot-swap,
+                                  # watch/rollback, autoscale replicas
 
 Every command's metrics JSONL starts with a run-manifest header (config hash,
 git SHA, device topology, perf knobs, seeds) and carries span/counter records
@@ -68,6 +78,7 @@ _COMMANDS = (
     "export-torch",
     "serve",
     "loadgen",
+    "control",
 )  # "report" and "lint" dispatch before config parsing (no jax, no workdir)
 
 _PASSTHROUGH = (  # command args, not config overrides
@@ -78,6 +89,8 @@ _PASSTHROUGH = (  # command args, not config overrides
     "--threshold=",
     "--rate=",
     "--n=",
+    "--drift-at=",
+    "--ticks=",
 )
 
 
@@ -357,10 +370,26 @@ def main(argv: list[str] | None = None) -> int:
             ))
             engine = ServeEngine.from_workdir(cfg, workdir, mesh=serve_mesh(cfg))
             deadline = cfg.serve.deadline_ms if cfg.serve.deadline_ms > 0 else None
+            drift_at = next(
+                (int(e.split("=", 1)[1]) for e in extra if e.startswith("--drift-at=")),
+                None,
+            )
             summary = run_loadgen(
-                cfg, engine, rate=rate, n=n, deadline_ms=deadline, logger=logger
+                cfg, engine, rate=rate, n=n, deadline_ms=deadline, logger=logger,
+                drift_at=drift_at,
             )
             print(json.dumps(summary))
+        elif cmd == "control":
+            from qdml_tpu.control.loop import control_main
+
+            ticks = next(
+                (int(e.split("=", 1)[1]) for e in extra if e.startswith("--ticks=")),
+                None,
+            )
+            # attaches to the RUNNING `qdml-tpu serve` at serve.host:port
+            # over the metrics/swap/scale verbs; fine-tune + canary run in
+            # this process against the shared workdir (docs/CONTROL.md)
+            return control_main(cfg, logger=logger, workdir=workdir, ticks=ticks)
         # reference prints total minutes (Runner...py:437-440)
         print(f"total time: {(time.time() - t0) / 60.0:.2f} min")
         return 0

@@ -38,6 +38,14 @@ class DataConfig:
     # on device, no DeepMIMO files; rows 0..2 stay the frozen reference
     # presets (bit-identical streams).
     n_scenarios: int = 3
+    # Channel-family drift trajectory (data/channels.family_table): step 0
+    # (default) is the frozen table bit-identically; > 0 perturbs
+    # delay-spread / K-factor / angular-spread / mobility of drift_scenario
+    # (-1 = all families) as a deterministic function of the step — the
+    # injected-drift axis the fleet control plane detects and adapts to
+    # (docs/CONTROL.md).
+    drift_step: int = 0
+    drift_scenario: int = -1
     n_users: int = 3         # users per scenario (reference: 3)
     data_len: int = 20000    # training samples per (scenario, user) cell
     snr_db: float = 10.0     # training SNR (reference SNRdb=10)
@@ -297,9 +305,77 @@ class ServeConfig:
     # rate/burstiness (burst state balances to keep the mean), and the
     # diurnal peak-to-trough ratio grows with it — serve/loadgen.arrival_times.
     burstiness: float = 4.0
+    # Traffic-side drift injection for `qdml-tpu loadgen` (--drift-at=K):
+    # requests offered from index K onward are drawn from the drifted channel
+    # family (data/channels.family_table at this drift step) with the offered
+    # scenario mix shifted toward drift_scenario — the loop's testable way to
+    # drive "the environment changed mid-run" from the traffic side
+    # (docs/CONTROL.md). 0 disables the drifted phase.
+    drift_step: int = 0
+    drift_scenario: int = 0
     # Local socket endpoint for `qdml-tpu serve`.
     host: str = "127.0.0.1"
     port: int = 8377
+
+
+@dataclass(frozen=True)
+class ControlConfig:
+    """Fleet control plane (:mod:`qdml_tpu.control`, docs/CONTROL.md): the
+    closed serve -> detect -> adapt -> deploy loop. One supervised controller
+    (``qdml-tpu control`` / :class:`~qdml_tpu.control.loop.FleetController`)
+    polls the live ``{"op": "metrics"}`` stats, runs streaming drift
+    detectors per scenario, fine-tunes ONLY the drifted trunk, canary-gates
+    the candidate, hot-swaps it through the existing ``{"op": "swap"}`` path,
+    watches for post-swap regression (automatic rollback), and autoscales the
+    replica count against queue depth."""
+
+    # -- controller loop ----------------------------------------------------
+    interval_s: float = 1.0   # tick period between metric polls
+    # Dry-run mode: the controller observes, detects and REPORTS every
+    # decision (control_event records with "dry_run": true) but takes no
+    # action — no fine-tune, no swap, no scaling.
+    dry_run: bool = False
+    # -- drift detectors (control/drift.py) ---------------------------------
+    # Page–Hinkley/CUSUM drift magnitude slack and trip threshold, in the
+    # units of the watched signal (classifier confidence and overflow rate
+    # are fractions in [0, 1]; nmse_parity is in dB — scaled by ~10x
+    # internally, see DriftMonitor). Debounce requires this many CONSECUTIVE
+    # tripping windows before a drift_event fires (one noisy window must
+    # never trigger a fine-tune).
+    ph_delta: float = 0.01
+    ph_threshold: float = 0.15
+    debounce: int = 2
+    # Windows with fewer than this many predictions for a scenario are not
+    # fed to its detectors (a 2-sample confidence mean is noise, not signal).
+    min_window: int = 8
+    # -- continual fine-tuning (control/finetune.py) ------------------------
+    ft_steps: int = 200       # fine-tune steps over the drifted family
+    ft_lr: float = 1e-3
+    ft_batch: int = 32
+    # -- canary gate + rollback (control/deploy.py) -------------------------
+    probe_n: int = 96         # held-out probe samples per scenario
+    # Candidate must beat the live params by at least this much on the
+    # drifted scenario's probes...
+    min_gain_db: float = 0.3
+    # ...while regressing NO un-drifted scenario by more than this.
+    tol_db: float = 0.5
+    # Post-swap watch window: ticks the deployer watches served stats after
+    # a deploy; a parity/confidence regression beyond rollback_db inside the
+    # window rolls the previous checkpoint back automatically.
+    watch_ticks: int = 3
+    rollback_db: float = 1.0
+    # -- autoscaler (control/autoscale.py) ----------------------------------
+    autoscale: bool = True
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Queue-depth hysteresis band (in requests at dequeue): sustained depth
+    # above `queue_high` for `scale_debounce` consecutive ticks scales up,
+    # below `queue_low` scales down; `cooldown_ticks` must pass between
+    # actions so the scaler never flaps on its own transient.
+    queue_high: float = 16.0
+    queue_low: float = 2.0
+    scale_debounce: int = 2
+    cooldown_ticks: int = 3
 
 
 @dataclass(frozen=True)
@@ -323,6 +399,7 @@ class ExperimentConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    control: ControlConfig = field(default_factory=ControlConfig)
 
     # Geometry-derived model dimensions. Single-sourced from DataConfig so a
     # non-default geometry (e.g. the tiny multichip dryrun) can never silently

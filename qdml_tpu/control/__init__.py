@@ -1,0 +1,41 @@
+"""Fleet control plane: the closed serve -> detect -> adapt -> deploy loop.
+
+PRs 7-9 built every hook this package needs — live ``{"op": "metrics"}``
+serve statistics with per-scenario confidence, continual-training-ready
+checkpoint machinery, zero-recompile hot-swap (``{"op": "swap"}``), elastic
+replica pools — but nothing CLOSED the loop: a drifting scenario degraded
+silently until a human retrained. This package is the supervisor that runs
+the cycle autonomously (QuantumNAT's argument, arXiv 2110.11331, applied at
+fleet scope: models must be adapted to the perturbed conditions they
+actually face, not the clean ones they were born in):
+
+- :mod:`~qdml_tpu.control.drift` — streaming Page-Hinkley/CUSUM detectors
+  over per-scenario serve statistics (classifier confidence, served NMSE
+  parity, routing overflow rate) with debounce, emitting structured
+  ``drift_event`` records;
+- :mod:`~qdml_tpu.control.finetune` — continual fine-tuning of ONLY the
+  drifted scenario trunk (warm-start from the live checkpoint, shared FC
+  head and every other trunk frozen — bit-identical, pinned), on fresh
+  on-device batches from the drifted channel family;
+- :mod:`~qdml_tpu.control.deploy` — canary-gated deployment: candidate vs
+  live on held-out probes, deploy through the existing hot-swap path with
+  an EXPLICIT checkpoint tag, automatic rollback when post-swap serving
+  regresses inside the watch window;
+- :mod:`~qdml_tpu.control.autoscale` — a queue-depth/SLO replica autoscaler
+  with hysteresis over the drain-safe
+  :meth:`~qdml_tpu.serve.server.ReplicaPool.add_replica` /
+  :meth:`~qdml_tpu.serve.server.ReplicaPool.remove_replica` levers;
+- :mod:`~qdml_tpu.control.loop` — :class:`FleetController` wiring it all
+  into one supervised loop (``qdml-tpu control``), with a dry-run mode that
+  reports every decision and takes none.
+
+Knobs: :class:`qdml_tpu.config.ControlConfig`. Record schemas + operational
+guidance: ``docs/CONTROL.md``. The committed closed-loop proof:
+``results/control_dryrun/`` (scripts/control_dryrun.py).
+"""
+
+from qdml_tpu.control.autoscale import Autoscaler  # noqa: F401
+from qdml_tpu.control.deploy import Deployer  # noqa: F401
+from qdml_tpu.control.drift import DriftMonitor, PageHinkley  # noqa: F401
+from qdml_tpu.control.finetune import finetune_trunk  # noqa: F401
+from qdml_tpu.control.loop import FleetController  # noqa: F401

@@ -1,0 +1,341 @@
+"""Canary-gated deployment + post-swap watch/rollback.
+
+A fine-tuned checkpoint is a CANDIDATE, not a deploy: the gate evaluates it
+against the LIVE params on held-out probe sets before it ever serves a
+request, and keeps watching after the swap so a canary that lied (probe set
+unlucky, drift moved again) is rolled back automatically.
+
+Canary protocol (:meth:`Deployer.canary`):
+
+- **drifted probes** — fresh samples from the drifted channel family (the
+  distribution the candidate was fine-tuned FOR): the candidate must beat
+  the live params by at least ``min_gain_db`` NMSE there, or the fine-tune
+  bought nothing and does not deploy;
+- **base probes, every scenario** — samples from the frozen families: the
+  candidate must not regress any UN-drifted scenario by more than ``tol_db``
+  (the single-trunk freeze makes big regressions structurally impossible —
+  other trunks are bit-identical — but the routed pipeline is shared, so the
+  gate verifies end-to-end anyway). The drifted scenario's frozen-family
+  numbers are reported but never gated: that family no longer exists in
+  production, and a trunk adapted to a large drift necessarily scores worse
+  on it — gating there would block adaptation exactly when drift is
+  largest.
+
+Both sides run through the SAME fused serving forward
+(``ServeEngine.offline_forward`` on throwaway engines), so the canary
+measures exactly what production will serve. These are control-plane
+compiles — never the serving process's request path.
+
+Deploy (:meth:`Deployer.deploy`) goes through the existing hot-swap with an
+EXPLICIT tag map (``swap_from_workdir(tags=...)`` / ``{"op": "swap",
+"tags": ...}``): zero recompiles, in-flight batches keep the old params, and
+a stale ``hdce_best`` can never shadow the promoted ``hdce_last``. The
+pre-deploy tags are recorded as the rollback target.
+
+Watch window (:meth:`Deployer.observe_served`): for ``watch_ticks`` ticks
+after a deploy the controller feeds the served NMSE-parity stat; a
+regression beyond ``rollback_db`` against the reference triggers an
+immediate rollback swap to the recorded tags. Watch state is shared between
+the controller tick thread and status readers (``_watch`` -> ``_lock``,
+graftlint LOCK_MAP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import make_network_batch
+from qdml_tpu.control.events import emit_record
+from qdml_tpu.telemetry import span
+from qdml_tpu.train.checkpoint import restore_params
+from qdml_tpu.utils.metrics import nmse_db
+
+# probe indices start well past both the training range and the loadgen
+# offset (data_len * 3), so the canary never scores on samples any other
+# consumer has seen
+PROBE_INDEX_OFFSET = 5
+
+
+def probe_batch(
+    cfg: ExperimentConfig,
+    scenario: int,
+    n: int,
+    drift_step: int = 0,
+) -> dict[str, np.ndarray]:
+    """``n`` held-out probe samples of one scenario (``drift_step > 0`` draws
+    them from the DRIFTED family instead of the frozen one): ``{"x",
+    "h_perf"}`` host arrays."""
+    data = cfg.data
+    if drift_step > 0:
+        data = dataclasses.replace(
+            data, drift_step=int(drift_step), drift_scenario=int(scenario)
+        )
+    geom = ChannelGeometry.from_config(data)
+    i = jnp.arange(n)
+    batch = make_network_batch(
+        jnp.uint32(cfg.data.seed),
+        jnp.full((n,), scenario),
+        i % cfg.data.n_users,
+        cfg.data.data_len * PROBE_INDEX_OFFSET + i,
+        jnp.float32(cfg.data.snr_db),
+        geom,
+    )
+    return {
+        "x": np.asarray(batch["yp_img"], np.float32),
+        "h_perf": np.asarray(batch["h_perf"], np.float32),
+    }
+
+
+def _probe_scorer(cfg, hdce_vars, clf_vars, quantum):
+    """One engine + ONE jitted fused forward, reused across every probe set
+    of a canary: ``offline_forward`` re-jits per call (fresh wrapper, fresh
+    trace), which at S scenarios would mean 2·(S+1) compiles per canary —
+    minutes of control-plane stall at S≫3 for a program that never changes
+    between probe sets (all sets share probe_n, so one shape = one
+    compile)."""
+    import jax
+
+    from qdml_tpu.serve.engine import ServeEngine
+
+    eng = ServeEngine(cfg, hdce_vars, clf_vars, quantum=quantum)
+    fwd = jax.jit(eng._forward)
+    live = eng.live_vars()
+
+    def score(probes) -> float:
+        h, _pred, _conf = fwd(*live, jnp.asarray(probes["x"]))
+        h = np.asarray(jax.device_get(h))
+        err = float(np.sum((h - probes["h_perf"]) ** 2))
+        pow_ = float(np.sum(probes["h_perf"] ** 2))
+        return nmse_db(err / pow_)
+
+    return score
+
+
+def _served_nmse_db(cfg, hdce_vars, clf_vars, quantum, probes) -> float:
+    """End-to-end NMSE (dB) of the fused serving forward on one probe set —
+    classifier routing included, exactly what production serves. One-shot
+    form of :func:`_probe_scorer` (which amortizes the compile across many
+    probe sets)."""
+    return _probe_scorer(cfg, hdce_vars, clf_vars, quantum)(probes)
+
+
+class Deployer:
+    """Canary gate + explicit-tag hot-swap + post-deploy watch/rollback.
+
+    Transport-agnostic: ``swap_fn(tags)`` performs the actual swap — the
+    in-process controller passes ``engine.swap_from_workdir``; the remote
+    (``qdml-tpu control``) controller passes the ``{"op": "swap"}`` socket
+    verb. The canary itself always evaluates locally from the shared
+    workdir.
+    """
+
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        workdir: str,
+        swap_fn,
+        live_hdce_vars=None,
+        clf_vars=None,
+        quantum: bool = False,
+        sink=None,
+        dry_run: bool = False,
+    ):
+        ctl = cfg.control
+        self.cfg = cfg
+        self.workdir = workdir
+        self._swap_fn = swap_fn
+        self._live_hdce = live_hdce_vars
+        self._clf = clf_vars
+        self._quantum = quantum
+        self._sink = sink
+        self.dry_run = bool(dry_run)
+        self.probe_n = int(ctl.probe_n)
+        self.min_gain_db = float(ctl.min_gain_db)
+        self.tol_db = float(ctl.tol_db)
+        self.watch_ticks = int(ctl.watch_ticks)
+        self.rollback_db = float(ctl.rollback_db)
+        self._lock = threading.Lock()
+        # active post-deploy watch: {"ticks_left", "ref_db", "rollback_tags",
+        # "deployed_tags"} — None when no deploy is being watched
+        self._watch: dict | None = None
+        # the tag map this deployer last put live (deploy or rollback): the
+        # engine-less (remote) canary resolves its LIVE baseline from these,
+        # NOT from latest_tag — best > last would re-introduce the exact
+        # stale-best-shadows-fresh-last bug the explicit-tag swap fixes
+        self._live_tags: dict | None = None
+
+    def _emit(self, action: str, **payload) -> dict:
+        return emit_record(
+            self._sink, "control_event",
+            action=action, dry_run=self.dry_run, **payload,
+        )
+
+    def _live_vars(self):
+        """The params currently serving: the engine's live tuple when bound;
+        else the tags THIS deployer last deployed (a prior adaptation's
+        hdce_last must stay the baseline — latest_tag's best > last
+        preference would resolve the stale original); else the newest
+        workdir checkpoints (nothing deployed yet)."""
+        if self._live_hdce is not None and self._clf is not None:
+            return self._live_hdce, self._clf
+        from qdml_tpu.train.checkpoint import CheckpointNotFoundError, restore_latest_params
+
+        live_hdce_tag = (self._live_tags or {}).get("hdce")
+        if live_hdce_tag is not None:
+            hdce, _ = restore_params(self.workdir, live_hdce_tag)
+        else:
+            hdce, _, _ = restore_latest_params(self.workdir, "hdce")
+        try:
+            clf_tag = (self._live_tags or {}).get("qsc")
+            if clf_tag is not None:
+                clf, _ = restore_params(self.workdir, clf_tag)
+            else:
+                clf, _, _ = restore_latest_params(self.workdir, "qsc")
+            quantum = True
+        except CheckpointNotFoundError:
+            clf_tag = (self._live_tags or {}).get("sc")
+            if clf_tag is not None:
+                clf, _ = restore_params(self.workdir, clf_tag)
+            else:
+                clf, _, _ = restore_latest_params(self.workdir, "sc")
+            quantum = False
+        self._quantum = quantum
+        return hdce, clf
+
+    def set_live(self, hdce_vars, clf_vars, quantum: bool | None = None) -> None:
+        """Rebind the live reference after a confirmed deploy/rollback."""
+        self._live_hdce = hdce_vars
+        self._clf = clf_vars
+        if quantum is not None:
+            self._quantum = quantum
+
+    # -- canary -------------------------------------------------------------
+
+    def canary(
+        self, candidate_tag: str, scenario: int, drift_step: int
+    ) -> dict:
+        """Evaluate candidate vs live; returns the canary report with
+        ``passed`` set. Never swaps — :meth:`deploy` does, and only when
+        this passed."""
+        cand_vars, _ = restore_params(self.workdir, candidate_tag)
+        live_hdce, clf = self._live_vars()
+        with span("control_canary", scenario=scenario, tag=candidate_tag):
+            # one compiled forward per SIDE for the whole canary (every
+            # probe set shares probe_n, so the program never re-traces)
+            score_live = _probe_scorer(self.cfg, live_hdce, clf, self._quantum)
+            score_cand = _probe_scorer(self.cfg, cand_vars, clf, self._quantum)
+            drifted = probe_batch(
+                self.cfg, scenario, self.probe_n, drift_step=drift_step
+            )
+            drift_live = score_live(drifted)
+            drift_cand = score_cand(drifted)
+            base: dict = {}
+            worst_regress = 0.0
+            for s in range(self.cfg.data.n_scenarios):
+                probes = probe_batch(self.cfg, s, self.probe_n, drift_step=0)
+                live_db = score_live(probes)
+                cand_db = score_cand(probes)
+                base[str(s)] = {
+                    "live_db": round(live_db, 3),
+                    "cand_db": round(cand_db, 3),
+                }
+                if s == scenario:
+                    # the DRIFTED scenario's frozen family no longer exists
+                    # in production — a trunk adapted to a large drift
+                    # necessarily regresses on it, and gating on that would
+                    # block adaptation exactly when drift is largest. Its
+                    # frozen-family numbers stay in the report (informational)
+                    continue
+                worst_regress = max(worst_regress, cand_db - live_db)
+        gain = drift_live - drift_cand
+        passed = gain >= self.min_gain_db and worst_regress <= self.tol_db
+        return self._emit(
+            "canary",
+            passed=bool(passed),
+            tag=candidate_tag,
+            scenario=int(scenario),
+            drift_step=int(drift_step),
+            gain_db=round(gain, 3),
+            min_gain_db=self.min_gain_db,
+            worst_base_regress_db=round(worst_regress, 3),
+            tol_db=self.tol_db,
+            drifted_probes={
+                "live_db": round(drift_live, 3), "cand_db": round(drift_cand, 3)
+            },
+            base_probes=base,
+        )
+
+    # -- deploy + watch -----------------------------------------------------
+
+    def deploy(
+        self,
+        tags: dict,
+        rollback_tags: dict,
+        ref_db: float | None = None,
+    ) -> dict:
+        """Hot-swap ``tags`` live (explicit-tag path — a stale ``*_best``
+        cannot shadow them) and arm the watch window with ``rollback_tags``
+        as the escape hatch. ``ref_db`` is the served-NMSE reference the
+        watch compares against (e.g. the canary's candidate probe figure)."""
+        if self.dry_run:
+            return self._emit("deploy", tags=tags, skipped="dry_run")
+        rec = self._swap_fn(tags)
+        self._live_tags = {**(self._live_tags or {}), **tags}
+        with self._lock:
+            self._watch = {
+                "ticks_left": self.watch_ticks,
+                "ref_db": ref_db,
+                "rollback_tags": dict(rollback_tags),
+                "deployed_tags": dict(tags),
+            }
+        return self._emit("deploy", tags=tags, swap=rec, ref_db=ref_db)
+
+    def watching(self) -> bool:
+        with self._lock:
+            return self._watch is not None
+
+    def observe_served(self, nmse_db_served: float | None) -> dict | None:
+        """One watch tick: feed the latest served-NMSE stat (None when the
+        tick had no measurement — the tick still counts down, a deploy must
+        not stay on watch forever). Returns the rollback record when the
+        watch tripped, the confirmation record when the window closed clean,
+        else None."""
+        with self._lock:
+            if self._watch is None:
+                return None
+            w = self._watch
+            regressed = (
+                nmse_db_served is not None
+                and w["ref_db"] is not None
+                and nmse_db_served > w["ref_db"] + self.rollback_db
+            )
+            w["ticks_left"] -= 1
+            confirmed = w["ticks_left"] <= 0 and not regressed
+            if regressed or confirmed:
+                self._watch = None
+        if regressed:
+            rec = self._swap_fn(w["rollback_tags"])
+            # the rollback tags are now live: re-point the canary baseline
+            # and drop any bound in-memory reference (it holds the params
+            # the rollback just replaced)
+            self._live_tags = {**(self._live_tags or {}), **w["rollback_tags"]}
+            self._live_hdce = None
+            self._clf = None
+            return self._emit(
+                "rollback",
+                tags=w["rollback_tags"],
+                from_tags=w["deployed_tags"],
+                observed_db=round(float(nmse_db_served), 3),
+                ref_db=w["ref_db"],
+                rollback_db=self.rollback_db,
+                swap=rec,
+            )
+        if confirmed:
+            return self._emit("deploy_confirmed", tags=w["deployed_tags"])
+        return None

@@ -1,0 +1,208 @@
+"""Streaming drift detection over per-scenario serve statistics.
+
+The serving stack already measures everything a detector needs
+(``ServeMetrics``: per-scenario prediction counts + confidence sums, the
+sparse-dispatch overflow counters, and — when ground truth is available, as
+in the loadgen/dryrun harnesses — served NMSE); this module turns those
+streams into *decisions*. Detection is the Page-Hinkley/CUSUM family: per
+(scenario, signal) a one-sided cumulative-deviation statistic against the
+stream's own running mean, with a magnitude slack ``delta`` (drift smaller
+than this is noise by definition) and a trip threshold. Two hardening
+layers sit on top, because a false fine-tune + swap cycle is expensive:
+
+- **min_samples** — the running mean must be established before the
+  statistic can trip (the first windows DEFINE in-distribution);
+- **debounce** — ``debounce`` CONSECUTIVE tripping windows are required
+  before a ``drift_event`` fires; a single noisy window resets nothing and
+  triggers nothing.
+
+A fired detector latches (``active()``) until the controller adapts and
+calls :meth:`DriftMonitor.reset` — re-arming against the post-adaptation
+distribution, so the detector never compares the fine-tuned world against
+the stale pre-drift mean.
+
+Signals and their trip directions (docs/CONTROL.md):
+
+- ``confidence`` — per-scenario windowed mean of the routed class's
+  probability; drift trips on a sustained DROP;
+- ``nmse_parity`` — served NMSE in dB (fed externally by harnesses that
+  know ground truth); trips on a sustained RISE (values are ~10x the
+  fraction signals, so callers scale thresholds — ``DB_SCALE``);
+- ``overflow_rate`` — sparse-dispatch overflow fraction (scenario ``-1``,
+  fleet-wide); trips on a sustained RISE (a scenario-mix shift starving
+  expert capacity).
+
+Thread safety: the monitor is written by the controller tick thread and read
+by status/report paths, so the detector-window map is lock-guarded
+(``_windows`` -> ``_lock``, enforced by graftlint's LOCK_MAP).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from qdml_tpu.control.events import emit_record
+
+# nmse_parity streams are in dB (~10x the dynamic range of the [0, 1]
+# fraction signals): detector delta/threshold scale up by this factor.
+DB_SCALE = 10.0
+
+# signal -> trip direction ("down": a sustained drop is drift; "up": a rise)
+SIGNALS: dict[str, str] = {
+    "confidence": "down",
+    "nmse_parity": "up",
+    "overflow_rate": "up",
+}
+
+
+class PageHinkley:
+    """One-sided Page-Hinkley/CUSUM mean-shift detector for a scalar stream.
+
+    ``update(x)`` folds one observation into the running mean and the
+    cumulative deviation statistic ``cum = max(0, cum + dev)`` where ``dev``
+    is ``mean - x - delta`` (direction "down") or ``x - mean - delta``
+    ("up"); returns True while ``cum > threshold`` and at least
+    ``min_samples`` observations established the mean. ``delta`` is the
+    magnitude slack (drift smaller than delta never accumulates), so on a
+    stationary stream ``cum`` repeatedly decays to zero — the
+    false-positive property pinned in tests/test_control.py.
+    """
+
+    def __init__(
+        self,
+        delta: float = 0.01,
+        threshold: float = 0.15,
+        direction: str = "down",
+        min_samples: int = 5,
+    ):
+        if direction not in ("down", "up"):
+            raise ValueError(f"direction must be 'down' or 'up', got {direction!r}")
+        if delta < 0 or threshold <= 0:
+            raise ValueError(
+                f"need delta >= 0 and threshold > 0, got {delta}, {threshold}"
+            )
+        self.delta = float(delta)
+        self.threshold = float(threshold)
+        self.direction = direction
+        self.min_samples = int(min_samples)
+        self.reset()
+
+    def reset(self) -> None:
+        self.n = 0
+        self.mean = 0.0
+        self.cum = 0.0
+
+    def update(self, x: float) -> bool:
+        x = float(x)
+        self.n += 1
+        # running mean BEFORE folding x in would bias the very first windows;
+        # the standard PH form tracks the mean of everything seen so far
+        self.mean += (x - self.mean) / self.n
+        dev = (self.mean - x - self.delta) if self.direction == "down" else (
+            x - self.mean - self.delta
+        )
+        self.cum = max(0.0, self.cum + dev)
+        return self.n >= self.min_samples and self.cum > self.threshold
+
+
+class DriftMonitor:
+    """Per-(scenario, signal) detector bank with debounce + latched events.
+
+    ``observe(scenario, signal, value)`` feeds one windowed statistic (the
+    controller differences two metric-verb snapshots to build windows) and
+    returns a ``drift_event`` record dict the FIRST time that stream's
+    debounced detector fires — also emitted to the telemetry sink, so every
+    detection is a durable, structured artifact. The stream then stays
+    ``active`` until :meth:`reset` re-arms it (post-adaptation).
+    """
+
+    def __init__(
+        self,
+        delta: float = 0.01,
+        threshold: float = 0.15,
+        debounce: int = 2,
+        min_samples: int = 5,
+        sink=None,
+    ):
+        self.delta = float(delta)
+        self.threshold = float(threshold)
+        self.debounce = max(1, int(debounce))
+        self.min_samples = int(min_samples)
+        self._sink = sink
+        self._lock = threading.Lock()
+        # (scenario, signal) -> {"det": PageHinkley, "hits": int, "fired": bool}
+        self._windows: dict[tuple[int, str], dict] = {}
+
+    def observe(self, scenario: int, signal: str, value: float) -> dict | None:
+        """Feed one windowed statistic; returns the ``drift_event`` record on
+        the debounced first trip of that (scenario, signal) stream, else
+        ``None``. Unknown signals raise — a typo'd signal name silently
+        never detecting anything is the worst failure mode a detector can
+        have."""
+        if signal not in SIGNALS:
+            raise ValueError(f"unknown drift signal {signal!r} (have {sorted(SIGNALS)})")
+        with self._lock:
+            key = (int(scenario), signal)
+            ent = self._windows.get(key)
+            if ent is None:
+                scale = DB_SCALE if signal == "nmse_parity" else 1.0
+                ent = self._windows[key] = {
+                    "det": PageHinkley(
+                        delta=self.delta * scale,
+                        threshold=self.threshold * scale,
+                        direction=SIGNALS[signal],
+                        min_samples=self.min_samples,
+                    ),
+                    "hits": 0,
+                    "fired": False,
+                }
+            if ent["fired"]:
+                return None  # latched: one event per drift episode
+            det: PageHinkley = ent["det"]
+            tripped = det.update(value)
+            ent["hits"] = ent["hits"] + 1 if tripped else 0
+            if ent["hits"] < self.debounce:
+                return None
+            ent["fired"] = True
+            event = {
+                "scenario": int(scenario),
+                "signal": signal,
+                "value": round(float(value), 6),
+                "mean": round(det.mean, 6),
+                "stat": round(det.cum, 6),
+                "threshold": det.threshold,
+                "windows": det.n,
+                "debounce": self.debounce,
+            }
+        return emit_record(self._sink, "drift_event", **event)
+
+    def active(self) -> list[tuple[int, str]]:
+        """(scenario, signal) streams whose drift_event has fired and not
+        been reset — what the controller's adaptation queue drains."""
+        with self._lock:
+            return sorted(k for k, e in self._windows.items() if e["fired"])
+
+    def reset(self, scenario: int | None = None) -> None:
+        """Re-arm detectors (all of them, or one scenario's) — called after
+        an adaptation deploys, so the bank learns the POST-adaptation
+        distribution as its new in-distribution mean."""
+        with self._lock:
+            for (s, _sig), ent in self._windows.items():
+                if scenario is None or s == int(scenario):
+                    ent["det"].reset()
+                    ent["hits"] = 0
+                    ent["fired"] = False
+
+    def state(self) -> dict:
+        """Snapshot for status displays / control_event records."""
+        with self._lock:
+            return {
+                f"{s}:{sig}": {
+                    "n": e["det"].n,
+                    "mean": round(e["det"].mean, 6),
+                    "stat": round(e["det"].cum, 6),
+                    "hits": e["hits"],
+                    "fired": e["fired"],
+                }
+                for (s, sig), e in sorted(self._windows.items())
+            }

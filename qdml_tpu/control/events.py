@@ -1,0 +1,23 @@
+"""One home for control-plane telemetry emission.
+
+Every control module needs the same three lines — resolve the explicit sink
+or the process-global one, check it is active, emit a ``counters`` record —
+and keeping four copies in sync is how a future record-shape change
+silently drops telemetry from one emitter. ``drift_event`` and
+``control_event`` records (schemas: docs/CONTROL.md) both route through
+here.
+"""
+
+from __future__ import annotations
+
+from qdml_tpu.telemetry.spans import get_sink
+
+
+def emit_record(sink, name: str, **payload) -> dict:
+    """Emit one ``counters`` record named ``name`` to ``sink`` (or the
+    process-global sink when ``sink`` is None); returns the payload either
+    way, so callers can use the emitted record as their return value."""
+    target = sink if sink is not None else get_sink()
+    if target is not None and getattr(target, "active", False):
+        target.emit("counters", name=name, **payload)
+    return payload

@@ -1,0 +1,262 @@
+"""Continual fine-tuning of ONE drifted scenario trunk.
+
+The adaptation step of the control loop (docs/CONTROL.md): when a scenario's
+channel family drifts, ONLY that scenario's expert trunk needs new weights —
+the shared FC head encodes cross-scenario structure serving every family,
+and the other trunks' families did not move. Retraining everything would be
+slower, riskier (a full retrain can regress healthy scenarios) and pointless.
+
+Mechanics:
+
+- **warm start** — the live checkpoint restores from the workdir (explicit
+  ``base_tag`` or ``latest_tag`` discovery, exactly the restore machinery
+  serving uses), so fine-tuning continues from the deployed weights;
+- **single-trunk isolation** — the stacked trunk params carry a leading
+  scenario axis (:class:`~qdml_tpu.models.cnn.StackedConvP128`), so slice
+  ``s`` is carved into a 1-scenario :class:`~qdml_tpu.train.hdce.HDCE`
+  twin (identical module names -> identical param tree modulo the leading
+  axis). Every OTHER trunk never enters the fine-tune step at all — frozen
+  by construction, bit-identical by construction;
+- **masked head** — the shared FC head must ride along in the forward (the
+  trunk adapts TO the frozen head) but must not move: an
+  ``optax.multi_transform`` maps its subtree to ``set_to_zero`` while the
+  trunk slice gets Adam — the masked-optimizer half of the freeze. At
+  reassembly the head subtree is taken from the BASE checkpoint verbatim,
+  so head bit-identity is guaranteed even against degenerate float edge
+  cases (``-0.0 + 0.0``), not just expected;
+- **drifted on-device data** — fresh batches synthesize inside the jitted
+  step from the drifted channel family (``family_table`` at the detected
+  drift step, the scenario's row perturbed), via the grid loader's scenario
+  slice — no files, no host batch build;
+- **normal checkpoint tags** — the reassembled full tree saves as
+  ``hdce_last`` with provenance meta, so every existing restore path
+  (serving, eval, export) works unchanged. The deployer must pass this tag
+  EXPLICITLY to the hot-swap: ``latest_tag``'s best > last preference would
+  let a stale ``hdce_best`` from the original training run shadow it (the
+  fix in ``ServeEngine.swap_from_workdir``).
+
+Compile accounting: fine-tune steps compile like any training — in a
+production fleet this runs on a trainer process, not the serving process;
+the in-process dryrun snapshots compile counters per traffic window so the
+zero-request-path-compile pins stay meaningful (scripts/control_dryrun.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import optax
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.data.channels import ChannelGeometry
+from qdml_tpu.data.datasets import DMLGridLoader
+from qdml_tpu.telemetry import span
+from qdml_tpu.train.checkpoint import (
+    latest_tag,
+    restore_params,
+    save_checkpoint,
+)
+from qdml_tpu.train.hdce import HDCE, make_hdce_eval_step, make_hdce_train_step
+from qdml_tpu.train.state import TrainState
+from qdml_tpu.models.cnn import activation_dtype
+
+
+def _subtree_keys(params: dict) -> tuple[str, str]:
+    """(trunk_key, head_key) of the HDCE param tree — resolved by module
+    name rather than hardcoded index, so a flax renaming fails loudly here
+    instead of silently freezing the wrong subtree."""
+    trunk = next((k for k in params if "StackedConv" in k), None)
+    head = next((k for k in params if "FCP128" in k), None)
+    if trunk is None or head is None:
+        raise ValueError(
+            f"HDCE param tree missing trunk/head subtrees (have {sorted(params)})"
+        )
+    return trunk, head
+
+
+def _slice_scenario(tree, s: int):
+    """Take stacked-axis slice ``s`` keeping the leading axis (S=1)."""
+    return jax.tree.map(lambda a: np.asarray(a)[s : s + 1], tree)
+
+
+def _scatter_scenario(base_tree, ft_tree, s: int):
+    """Write the fine-tuned slice back into a COPY of the base stack; every
+    other row is a byte-for-byte copy of the base array (the bit-identity
+    pin in tests/test_control.py)."""
+
+    def _set(b, f):
+        out = np.array(b)  # host copy; rows != s untouched bits
+        out[s] = np.asarray(f[0], out.dtype)
+        return out
+
+    return jax.tree.map(_set, base_tree, ft_tree)
+
+
+def finetune_trunk(
+    cfg: ExperimentConfig,
+    workdir: str,
+    scenario: int,
+    drift_step: int,
+    steps: int | None = None,
+    lr: float | None = None,
+    batch_size: int | None = None,
+    base_tag: str | None = None,
+    seed: int = 0,
+) -> dict:
+    """Fine-tune scenario ``scenario``'s trunk on its drifted channel family
+    and save the reassembled checkpoint as ``hdce_last``.
+
+    Returns the promotion record: ``{"tag", "rollback_tag", "scenario",
+    "drift_step", "steps", "loss_first", "loss_last", "val_nmse_db_before",
+    "val_nmse_db_after", "base_tag"}``. ``rollback_tag`` names a checkpoint
+    holding the PRE-fine-tune params (the warm-start source; when
+    ``hdce_last`` itself was the source, a ``hdce_prev`` backup is written
+    first so rolling back from disk is always possible).
+    """
+    if not (0 <= scenario < cfg.data.n_scenarios):
+        raise ValueError(
+            f"scenario must be < {cfg.data.n_scenarios}, got {scenario}"
+        )
+    if drift_step < 1:
+        raise ValueError(f"drift_step must be >= 1 to fine-tune, got {drift_step}")
+    ctl = cfg.control
+    steps = int(steps if steps is not None else ctl.ft_steps)
+    lr = float(lr if lr is not None else ctl.ft_lr)
+    batch_size = int(batch_size if batch_size is not None else ctl.ft_batch)
+
+    base_tag = base_tag or latest_tag(workdir, "hdce")
+    if base_tag is None:
+        raise FileNotFoundError(
+            f"no hdce checkpoint under {workdir!r} to warm-start from"
+        )
+    base_vars, base_meta = restore_params(workdir, base_tag)
+    trunk_key, head_key = _subtree_keys(base_vars["params"])
+
+    # 1-scenario twin of the serving model: same module classes, same names,
+    # so the sliced subtrees drop straight in
+    model = HDCE(
+        n_scenarios=1,
+        features=cfg.model.features,
+        out_dim=cfg.h_out_dim,
+        dtype=activation_dtype(cfg.model.dtype),
+        bn_momentum=0.9**cfg.data.n_users,
+        conv_impl=cfg.model.conv_impl,
+    )
+    params = {
+        trunk_key: _slice_scenario(base_vars["params"][trunk_key], scenario),
+        head_key: jax.tree.map(np.asarray, base_vars["params"][head_key]),
+    }
+    batch_stats = {
+        trunk_key: _slice_scenario(base_vars["batch_stats"][trunk_key], scenario)
+    }
+    # masked optimizer: the trunk trains, the shared head's updates are
+    # ZEROED — it shapes the gradients (the trunk adapts to the head it will
+    # serve behind) but never moves
+    labels = {
+        trunk_key: jax.tree.map(lambda _: "train", params[trunk_key]),
+        head_key: jax.tree.map(lambda _: "freeze", params[head_key]),
+    }
+    tx = optax.multi_transform(
+        {"train": optax.adam(lr), "freeze": optax.set_to_zero()}, labels
+    )
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx, batch_stats=batch_stats
+    )
+
+    # drifted single-scenario data: the loader's scenario slice generates
+    # ONLY rows of family `scenario`, whose family_table row is perturbed at
+    # the detected drift step — synthesis happens inside the jitted step
+    drift_data = dataclasses.replace(
+        cfg.data, drift_step=int(drift_step), drift_scenario=int(scenario),
+        seed=cfg.data.seed + seed,
+    )
+    geom = ChannelGeometry.from_config(drift_data)
+    train_loader = DMLGridLoader(drift_data, batch_size, "train", geom)
+    train_loader.set_process_slice(
+        0, train_loader.batch_size, scen_start=scenario, scen_count=1
+    )
+    val_loader = DMLGridLoader(drift_data, batch_size, "val", geom)
+    val_loader.set_process_slice(
+        0, val_loader.batch_size, scen_start=scenario, scen_count=1
+    )
+
+    train_step = make_hdce_train_step(model, state.tx, probes=False)
+    eval_step = make_hdce_eval_step(model)
+
+    def _val_nmse_db(st) -> float:
+        err = pow_ = 0.0
+        for i, batch in enumerate(val_loader.epoch(0, shuffle=False)):
+            out = eval_step(st, batch)
+            err += float(out["err"])
+            pow_ += float(out["pow"])
+            if i >= 3:  # a few hundred samples bound the probe cost
+                break
+        return 10.0 * np.log10(max(err / max(pow_, 1e-30), 1e-30))
+
+    with span("control_finetune", scenario=scenario, drift_step=drift_step, steps=steps):
+        val_before = _val_nmse_db(state)
+        loss_first = loss_last = None
+        done = 0
+        epoch = 0
+        while done < steps:
+            for batch in train_loader.epoch(epoch):
+                state, m = train_step(state, batch)
+                loss_last = float(m["loss"])
+                if loss_first is None:
+                    loss_first = loss_last
+                done += 1
+                if done >= steps:
+                    break
+            epoch += 1
+        val_after = _val_nmse_db(state)
+    if loss_last is None or not np.isfinite(loss_last):
+        raise RuntimeError(
+            f"fine-tune of scenario {scenario} produced non-finite loss "
+            f"({loss_last}) — refusing to promote a checkpoint"
+        )
+
+    # reassemble: fine-tuned slice scattered into the base stack; head and
+    # every other trunk are the BASE arrays verbatim (bit-identity by
+    # construction, not by arithmetic)
+    new_params = dict(base_vars["params"])
+    new_params[trunk_key] = _scatter_scenario(
+        base_vars["params"][trunk_key], state.params[trunk_key], scenario
+    )
+    new_stats = dict(base_vars["batch_stats"])
+    new_stats[trunk_key] = _scatter_scenario(
+        base_vars["batch_stats"][trunk_key], state.batch_stats[trunk_key], scenario
+    )
+
+    rollback_tag = base_tag
+    if base_tag == "hdce_last":
+        # the promotion below overwrites the warm-start source: keep a disk
+        # copy so rollback never depends on in-memory state alone
+        save_checkpoint(workdir, "hdce_prev", base_vars, base_meta or None)
+        rollback_tag = "hdce_prev"
+    rec = {
+        "tag": "hdce_last",
+        "rollback_tag": rollback_tag,
+        "base_tag": base_tag,
+        "scenario": int(scenario),
+        "drift_step": int(drift_step),
+        "steps": steps,
+        "lr": lr,
+        "loss_first": loss_first,
+        "loss_last": loss_last,
+        "val_nmse_db_before": round(val_before, 3),
+        "val_nmse_db_after": round(val_after, 3),
+    }
+    meta = {
+        "epoch": int((base_meta or {}).get("epoch", -1)),
+        "name": cfg.name,
+        "finetune": {k: rec[k] for k in (
+            "scenario", "drift_step", "steps", "lr", "base_tag",
+            "val_nmse_db_before", "val_nmse_db_after",
+        )},
+    }
+    save_checkpoint(
+        workdir, "hdce_last", {"params": new_params, "batch_stats": new_stats}, meta
+    )
+    return rec

@@ -1,0 +1,421 @@
+"""FleetController: the supervised serve -> detect -> adapt -> deploy loop.
+
+One controller per fleet. Each tick it polls the live metrics view (the
+same payload the ``{"op": "metrics"}`` verb serves), differences the
+per-scenario counters against the previous poll into WINDOWED statistics,
+feeds the drift detectors and the autoscaler, services any post-deploy
+watch window, and — when a debounced drift_event has fired — runs the
+adaptation pipeline:
+
+    drift_event(scenario s)
+      -> finetune_trunk(s)        # only trunk s trains; head + peers frozen
+      -> Deployer.canary          # candidate vs live on held-out probes
+      -> Deployer.deploy          # explicit-tag hot-swap, zero recompiles
+      -> watch window             # served stats; auto-rollback on regress
+      -> DriftMonitor.reset       # re-arm against the adapted distribution
+
+Two attachment modes share all of that logic:
+
+- **in-process** (:class:`PoolPoller`) — the controller holds the
+  :class:`~qdml_tpu.serve.server.ReplicaPool` and
+  :class:`~qdml_tpu.serve.engine.ServeEngine` directly (the dryrun/test
+  harness, scripts/control_dryrun.py);
+- **remote** (:class:`SocketPoller`, ``qdml-tpu control``) — the controller
+  attaches to a running ``qdml-tpu serve`` endpoint over the
+  ``metrics``/``swap``/``scale`` verbs and shares only the checkpoint
+  workdir; fine-tune and canary run in the controller's process, so the
+  serving process's request path never compiles.
+
+Drift-step hint: in this reproduction the drifted channel family is
+SYNTHESIZED (``family_table`` drift trajectories) — the controller cannot
+measure the environment's true drift step from serve stats alone, so
+``drift_step_hint`` (default ``serve.drift_step``, the injected value in
+the harnesses) tells fine-tune/canary which family to synthesize. A real
+deployment replaces that data source with logged production traffic; every
+other part of the loop is production-shaped.
+
+Every decision lands in the telemetry stream as a structured
+``control_event`` record (schemas: docs/CONTROL.md); ``control.dry_run``
+reports decisions without acting on any of them.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.control.autoscale import Autoscaler
+from qdml_tpu.control.deploy import Deployer
+from qdml_tpu.control.drift import DriftMonitor
+from qdml_tpu.control.events import emit_record
+
+# an adaptation that keeps failing its canary must not retrain forever on
+# the same drift episode: after this many failed attempts per scenario the
+# stream stays latched and a human reads the control_events
+MAX_ADAPT_ATTEMPTS = 3
+
+
+class PoolPoller:
+    """In-process attachment: the controller owns references to the live
+    pool + engine + workdir (dryrun/tests)."""
+
+    def __init__(self, pool, engine, workdir: str):
+        self.pool = pool
+        self.engine = engine
+        self.workdir = workdir
+
+    def metrics(self) -> dict:
+        return self.pool.live_metrics()
+
+    def swap(self, tags: dict) -> dict:
+        return self.engine.swap_from_workdir(self.workdir, tags=tags)
+
+    def scale(self, n: int) -> dict:
+        return self.pool.scale_to(n)
+
+
+class SocketPoller:
+    """Remote attachment over the serve socket's JSON verbs (one short-lived
+    connection per call: the controller polls on second timescales, and a
+    held-open connection would couple its lifetime to the server's)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = float(timeout_s)
+
+    def _verb(self, payload: dict) -> dict:
+        with socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        ) as sk:
+            fh = sk.makefile("rw", encoding="utf-8", newline="\n")
+            fh.write(json.dumps(payload) + "\n")
+            fh.flush()
+            line = fh.readline()
+        if not line:
+            raise ConnectionError(f"serve endpoint {self.host}:{self.port} closed")
+        rep = json.loads(line)
+        if not rep.get("ok"):
+            raise RuntimeError(f"verb {payload.get('op')!r} failed: {rep.get('reason')}")
+        return rep
+
+    def metrics(self) -> dict:
+        return self._verb({"op": "metrics"})["metrics"]
+
+    def swap(self, tags: dict) -> dict:
+        return self._verb({"op": "swap", "tags": tags})["swap"]
+
+    def scale(self, n: int) -> dict:
+        return self._verb({"op": "scale", "replicas": n})["scale"]
+
+
+class FleetController:
+    """The loop. Construct with a poller, call :meth:`tick` (or :meth:`run`);
+    harnesses with ground truth additionally feed :meth:`observe_parity`."""
+
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        workdir: str,
+        poller,
+        engine=None,
+        sink=None,
+        drift_step_hint: int | None = None,
+    ):
+        ctl = cfg.control
+        self.cfg = cfg
+        self.workdir = workdir
+        self.poller = poller
+        self.engine = engine
+        self._sink = sink
+        self.dry_run = bool(ctl.dry_run)
+        self.drift_step_hint = int(
+            drift_step_hint
+            if drift_step_hint is not None
+            else (cfg.serve.drift_step or 1)
+        )
+        self.min_window = int(ctl.min_window)
+        self.monitor = DriftMonitor(
+            delta=ctl.ph_delta,
+            threshold=ctl.ph_threshold,
+            debounce=ctl.debounce,
+            min_samples=5,
+            sink=sink,
+        )
+        self.autoscaler = (
+            Autoscaler(
+                poller.scale,
+                min_replicas=ctl.min_replicas,
+                max_replicas=ctl.max_replicas,
+                queue_high=ctl.queue_high,
+                queue_low=ctl.queue_low,
+                debounce=ctl.scale_debounce,
+                cooldown_ticks=ctl.cooldown_ticks,
+                sink=sink,
+                dry_run=ctl.dry_run,
+            )
+            if ctl.autoscale
+            else None
+        )
+        live = engine.live_vars() if engine is not None else (None, None)
+        self.deployer = Deployer(
+            cfg,
+            workdir,
+            swap_fn=poller.swap,
+            live_hdce_vars=live[0],
+            clf_vars=live[1],
+            quantum=bool(getattr(engine, "quantum", False)),
+            sink=sink,
+            dry_run=ctl.dry_run,
+        )
+        self._prev_scenario: dict = {}
+        self._prev_dispatch: dict = {}
+        # latest served-NMSE measurement PER SCENARIO: the post-deploy watch
+        # must compare the adapted scenario's own parity against the canary
+        # reference — another scenario's intrinsically-worse NMSE fed to a
+        # scenario-agnostic slot would trip spurious rollbacks
+        self._latest_parity: dict[int, float] = {}
+        self._watch_scenario: int | None = None
+        self._attempts: dict[int, int] = {}
+        self._prev_slo: dict | None = None
+        # dry-run adapt decisions and suspensions are reported ONCE per drift
+        # episode — a latched detector would otherwise re-report every tick
+        # forever
+        self._dry_reported: set[int] = set()
+        self._suspended_reported: set[int] = set()
+        self.ticks = 0
+
+    # -- telemetry ----------------------------------------------------------
+
+    def _emit(self, action: str, **payload) -> dict:
+        return emit_record(
+            self._sink, "control_event",
+            action=action, dry_run=self.dry_run, **payload,
+        )
+
+    # -- external ground-truth feed ------------------------------------------
+
+    def observe_parity(self, scenario: int, nmse_db_served: float) -> dict | None:
+        """Feed a served-NMSE measurement (dB) for one scenario — harnesses
+        that know ground truth (loadgen windows, the dryrun) wire this; it
+        drives both the ``nmse_parity`` drift detector and the post-deploy
+        watch reference (keyed by scenario — the watch only reads the
+        adapted scenario's own stream)."""
+        self._latest_parity[int(scenario)] = float(nmse_db_served)
+        return self.monitor.observe(scenario, "nmse_parity", nmse_db_served)
+
+    # -- the loop ------------------------------------------------------------
+
+    def _window_scenarios(self, m: dict) -> list[dict]:
+        """Difference this poll's per-scenario cumulative counters against
+        the previous poll into windowed means; feed the detectors."""
+        events = []
+        per = m.get("per_scenario") or {}
+        for key, cur in per.items():
+            prev = self._prev_scenario.get(key, {"n": 0, "conf_sum": 0.0})
+            dn = cur.get("n", 0) - prev.get("n", 0)
+            dconf = cur.get("conf_sum", 0.0) - prev.get("conf_sum", 0.0)
+            if dn >= self.min_window and cur.get("conf_sum") is not None:
+                ev = self.monitor.observe(int(key), "confidence", dconf / dn)
+                if ev:
+                    events.append(ev)
+        self._prev_scenario = {
+            k: {"n": v.get("n", 0), "conf_sum": v.get("conf_sum", 0.0)}
+            for k, v in per.items()
+        }
+        disp = m.get("dispatch") or {}
+        prev_d = self._prev_dispatch
+        d_routed = (disp.get("routed_rows") or 0) - (prev_d.get("routed_rows") or 0)
+        d_over = (disp.get("overflow_rows") or 0) - (prev_d.get("overflow_rows") or 0)
+        if d_routed >= self.min_window:
+            ev = self.monitor.observe(-1, "overflow_rate", d_over / d_routed)
+            if ev:
+                events.append(ev)
+        self._prev_dispatch = {
+            "routed_rows": disp.get("routed_rows"),
+            "overflow_rows": disp.get("overflow_rows"),
+        }
+        return events
+
+    def _windowed_slo(self, slo: dict | None) -> float | None:
+        """Attainment over THIS poll window (cumulative counters
+        differenced), like every other detector input. The pool-lifetime
+        aggregate would let one early overload veto scale-down forever."""
+        prev = self._prev_slo
+        self._prev_slo = dict(slo) if slo else self._prev_slo
+        if not slo:
+            return None
+        dn = slo.get("n", 0) - (prev or {}).get("n", 0)
+        dmet = slo.get("met", 0) - (prev or {}).get("met", 0)
+        return dmet / dn if dn > 0 else None
+
+    def _adapt(self, scenario: int) -> dict:
+        """The adaptation pipeline for one drifted scenario."""
+        from qdml_tpu.control.finetune import finetune_trunk
+
+        attempts = self._attempts.get(scenario, 0)
+        if attempts >= MAX_ADAPT_ATTEMPTS:
+            if scenario in self._suspended_reported:
+                return {}
+            self._suspended_reported.add(scenario)
+            return self._emit(
+                "adapt_suspended", scenario=scenario, attempts=attempts
+            )
+        if self.dry_run:
+            if scenario in self._dry_reported:
+                return {}
+            self._dry_reported.add(scenario)
+            return self._emit(
+                "adapt", scenario=scenario, skipped="dry_run",
+                drift_step=self.drift_step_hint,
+            )
+        self._attempts[scenario] = attempts + 1
+        ft = finetune_trunk(
+            self.cfg, self.workdir, scenario, drift_step=self.drift_step_hint
+        )
+        self._emit("finetune", **ft)
+        rep = self.deployer.canary(ft["tag"], scenario, self.drift_step_hint)
+        if not rep["passed"]:
+            # re-arm: if the drift persists, the detectors re-fire after
+            # fresh debounced windows and we try again (bounded above)
+            self.monitor.reset(scenario)
+            return self._emit("adapt_aborted", scenario=scenario, canary=rep)
+        dep = self.deployer.deploy(
+            tags={"hdce": ft["tag"]},
+            rollback_tags={"hdce": ft["rollback_tag"]},
+            ref_db=rep["drifted_probes"]["cand_db"],
+        )
+        if self.engine is not None:
+            # rebind the canary's live reference to the now-serving params
+            self.deployer.set_live(*self.engine.live_vars())
+        # the WHOLE bank re-arms: post-deploy serve stats are a new
+        # distribution for every scenario (routing shares the classifier).
+        # The per-scenario poll snapshot is deliberately KEPT — clearing it
+        # would make the re-armed detectors' first window a difference
+        # against zero, i.e. a pool-lifetime aggregate, not a window
+        self.monitor.reset()
+        # a deploy invalidates any parity measured against the OLD params:
+        # the watch must wait for a fresh post-deploy measurement (ticks
+        # without one still count down), not roll back on a stale reading
+        self._watch_scenario = scenario
+        self._latest_parity.pop(scenario, None)
+        self._attempts[scenario] = 0
+        return self._emit(
+            "adapted", scenario=scenario, finetune=ft, canary=rep, deploy=dep
+        )
+
+    def tick(self) -> dict:
+        """One observe -> decide -> act cycle; returns what happened (the
+        same facts the control_event records carry)."""
+        self.ticks += 1
+        m = self.poller.metrics()
+        out: dict = {"tick": self.ticks, "events": []}
+        out["events"].extend(self._window_scenarios(m))
+        if self.autoscaler is not None:
+            act = self.autoscaler.observe(
+                float(m.get("queue_depth_now") or 0.0),
+                int(m.get("replicas") or 1),
+                self._windowed_slo(m.get("slo")),
+            )
+            if act:
+                out["events"].append(act)
+        if self.deployer.watching():
+            watch = self.deployer.observe_served(
+                self._latest_parity.get(self._watch_scenario)
+                if self._watch_scenario is not None
+                else None
+            )
+            if watch:
+                out["events"].append(watch)
+        else:
+            fired = [s for s, _sig in self.monitor.active() if s >= 0]
+            for scenario in fired:
+                ev = self._adapt(scenario)
+                if ev:
+                    out["events"].append(ev)
+                if self._attempts.get(scenario, 0) < MAX_ADAPT_ATTEMPTS:
+                    # one real adaptation per tick; a SUSPENDED scenario only
+                    # (re-)reports and must not starve later-numbered drifted
+                    # scenarios of their turn
+                    break
+        return out
+
+    def run(
+        self,
+        ticks: int | None = None,
+        interval_s: float | None = None,
+        stop: threading.Event | None = None,
+    ) -> int:
+        """Tick until ``ticks`` is exhausted / ``stop`` is set /
+        KeyboardInterrupt. Transient endpoint failures (server restarting)
+        are reported and retried next tick; they must not kill the
+        supervisor."""
+        interval = float(
+            interval_s if interval_s is not None else self.cfg.control.interval_s
+        )
+        done = 0
+        try:
+            while (ticks is None or done < ticks) and not (stop and stop.is_set()):
+                try:
+                    self.tick()
+                except (ConnectionError, OSError, TimeoutError) as e:
+                    self._emit("poll_failed", error=str(e))
+                except (RuntimeError, ValueError, FileNotFoundError) as e:
+                    # an adaptation-pipeline failure (rejected swap verb,
+                    # non-finite fine-tune loss, checkpoint race) is ONE
+                    # failed episode, not a reason to stop supervising the
+                    # fleet — autoscaling, watch/rollback and detection must
+                    # keep running; the record carries the error
+                    self._emit(
+                        "tick_failed", error=f"{type(e).__name__}: {e}"
+                    )
+                done += 1
+                if stop is not None:
+                    stop.wait(interval)
+                else:
+                    time.sleep(interval)
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    def run_in_thread(
+        self, interval_s: float | None = None
+    ) -> tuple[threading.Thread, threading.Event]:
+        """Background supervision (the dryrun runs the controller alongside
+        live loadgen traffic): returns (thread, stop_event)."""
+        stop = threading.Event()
+        t = threading.Thread(
+            target=self.run,
+            kwargs={"interval_s": interval_s, "stop": stop},
+            daemon=True,
+            name="fleet-controller",
+        )
+        t.start()
+        return t, stop
+
+
+def control_main(
+    cfg: ExperimentConfig, logger=None, workdir: str | None = None, ticks: int | None = None
+) -> int:
+    """``qdml-tpu control``: attach to the running serve endpoint and
+    supervise it until interrupted (or for ``--ticks=N`` polls)."""
+    sink = None if logger is None else logger.telemetry
+    poller = SocketPoller(cfg.serve.host, cfg.serve.port)
+    ctrl = FleetController(cfg, workdir, poller, sink=sink)
+    print(
+        json.dumps(
+            {
+                "control": f"{cfg.serve.host}:{cfg.serve.port}",
+                "workdir": workdir,
+                "dry_run": ctrl.dry_run,
+                "interval_s": cfg.control.interval_s,
+                "autoscale": ctrl.autoscaler is not None,
+                "drift_step_hint": ctrl.drift_step_hint,
+            }
+        ),
+        flush=True,
+    )
+    return ctrl.run(ticks=ticks)

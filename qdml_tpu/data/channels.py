@@ -71,7 +71,9 @@ SCENARIO_K_FACTOR = np.array([8.0, 2.0, 0.5], dtype=np.float32)  # LOS power boo
 SCENARIO_MOBILITY = np.array([0.0, 0.0, 0.0], dtype=np.float32)
 
 
-def family_table(n_scenarios: int) -> dict[str, np.ndarray]:
+def family_table(
+    n_scenarios: int, drift_step: int = 0, drift_scenario: int = -1
+) -> dict[str, np.ndarray]:
     """Per-scenario propagation parameters for an S-family grid — the
     on-device channel-family synthesizer's parameter bank (host numpy; the
     geometry is a jit-static argument, so these become trace-time constants
@@ -87,13 +89,27 @@ def family_table(n_scenarios: int) -> dict[str, np.ndarray]:
     host and every run. Prefix property: ``family_table(S)[k] ==
     family_table(S')[k]`` for every ``k < min(S, S')`` — growing the grid
     never re-parameterizes existing scenarios (pinned in tests/test_data.py).
+
+    Drift trajectory (``drift_step > 0``): a deterministic parameterized
+    perturbation of the table as a function of the drift step ``d`` — the
+    environment evolving under a model's feet (the fleet-control subsystem's
+    testable stand-in for a real scenario drifting, docs/CONTROL.md). Per
+    step, the affected row(s) stretch delay spread (+12%/step, same CP-style
+    cap as the tier ladder), bleed K-factor toward Rayleigh (/(1+0.25 d)),
+    widen the angular spread (+8%/step) and pick up mobility (+0.08 rad/step
+    Doppler phase spread). ``drift_scenario`` selects ONE drifting family
+    (-1 drifts them all). ``drift_step=0`` returns the frozen table with NO
+    float ops applied — bit-identical to the undrifted call, pinned in
+    tests/test_control.py.
     """
     if n_scenarios < 1:
         raise ValueError(f"n_scenarios must be >= 1, got {n_scenarios}")
+    if drift_step < 0:
+        raise ValueError(f"drift_step must be >= 0, got {drift_step}")
     idx = np.arange(n_scenarios)
     base = idx % 3
     tier = (idx // 3).astype(np.float32)
-    return {
+    table = {
         "n_paths": np.clip(
             SCENARIO_N_PATHS[base] + 2 * (idx // 3), 1, MAX_PATHS
         ).astype(np.int32),
@@ -116,6 +132,30 @@ def family_table(n_scenarios: int) -> dict[str, np.ndarray]:
             for b, t in zip(base, tier)
         ],
     }
+    if drift_step == 0:
+        # the frozen table, untouched: no float op may run here — this exact
+        # early return is what makes "drift 0 == the committed streams" a
+        # bitwise fact rather than a rounding accident
+        return table
+    d = np.float32(drift_step)
+    hit = np.ones(n_scenarios, bool) if drift_scenario < 0 else (idx == drift_scenario)
+    table["delay_spread"] = np.where(
+        hit, np.clip(table["delay_spread"] * (1.0 + 0.12 * d), 0.1, None),
+        table["delay_spread"],
+    ).astype(np.float32)
+    table["k_factor"] = np.where(
+        hit, table["k_factor"] / (1.0 + 0.25 * d), table["k_factor"]
+    ).astype(np.float32)
+    table["angle_spread"] = np.where(
+        hit, table["angle_spread"] * (1.0 + 0.08 * d), table["angle_spread"]
+    ).astype(np.float32)
+    table["mobility"] = np.where(
+        hit, table["mobility"] + 0.08 * d, table["mobility"]
+    ).astype(np.float32)
+    table["preset"] = [
+        p + (f"~d{drift_step}" if h else "") for p, h in zip(table["preset"], hit)
+    ]
+    return table
 # Per-user angular sector centres, in spatial-frequency units f = d/lambda*sin(theta).
 # Sector centres + 2-sigma truncated spreads stay strictly inside the sounded
 # beam span (max f = 4.2/64 + 2*1.6/64 = 7.4/64 < n_beam/64): the compressed
@@ -139,6 +179,15 @@ class ChannelGeometry:
     # this static field). 3 = the frozen reference presets; S > 3 appends
     # derived UMa/UMi/InH-style families without touching rows 0..2.
     n_scenarios: int = 3
+    # Channel-family drift trajectory (family_table's drift args): drift_step
+    # 0 (default) is the frozen table down to the bit; > 0 perturbs
+    # delay-spread / K-factor / angular-spread / mobility of drift_scenario
+    # (-1 = every family) as a deterministic function of the step — the
+    # fleet-control subsystem's injected-drift axis (docs/CONTROL.md). Static
+    # fields: a drifted geometry selects a different compiled program, never
+    # a runtime branch.
+    drift_step: int = 0
+    drift_scenario: int = -1
     # Full-pilot LS label noise scale: per-entry variance of the Hlabel/HLS
     # observation is ``label_noise_factor * 10**(-SNR/10)`` (unit channel-entry
     # power). 1.9 (= 10**0.28, i.e. a 2.8 dB pilot-overhead loss) calibrates
@@ -167,6 +216,13 @@ class ChannelGeometry:
             raise ValueError(
                 f"trig_impl must be 'direct' or 'split', got {self.trig_impl!r}"
             )
+        if self.drift_step < 0:
+            raise ValueError(f"drift_step must be >= 0, got {self.drift_step}")
+        if not (-1 <= self.drift_scenario < self.n_scenarios):
+            raise ValueError(
+                f"drift_scenario must be -1 (all) or a scenario id < "
+                f"{self.n_scenarios}, got {self.drift_scenario}"
+            )
 
     @classmethod
     def from_config(cls, cfg: DataConfig) -> "ChannelGeometry":
@@ -175,6 +231,8 @@ class ChannelGeometry:
             n_sub=cfg.n_sub,
             n_beam=cfg.n_beam,
             n_scenarios=cfg.n_scenarios,
+            drift_step=cfg.drift_step,
+            drift_scenario=cfg.drift_scenario,
             label_noise_factor=cfg.label_noise_factor,
             rng_impl=cfg.rng_impl,
             trig_impl=cfg.trig_impl,
@@ -275,7 +333,7 @@ def sample_channel(
     s = scenario.astype(jnp.int32)
     u = user.astype(jnp.int32)
 
-    fam = family_table(geom.n_scenarios)
+    fam = family_table(geom.n_scenarios, geom.drift_step, geom.drift_scenario)
     n_paths = jnp.asarray(fam["n_paths"])[s]
     spread = jnp.asarray(fam["angle_spread"])[s]
     dly = jnp.asarray(fam["delay_spread"])[s]

@@ -72,6 +72,29 @@ from qdml_tpu.train.hdce import HDCE
 from qdml_tpu.utils.compile_cache import compile_cache_stats, enable_compile_cache
 
 
+def _restore_family(workdir: str, prefix: str, tags: dict | None):
+    """One family's eval-only restore: the EXPLICIT tag when ``tags`` pins
+    one (must exist — a typo'd pin must fail loudly, not fall back to a
+    different checkpoint), else newest-tag discovery. Shared by engine
+    construction and the live hot-swap, so a deployer's tag semantics are
+    identical across restart and swap."""
+    from qdml_tpu.train.checkpoint import (
+        has_checkpoint,
+        restore_latest_params,
+        restore_params,
+    )
+
+    tag = (tags or {}).get(prefix)
+    if tag is None:
+        return restore_latest_params(workdir, prefix)
+    if not has_checkpoint(workdir, tag):
+        raise FileNotFoundError(
+            f"pinned tag {tag!r} does not exist under {workdir!r}"
+        )
+    vars_, meta = restore_params(workdir, tag)
+    return vars_, meta, tag
+
+
 class ServeEngine:
     """Checkpoint-restored HDCE pipeline behind per-bucket AOT executables."""
 
@@ -216,6 +239,7 @@ class ServeEngine:
         workdir: str,
         buckets: tuple[int, ...] | None = None,
         mesh: Any | None = None,
+        tags: dict | None = None,
     ) -> "ServeEngine":
         """Restore the newest trained HDCE + classifier from ``workdir``.
 
@@ -224,15 +248,17 @@ class ServeEngine:
         (best > last > resume); the quantum classifier is preferred when one
         was trained (its checkpoint meta reconciles the circuit config via
         ``reconcile_quantum_cfg``, exactly like the eval CLI), falling back to
-        the classical ``SCP128``.
+        the classical ``SCP128``. ``tags`` pins explicit per-family tags
+        exactly like :meth:`swap_from_workdir` — how a RESTARTED server comes
+        up on a continually fine-tuned ``hdce_last`` that a stale earlier
+        ``hdce_best`` would otherwise shadow (docs/CONTROL.md).
         """
         from qdml_tpu.train.checkpoint import (
             CheckpointNotFoundError,
             reconcile_quantum_cfg,
-            restore_latest_params,
         )
 
-        hdce_vars, _, _ = restore_latest_params(workdir, "hdce")
+        hdce_vars, _, _ = _restore_family(workdir, "hdce", tags)
         try:
             # one resolve-and-restore per family: a separate existence check
             # would scan the directory twice and race checkpoint promotion.
@@ -240,14 +266,14 @@ class ServeEngine:
             # classical classifier — a failed restore of an EXISTING qsc tag
             # (partial/corrupt checkpoint) propagates; silently downgrading a
             # quantum deployment to SCP128 would serve the wrong model.
-            clf_vars, clf_meta, _ = restore_latest_params(workdir, "qsc")
+            clf_vars, clf_meta, _ = _restore_family(workdir, "qsc", tags)
         except CheckpointNotFoundError:
             pass
         else:
             cfg = reconcile_quantum_cfg(cfg, clf_meta)
             return cls(cfg, hdce_vars, clf_vars, quantum=True, buckets=buckets, mesh=mesh)
         try:
-            clf_vars, _, _ = restore_latest_params(workdir, "sc")
+            clf_vars, _, _ = _restore_family(workdir, "sc", tags)
         except CheckpointNotFoundError:
             raise FileNotFoundError(
                 f"no scenario-classifier checkpoint (qsc/sc) under {workdir!r} "
@@ -331,24 +357,30 @@ class ServeEngine:
             sink.emit("counters", name="serve_swap", **rec)
         return rec
 
-    def swap_from_workdir(self, workdir: str) -> dict:
+    def swap_from_workdir(self, workdir: str, tags: dict | None = None) -> dict:
         """Re-resolve the newest checkpoints under ``workdir`` (best > last >
         resume, per family) and hot-swap to them — the ``{"op": "swap"}``
         serve verb's engine half. A training run that just promoted a new
-        ``*_best`` is deployed without restarting the server."""
-        from qdml_tpu.train.checkpoint import (
-            reconcile_quantum_cfg,
-            restore_latest_params,
-        )
+        ``*_best`` is deployed without restarting the server.
+
+        ``tags`` pins an EXPLICIT checkpoint tag per family prefix (e.g.
+        ``{"hdce": "hdce_last"}``; families not named keep the newest-tag
+        resolution). The deployer (control/deploy.py) always passes the tag
+        it just promoted: ``latest_tag``'s best > last preference is right
+        for "deploy the newest training run", but after continual fine-tuning
+        — which writes ``hdce_last`` — a STALE earlier ``hdce_best`` from the
+        original training run would shadow the freshly promoted checkpoint
+        and silently re-deploy yesterday's params."""
+        from qdml_tpu.train.checkpoint import reconcile_quantum_cfg
 
         # the gate spans resolve+restore+flip: restoring OUTSIDE it would let
         # two concurrent swap verbs resolve different tags (slow orbax IO)
         # and flip in reverse completion order — the stale checkpoint would
         # pass swap_params' shape validation and end up live
         with self._swap_gate:
-            hdce_vars, _, hdce_tag = restore_latest_params(workdir, "hdce")
+            hdce_vars, _, hdce_tag = _restore_family(workdir, "hdce", tags)
             clf_prefix = "qsc" if self.quantum else "sc"
-            clf_vars, clf_meta, clf_tag = restore_latest_params(workdir, clf_prefix)
+            clf_vars, clf_meta, clf_tag = _restore_family(workdir, clf_prefix, tags)
             if self.quantum:
                 # from_workdir RECONCILES the circuit config from checkpoint
                 # meta; a live engine cannot (the model is baked into every
@@ -373,14 +405,19 @@ class ServeEngine:
 
     def _forward(
         self, hdce_vars: dict, clf_vars: dict, x: jnp.ndarray
-    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Fused classify -> all-trunks -> top-1 route. ``x``: (B, n_sub,
-        n_beam, 2) f32 -> ``(h (B, 2*h_dim), pred (B,))``."""
+        n_beam, 2) f32 -> ``(h (B, 2*h_dim), pred (B,), conf (B,))``.
+        ``conf`` is the routed class's probability (``exp(max log-prob)``) —
+        the per-request classifier-confidence stat ServeMetrics histograms
+        and the drift detectors consume (docs/CONTROL.md); it rides the
+        existing result fetch, no extra dispatch."""
         logp = self.clf.apply(clf_vars, x, train=False)
         pred = jnp.argmax(logp, -1)
+        conf = jnp.exp(jnp.max(logp, -1))
         xs = jnp.broadcast_to(x[None], (self.cfg.data.n_scenarios,) + x.shape)
         est_all = self.hdce.apply(hdce_vars, xs, train=False)  # (S, B, D)
-        return select_expert(est_all, pred), pred
+        return select_expert(est_all, pred), pred, conf
 
     def _apply_trunks(self, hdce_vars: dict, xs: jnp.ndarray) -> jnp.ndarray:
         """Stacked trunks+head on per-scenario inputs ``(S, B', ...) ->
@@ -399,16 +436,17 @@ class ServeEngine:
 
     def _forward_sparse(
         self, hdce_vars: dict, clf_vars: dict, x: jnp.ndarray, n_valid: jnp.ndarray
-    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Capacity-bucketed twin of :meth:`_forward`: classify -> pack rows
         into per-expert buckets -> run ONLY the chosen trunk per bucket ->
         unsort (``routing.sparse_dispatch``). ``n_valid`` masks the zero-pad
         tail out of bucket capacity (padding must not inflate overflow).
-        Returns ``(h, pred, overflow)`` — overflow rows were served by the
-        dense fallback inside the same program, never dropped."""
+        Returns ``(h, pred, conf, overflow)`` — overflow rows were served by
+        the dense fallback inside the same program, never dropped."""
         s = self.cfg.data.n_scenarios
         logp = self.clf.apply(clf_vars, x, train=False)
         pred = jnp.argmax(logp, -1)
+        conf = jnp.exp(jnp.max(logp, -1))
         valid = jnp.arange(x.shape[0]) < n_valid
 
         def dense_fb(xb, predb):
@@ -424,7 +462,7 @@ class ServeEngine:
             self.cfg.serve.capacity_factor,
             valid=valid,
         )
-        return h, pred, overflow
+        return h, pred, conf, overflow
 
     def _bucket_dispatch(self, b: int) -> str:
         """Resolve bucket ``b``'s routing dispatch at warmup time: a forced
@@ -447,14 +485,23 @@ class ServeEngine:
         self.dispatch_race[str(b)] = entry
         return entry.get("best_infer") or "dense"
 
-    def offline_forward(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    def offline_forward(
+        self, x: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """The parity reference: the same fused forward jitted at the natural
         (unpadded, unbucketed) batch shape — numerically the offline eval
-        path. Loadgen/tests call this BEFORE :meth:`warmup` so its compile
-        never pollutes the request-path compile gate."""
+        path. Returns ``(h, pred, conf)``. Loadgen/tests call this BEFORE
+        :meth:`warmup` so its compile never pollutes the request-path compile
+        gate; the canary gate (control/deploy.py) calls it on throwaway
+        candidate engines — control-plane compiles, never serving-window
+        ones."""
         hdce_live, clf_live = self.live_vars()
-        h, pred = jax.jit(self._forward)(hdce_live, clf_live, jnp.asarray(x))
-        return np.asarray(jax.device_get(h)), np.asarray(jax.device_get(pred))
+        h, pred, conf = jax.jit(self._forward)(hdce_live, clf_live, jnp.asarray(x))
+        return (
+            np.asarray(jax.device_get(h)),
+            np.asarray(jax.device_get(pred)),
+            np.asarray(jax.device_get(conf)),
+        )
 
     # -- warmup -------------------------------------------------------------
 
@@ -611,10 +658,14 @@ class ServeEngine:
 
     # -- request path -------------------------------------------------------
 
-    def infer(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray, int]:
+    def infer(
+        self, x: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
         """Serve one coalesced batch: pad to its bucket, run the pre-compiled
         executable, slice back. ``x``: (n, n_sub, n_beam, 2). Returns
-        ``(h (n, 2*h_dim), pred (n,), bucket)``.
+        ``(h (n, 2*h_dim), pred (n,), conf (n,), bucket)`` — ``conf`` is the
+        routed class's probability, the per-request confidence stat the
+        serve metrics histogram and the drift detectors consume.
 
         Oversized batches (n > largest bucket — only reachable by direct
         callers; the micro-batcher caps at ``max_batch``) fall back to
@@ -627,12 +678,18 @@ class ServeEngine:
             raise ValueError("empty batch")
         largest = self.buckets[-1]
         if n > largest:
-            hs, preds = [], []
+            hs, preds, confs = [], [], []
             for lo in range(0, n, largest):
-                h, p, _ = self.infer(x[lo : lo + largest])
+                h, p, c, _ = self.infer(x[lo : lo + largest])
                 hs.append(h)
                 preds.append(p)
-            return np.concatenate(hs), np.concatenate(preds), largest
+                confs.append(c)
+            return (
+                np.concatenate(hs),
+                np.concatenate(preds),
+                np.concatenate(confs),
+                largest,
+            )
         b = pick_bucket(n, self.buckets)
         xp = np.zeros((b, *x.shape[1:]), np.float32)
         xp[:n] = x
@@ -664,9 +721,9 @@ class ServeEngine:
         else:
             res = out
         if mode == "sparse":
-            h, pred, overflow = res
+            h, pred, conf, overflow = res
         else:
-            h, pred = res
+            h, pred, conf = res
         if overflow is not None:
             # overflow rides the same result fetch cadence (a 4-byte scalar
             # next to the reply arrays) — the capacity-factor health signal
@@ -678,5 +735,6 @@ class ServeEngine:
         return (
             np.asarray(jax.device_get(h))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
             np.asarray(jax.device_get(pred))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
+            np.asarray(jax.device_get(conf))[:n],  # lint: disable=host-sync-hot-path(per-request confidence fetched with the reply it annotates — same dispatch, no extra stall)
             b,
         )

@@ -46,6 +46,7 @@ from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.data.channels import ChannelGeometry
 from qdml_tpu.data.datasets import make_network_batch
 from qdml_tpu.serve.engine import ServeEngine
+from qdml_tpu.serve.metrics import ServeMetrics
 from qdml_tpu.serve.server import ReplicaPool
 from qdml_tpu.serve.types import Prediction
 from qdml_tpu.telemetry import span
@@ -125,25 +126,110 @@ def arrival_times(
     return out
 
 
-def make_request_samples(cfg: ExperimentConfig, n: int) -> dict[str, np.ndarray]:
+def make_request_samples(
+    cfg: ExperimentConfig,
+    n: int,
+    drift_at: int | None = None,
+    drift_step: int = 0,
+    drift_scenario: int = 0,
+) -> dict[str, np.ndarray]:
     """``n`` fresh request samples past the training range (the eval sweep's
     offset convention, Test.py:127) round-robined over the scenario/user grid;
     returns host arrays: ``x`` (pilot images), ``h_perf`` (ground truth),
-    ``indicator`` (true scenario)."""
+    ``indicator`` (true scenario).
+
+    Drift injection (``drift_at``/``serve.drift_step``, docs/CONTROL.md):
+    requests from index ``drift_at`` onward come from the DRIFTED channel
+    family table (``family_table`` at ``drift_step``, ``drift_scenario``
+    perturbed) with the offered scenario mix shifted toward the drifting
+    family (every other post-drift request is drawn from it) — the traffic
+    the fleet controller's detectors must notice mid-run. ``drift_at=0``
+    makes the whole stream drifted; ``None`` (or ``drift_step=0``) is the
+    stationary PR-2 stream, bit-identical to before the knob existed."""
     geom = ChannelGeometry.from_config(cfg.data)
     i = jnp.arange(n)
     scen = i % cfg.data.n_scenarios
     user = (i // cfg.data.n_scenarios) % cfg.data.n_users
     start = cfg.data.data_len * 3
-    batch = make_network_batch(
-        jnp.uint32(cfg.data.seed), scen, user, start + i,
-        jnp.float32(cfg.data.snr_db), geom,
+
+    def _gen(geom_, scen_, user_, idx_):
+        batch = make_network_batch(
+            jnp.uint32(cfg.data.seed), scen_, user_, idx_,
+            jnp.float32(cfg.data.snr_db), geom_,
+        )
+        return (
+            np.asarray(batch["yp_img"], np.float32),
+            np.asarray(batch["h_perf"], np.float32),
+            np.asarray(batch["indicator"]),
+        )
+
+    if drift_at is None or drift_step <= 0 or drift_at >= n:
+        x, h_perf, ind = _gen(geom, scen, user, start + i)
+        return {"x": x, "h_perf": h_perf, "indicator": ind}
+    if not (0 <= drift_scenario < cfg.data.n_scenarios):
+        raise ValueError(
+            f"drift_scenario must be a scenario id < {cfg.data.n_scenarios}, "
+            f"got {drift_scenario}"
+        )
+    import dataclasses
+
+    k = max(0, int(drift_at))
+    geom_d = dataclasses.replace(
+        geom, drift_step=int(drift_step), drift_scenario=int(drift_scenario)
     )
-    return {
-        "x": np.asarray(batch["yp_img"], np.float32),
-        "h_perf": np.asarray(batch["h_perf"], np.float32),
-        "indicator": np.asarray(batch["indicator"]),
+    # post-drift mix: every other request from the drifting family, the rest
+    # keep the round-robin — the scenario-mix shift rides along with the
+    # channel-statistics drift
+    j = i[k:]
+    scen_d = jnp.where((j - k) % 2 == 0, drift_scenario, scen[k:])
+    parts = [_gen(geom, scen[:k], user[:k], start + i[:k])] if k else []
+    parts.append(_gen(geom_d, scen_d, user[k:], start + j))
+    x, h_perf, ind = (np.concatenate(cols) for cols in zip(*parts))
+    return {"x": x, "h_perf": h_perf, "indicator": ind}
+
+
+def _window_stats(
+    ids: list[int],
+    done: dict,
+    offline_h: np.ndarray,
+    offline_pred: np.ndarray,
+    h_perf: np.ndarray,
+    indicator: np.ndarray,
+    drift_scenario: int | None = None,
+) -> dict | None:
+    """Parity/NMSE/confidence stats over one id window of completed results —
+    the per-phase view the drift story needs (pre- vs post-drift vs
+    recovered), same math as the run-level figures."""
+    ids = [i for i in ids if i in done]
+    if not ids:
+        return None
+    served_h = np.stack([done[i].h for i in ids])
+    off_h, off_p = offline_h[ids], offline_pred[ids]
+    pow_ = float(np.sum(h_perf[ids] ** 2))
+    confs = [done[i].confidence for i in ids if done[i].confidence is not None]
+    out = {
+        "n": len(ids),
+        "parity_max_abs_err": float(np.max(np.abs(served_h - off_h))),
+        "pred_agreement": float(
+            np.mean([done[i].scenario == int(off_p[k]) for k, i in enumerate(ids)])
+        ),
+        "nmse_db_served": nmse_db(
+            float(np.sum((served_h - h_perf[ids]) ** 2)) / pow_
+        ),
+        "nmse_db_offline": nmse_db(float(np.sum((off_h - h_perf[ids]) ** 2)) / pow_),
+        "conf_mean": round(float(np.mean(confs)), 4) if confs else None,
     }
+    if drift_scenario is not None:
+        # the drifting family's own served NMSE (rows by TRUE scenario): the
+        # number the fine-tune must move and the canary must not regress
+        rows = [k for k, i in enumerate(ids) if int(indicator[i]) == drift_scenario]
+        if rows:
+            pw = float(np.sum(h_perf[np.asarray(ids)[rows]] ** 2))
+            out["nmse_db_drift_scenario"] = nmse_db(
+                float(np.sum((served_h[rows] - h_perf[np.asarray(ids)[rows]]) ** 2))
+                / pw
+            )
+    return out
 
 
 def run_loadgen(
@@ -156,6 +242,8 @@ def run_loadgen(
     logger=None,
     process: str | None = None,
     replicas: int | None = None,
+    pool: ReplicaPool | None = None,
+    drift_at: int | None = None,
 ) -> dict:
     """Drive a warmed (or about-to-be-warmed) engine with open-loop traffic.
 
@@ -166,7 +254,21 @@ def run_loadgen(
     :class:`~qdml_tpu.serve.server.ReplicaPool` (default
     ``cfg.serve.replicas``) — every replica shares the one warmup and one
     batcher feed, and the summary merges every replica's metrics exactly.
-    """
+
+    ``drift_at`` injects mid-run channel-family drift from the traffic side
+    (``serve.drift_step``/``serve.drift_scenario`` shape it; docs/CONTROL.md):
+    requests from that index onward come from the drifted family table with
+    the scenario mix shifted toward the drifting family, and the summary
+    grows a ``windows`` block (pre/post-drift parity, NMSE and confidence)
+    plus a ``drift`` fact block.
+
+    ``pool`` attaches to an EXISTING (started) replica pool instead of
+    creating one — the fleet-controller harness, where the controller is
+    polling the same pool's live metrics while traffic runs. In that mode
+    the engine is already warm, so the per-request summary stats are rebuilt
+    from this run's results alone (the pool's own collectors span its whole
+    lifetime), the compile gate is the counter delta across the traffic
+    window only, and the caller keeps ownership of the pool (no stop)."""
     process = process or cfg.serve.arrival
     if process not in ARRIVAL_PROCESSES:
         # fail on the config typo BEFORE the restore/parity-compile/warmup
@@ -174,18 +276,39 @@ def run_loadgen(
         raise ValueError(
             f"unknown arrival process {process!r} (have {ARRIVAL_PROCESSES})"
         )
-    samples = make_request_samples(cfg, n)
+    drift_step = int(cfg.serve.drift_step)
+    drift_scen = int(cfg.serve.drift_scenario)
+    drifting = drift_at is not None and drift_step > 0
+    samples = make_request_samples(
+        cfg, n,
+        drift_at=drift_at if drifting else None,
+        drift_step=drift_step, drift_scenario=drift_scen,
+    )
     x, h_perf = samples["x"], samples["h_perf"]
 
+    from qdml_tpu.utils.compile_cache import compile_cache_stats
+
+    external_pool = pool is not None
     with span("loadgen_offline_reference", n=n):
-        offline_h, offline_pred = engine.offline_forward(x)
-    with span("serve_warmup", buckets=list(engine.buckets)):
-        warm = engine.warmup()
+        offline_h, offline_pred, _offline_conf = engine.offline_forward(x)
+    if external_pool:
+        if not engine._compiled:
+            raise ValueError("run_loadgen(pool=...) requires a started (warmed) pool")
+        warm = None
+        # the offline-reference compile above happened AFTER this engine's
+        # warmup, so the engine-level since-warmup delta can no longer prove
+        # anything: gate the TRAFFIC WINDOW instead (snapshot here, diff
+        # after the drain)
+        cache_before = compile_cache_stats()
+    else:
+        with span("serve_warmup", buckets=list(engine.buckets)):
+            warm = engine.warmup()
 
     sink = None if logger is None else logger.telemetry
-    pool = ReplicaPool(
-        engine, replicas=replicas, sink=sink, log_requests=n <= 2048
-    ).start()
+    if not external_pool:
+        pool = ReplicaPool(
+            engine, replicas=replicas, sink=sink, log_requests=n <= 2048
+        ).start()
     rng = np.random.default_rng(seed)
     arrivals = arrival_times(
         n, rate, rng, process=process, burstiness=cfg.serve.burstiness
@@ -204,8 +327,14 @@ def run_loadgen(
         # would look like a slow generator and mask its own overload
         offered_elapsed = time.perf_counter() - t0
         results = [f.result(timeout=60.0) for f in futures]
-    pool.stop()
-    cache_after = engine.request_path_compiles()
+    if external_pool:
+        cache_after = {
+            k: max(0, v - cache_before.get(k, 0))
+            for k, v in compile_cache_stats().items()
+        }
+    else:
+        pool.stop()
+        cache_after = engine.request_path_compiles()
     # End-of-run poll of the live `{"op": "metrics"}` view, folded SLIM: the
     # summary below is already built from the same (merged) collectors, so
     # only the fields the verb adds ride along — replica/queue/bucket state
@@ -238,10 +367,24 @@ def run_loadgen(
 
     import jax
 
-    # aggregate across every replica's every worker (== the single loop's
-    # metrics when replicas=workers=1); any one collector alone would
-    # undercount the pool
-    metrics_all = pool.merged_metrics(sink=sink)
+    if external_pool:
+        # this RUN's window only: the pool's collectors span its whole
+        # lifetime (other runs, controller probes), so replay the results
+        # into a fresh collector — latency/SLO/scenario stats exact, batch
+        # fill/queue depth unknowable here and reported null
+        metrics_all = ServeMetrics(sink=sink, log_requests=False)
+        metrics_all._t0 = t0
+        for r in results:
+            if isinstance(r, Prediction):
+                metrics_all.observe_prediction(r)
+            else:
+                metrics_all.observe_shed(r, had_deadline=deadline_ms is not None)
+        metrics_all.completed = len(done)
+    else:
+        # aggregate across every replica's every worker (== the single loop's
+        # metrics when replicas=workers=1); any one collector alone would
+        # undercount the pool
+        metrics_all = pool.merged_metrics(sink=sink)
     summary = metrics_all.summary(
         compile_cache=cache_after,
         # labels the record for report's platform-mismatch disarm: a CPU
@@ -273,6 +416,38 @@ def run_loadgen(
         warmup=warm,
         server_metrics=live_slim,
     )
+    if drifting:
+        summary["drift"] = {
+            "at": int(drift_at),
+            "step": drift_step,
+            "scenario": drift_scen,
+        }
+        # chunked sub-windows ride along so a controller harness can replay
+        # the run as a SEQUENCE of windowed measurements (the nmse_parity
+        # drift detector consumes windows, not one aggregate)
+        chunk = max(24, n // 12)
+        chunks = []
+        for lo in range(0, n, chunk):
+            st = _window_stats(
+                list(range(lo, min(lo + chunk, n))), done, offline_h,
+                offline_pred, h_perf, samples["indicator"],
+                drift_scenario=drift_scen,
+            )
+            if st is not None:
+                st["start"] = lo
+                st["pre_drift"] = lo + chunk <= int(drift_at)
+                chunks.append(st)
+        summary["windows"] = {
+            "pre_drift": _window_stats(
+                list(range(int(drift_at))), done, offline_h, offline_pred,
+                h_perf, samples["indicator"], drift_scenario=drift_scen,
+            ),
+            "post_drift": _window_stats(
+                list(range(int(drift_at), n)), done, offline_h, offline_pred,
+                h_perf, samples["indicator"], drift_scenario=drift_scen,
+            ),
+            "chunks": chunks,
+        }
     if summary.get("rps") is not None and pool.n_replicas:
         summary["rps_per_replica"] = round(summary["rps"] / pool.n_replicas, 2)
     metrics_all.flush(

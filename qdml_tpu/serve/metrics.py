@@ -34,6 +34,16 @@ class ServeMetrics:
         self.latency = Histogram()       # per-request enqueue -> result
         self.batch_fill = Histogram()    # n / bucket per served batch (0..1)
         self.queue_depth = Histogram()   # depth at dequeue (stored as "seconds")
+        # classifier-confidence histogram (routed-class probability per
+        # prediction; raw samples, so Histogram.merge aggregates exactly) +
+        # per-scenario prediction counts and confidence SUMS. The sums exist
+        # so a poller can window the stream by differencing two snapshots
+        # (mean-of-window = d(sum)/d(n)) — a cumulative histogram cannot be
+        # differenced, and the drift detectors (docs/CONTROL.md) live on
+        # windowed per-scenario means.
+        self.confidence = Histogram()
+        self.scenario_counts: dict[str, int] = {}
+        self.scenario_conf_sum: dict[str, float] = {}
         self.batches = 0
         self.completed = 0
         self.shed: dict[str, int] = {}
@@ -67,10 +77,7 @@ class ServeMetrics:
                 queue_depth=depth,
             )
         for p in preds:
-            self.latency.add(p.latency_s)
-            if p.deadline_met is not None:
-                self.slo_total += 1
-                self.slo_met += int(p.deadline_met)
+            self.observe_prediction(p)
             if active and self.log_requests:
                 target.emit(
                     "span",
@@ -81,6 +88,22 @@ class ServeMetrics:
                     rid=p.rid,
                     bucket=bucket,
                 )
+
+    def observe_prediction(self, p: Prediction) -> None:
+        """Per-request accounting shared by :meth:`observe_batch` and the
+        windowed loadgen summaries (which replay results into a fresh
+        collector): latency, SLO, per-scenario counts and confidence."""
+        self.latency.add(p.latency_s)
+        if p.deadline_met is not None:
+            self.slo_total += 1
+            self.slo_met += int(p.deadline_met)
+        key = str(p.scenario)
+        self.scenario_counts[key] = self.scenario_counts.get(key, 0) + 1
+        if p.confidence is not None:
+            self.confidence.add(float(p.confidence))
+            self.scenario_conf_sum[key] = self.scenario_conf_sum.get(key, 0.0) + float(
+                p.confidence
+            )
 
     def observe_shed(self, o: Overloaded, had_deadline: bool = False) -> None:
         self.shed[o.reason] = self.shed.get(o.reason, 0) + 1
@@ -97,10 +120,15 @@ class ServeMetrics:
         self.latency.merge(other.latency)
         self.batch_fill.merge(other.batch_fill)
         self.queue_depth.merge(other.queue_depth)
+        self.confidence.merge(other.confidence)
         self.batches += other.batches
         self.completed += other.completed
         for k, v in other.shed.items():
             self.shed[k] = self.shed.get(k, 0) + v
+        for k, v in other.scenario_counts.items():
+            self.scenario_counts[k] = self.scenario_counts.get(k, 0) + v
+        for k, v in other.scenario_conf_sum.items():
+            self.scenario_conf_sum[k] = self.scenario_conf_sum.get(k, 0.0) + v
         self.slo_total += other.slo_total
         self.slo_met += other.slo_met
         self._t0 = min(self._t0, other._t0)
@@ -118,6 +146,24 @@ class ServeMetrics:
             "met": self.slo_met,
             "attainment": round(self.slo_met / self.slo_total, 4),
         }
+
+    def per_scenario(self) -> dict | None:
+        """Per predicted-scenario counts + confidence stats, or ``None``
+        before any prediction. ``conf_sum`` is deliberately raw (not just the
+        mean): two snapshots of a live server difference to an exact window
+        mean, which is what the drift detectors consume."""
+        if not self.scenario_counts:
+            return None
+        out: dict = {}
+        for k in sorted(self.scenario_counts, key=int):
+            n = self.scenario_counts[k]
+            rec: dict = {"n": n}
+            if k in self.scenario_conf_sum and n:
+                cs = self.scenario_conf_sum[k]
+                rec["conf_sum"] = round(cs, 4)
+                rec["conf_mean"] = round(cs / n, 4)
+            out[k] = rec
+        return out
 
     def _scaled(self, hist: Histogram) -> dict | None:
         """Histogram.summary() without the ms scaling (fill/depth are not
@@ -148,6 +194,8 @@ class ServeMetrics:
                 completed=self.completed,
                 shed=dict(self.shed),
                 slo=self.slo(),
+                confidence=self._scaled(self.confidence),
+                per_scenario=self.per_scenario(),
                 compile_cache=compile_cache,
                 **tags,
             )
@@ -175,6 +223,11 @@ class ServeMetrics:
             "latency_ms": self.latency.summary(),
             "batch_fill": self._scaled(self.batch_fill),
             "queue_depth": self._scaled(self.queue_depth),
+            # classifier-confidence histogram + per-scenario counts/means:
+            # the drift detectors' raw input, independently useful fleet
+            # observability (docs/CONTROL.md)
+            "confidence": self._scaled(self.confidence),
+            "per_scenario": self.per_scenario(),
             "compile_cache_after_warmup": compile_cache,
             **extra,
         }

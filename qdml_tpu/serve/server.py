@@ -238,6 +238,7 @@ class ServeLoop:
             queue_depth_now=self.batcher.depth,
             buckets=list(self.engine.buckets),
             swap_epoch=self.engine.swap_epoch,
+            dispatch=self.engine.dispatch_summary(),
         )
 
     def _serve_one(self, metrics: ServeMetrics | None = None) -> bool:
@@ -260,7 +261,7 @@ class ServeLoop:
             # stack INSIDE the guard: a shape-mismatched request failing the
             # stack must strand nobody, exactly like an engine failure
             x = np.stack([r.x for r in batch])
-            h, pred, bucket = self.engine.infer(x)
+            h, pred, conf, bucket = self.engine.infer(x)
         except BaseException as e:
             # a dying batch must not strand its clients: forward the failure
             # into every future, then let the loop's finally drain the rest
@@ -280,6 +281,7 @@ class ServeLoop:
                 bucket=bucket,
                 batch_n=len(batch),
                 deadline_met=None if r.deadline is None else now <= r.deadline,
+                confidence=float(conf[i]),
             )
             preds.append(p)
         # metrics before resolution: a client awaiting the future must be able
@@ -332,6 +334,17 @@ class ReplicaPool:
     last-worker-out draining are pool-wide facts. A checkpoint hot-swap on
     the shared engine (``engine.swap_params``) lands on every replica at
     once — each batch reads the live param tuple at dequeue.
+
+    The pool is ELASTIC: :meth:`add_replica` / :meth:`remove_replica` /
+    :meth:`scale_to` resize it under live traffic (the autoscaler's levers,
+    docs/CONTROL.md). Removal is drain-safe by construction: the departing
+    replica's workers deregister from the SHARED coordinator, and because
+    live peers remain, the last-worker-out drain never fires — the shared
+    queue keeps being pumped by the survivors and no submitted future is
+    ever shed by a scale-down. Replica 0 is the permanent submit front and
+    is never removed. Removed replicas land in a retired list so their
+    histograms stay in :meth:`merged_metrics` (a scale-down must not vanish
+    the requests it already served).
     """
 
     def __init__(
@@ -345,7 +358,7 @@ class ReplicaPool:
     ):
         serve_cfg = engine.cfg.serve
         self.engine = engine
-        self.n_replicas = max(
+        n_replicas = max(
             1, int(replicas if replicas is not None else serve_cfg.replicas)
         )
         self.batcher = batcher or MicroBatcher(
@@ -354,21 +367,46 @@ class ReplicaPool:
             max_queue=serve_cfg.max_queue,
         )
         self._exit = ExitCoordinator()
-        self.replicas = [
-            ServeLoop(
-                engine,
-                batcher=self.batcher,
-                metrics=ServeMetrics(sink=sink, log_requests=log_requests),
-                workers=workers,
-                exit_coord=self._exit,
-                name=f"serve-replica-{i}",
-            )
-            for i in range(self.n_replicas)
+        self._sink = sink
+        self._log_requests = log_requests
+        self._workers_per = workers
+        self._pool_lock = threading.Lock()
+        self._started = False
+        self._next_id = n_replicas
+        self._replicas = [
+            self._make_replica(i) for i in range(n_replicas)
         ]
+        # the permanent submit front: replica 0 validates/enqueues into the
+        # shared feed without taking the pool lock per request (it is created
+        # here and never removed, so the hot path needs no synchronization)
+        self._front = self._replicas[0]
+        self._retired: list[ServeLoop] = []
+
+    def _make_replica(self, i: int) -> ServeLoop:
+        return ServeLoop(
+            self.engine,
+            batcher=self.batcher,
+            metrics=ServeMetrics(sink=self._sink, log_requests=self._log_requests),
+            workers=self._workers_per,
+            exit_coord=self._exit,
+            name=f"serve-replica-{i}",
+        )
+
+    @property
+    def replicas(self) -> list[ServeLoop]:
+        """Snapshot of the live replica list (copy — the pool can be resized
+        by the autoscaler thread while a caller iterates)."""
+        with self._pool_lock:
+            return list(self._replicas)
+
+    @property
+    def n_replicas(self) -> int:
+        with self._pool_lock:
+            return len(self._replicas)
 
     @property
     def workers(self) -> int:
-        """Total worker threads across the pool."""
+        """Total worker threads across the live pool."""
         return sum(r.workers for r in self.replicas)
 
     def start(self) -> "ReplicaPool":
@@ -376,6 +414,7 @@ class ReplicaPool:
             self.engine.warmup()  # ONE warmup, shared by every replica
         for r in self.replicas:
             r.start()
+        self._started = True
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -383,8 +422,54 @@ class ReplicaPool:
             while self.batcher.depth > 0 and self._exit.live() > 0:
                 self.batcher.wake.set()
                 time.sleep(0.001)
+        self._started = False
         for r in self.replicas:
             r.stop(drain=False)
+
+    # -- elastic scaling (the autoscaler's levers) --------------------------
+
+    def add_replica(self) -> ServeLoop:
+        """Grow the pool by one replica under live traffic: the new loop
+        shares the batcher, engine (already-warmed executables — zero new
+        compiles) and exit coordinator, and starts serving the shared queue
+        immediately."""
+        with self._pool_lock:
+            loop = self._make_replica(self._next_id)
+            self._next_id += 1
+            self._replicas.append(loop)
+            started = self._started
+        if started:
+            loop.start()
+        return loop
+
+    def remove_replica(self) -> ServeLoop | None:
+        """Shrink the pool by one replica (never below one; replica 0, the
+        submit front, is never the victim). Drain-safe: ``stop(drain=False)``
+        only stops THIS replica's workers — they deregister from the shared
+        :class:`ExitCoordinator`, and because peers remain live the
+        last-worker-out drain cannot fire, so every queued future is drained
+        by the survivors (pinned in tests/test_control.py). Returns the
+        removed loop (its metrics are retained in :meth:`merged_metrics`),
+        or ``None`` when the pool is already at one replica."""
+        with self._pool_lock:
+            if len(self._replicas) <= 1:
+                return None
+            loop = self._replicas.pop()
+            self._retired.append(loop)
+        loop.stop(drain=False)
+        return loop
+
+    def scale_to(self, n: int) -> dict:
+        """Resize to ``n`` replicas (clamped to >= 1); returns the action
+        record the ``{"op": "scale"}`` verb replies with."""
+        n = max(1, int(n))
+        before = self.n_replicas
+        while self.n_replicas < n:
+            self.add_replica()
+        while self.n_replicas > n:
+            if self.remove_replica() is None:
+                break
+        return {"replicas_before": before, "replicas": self.n_replicas}
 
     def submit(
         self,
@@ -395,13 +480,16 @@ class ReplicaPool:
         """Validated enqueue into the SHARED feed (replica 0 fronts it; the
         liveness check is pool-wide through the coordinator, so work is
         accepted as long as ANY replica can serve it)."""
-        return self.replicas[0].submit(x, rid=rid, deadline_ms=deadline_ms)
+        return self._front.submit(x, rid=rid, deadline_ms=deadline_ms)
 
     def merged_metrics(self, sink=None) -> ServeMetrics:
         """Every replica's every worker folded into one collector — exact
-        quantiles across the whole pool (``Histogram.merge``)."""
+        quantiles across the whole pool (``Histogram.merge``), retired
+        (scaled-down) replicas included: the requests they served happened."""
         agg = ServeMetrics(sink=sink, log_requests=False)
-        for r in self.replicas:
+        with self._pool_lock:
+            loops = list(self._replicas) + list(self._retired)
+        for r in loops:
             for m in r._worker_metrics:
                 agg.merge(m)
         return agg
@@ -409,19 +497,22 @@ class ReplicaPool:
     def live_metrics(self) -> dict:
         """Pool-wide ``{"op": "metrics"}`` payload: the merged counters plus
         replica topology and per-replica completion split (the fleet-balance
-        view), the shared queue depth, and the swap epoch."""
+        view), the shared queue depth, the swap epoch and the routing
+        dispatch block — everything the fleet controller's poll consumes."""
+        replicas = self.replicas
         return self.merged_metrics().snapshot(
             compile_cache=self.engine.request_path_compiles(),
             workers=self.workers,
-            replicas=self.n_replicas,
+            replicas=len(replicas),
             # plain counter sums — a per-replica merged_metrics() here would
             # copy every raw histogram sample once per replica per poll
             replica_completed=[
-                sum(m.completed for m in r._worker_metrics) for r in self.replicas
+                sum(m.completed for m in r._worker_metrics) for r in replicas
             ],
             queue_depth_now=self.batcher.depth,
             buckets=list(self.engine.buckets),
             swap_epoch=self.engine.swap_epoch,
+            dispatch=self.engine.dispatch_summary(),
         )
 
 
@@ -443,7 +534,7 @@ def _encode(res) -> dict:
     return {"id": res.rid, "ok": False, "reason": res.reason}
 
 
-async def _handle(reader, writer, loop_, swap_fn: Callable[[], dict] | None) -> None:
+async def _handle(reader, writer, loop_, swap_fn: "Callable[..., dict] | None") -> None:
     while True:
         line = await reader.readline()
         if not line:
@@ -469,16 +560,30 @@ async def _handle(reader, writer, loop_, swap_fn: Callable[[], dict] | None) -> 
             continue
         if isinstance(msg, dict) and msg.get("op") == "swap":
             # zero-downtime deploy verb: re-restore the newest checkpoints
-            # and hot-swap them under live traffic (engine.swap_params —
-            # zero recompiles, in-flight batches keep the old params). Off
-            # the event loop: the orbax restore + device_put is host work
-            # that must not stall connected clients' reply paths.
+            # (or the EXPLICIT per-family "tags" the client pins — the
+            # deployer's path, so a stale *_best can never shadow a freshly
+            # fine-tuned *_last) and hot-swap them under live traffic
+            # (engine.swap_params — zero recompiles, in-flight batches keep
+            # the old params). Off the event loop: the orbax restore +
+            # device_put is host work that must not stall connected clients'
+            # reply paths.
             if swap_fn is None:
                 reply = {"id": msg.get("id"), "ok": False,
                          "reason": "swap_unavailable: server has no checkpoint workdir"}
             else:
                 try:
-                    rec = await asyncio.get_running_loop().run_in_executor(None, swap_fn)
+                    tags = msg.get("tags")
+                    if tags is not None and not (
+                        isinstance(tags, dict)
+                        and all(
+                            isinstance(k, str) and isinstance(v, str)
+                            for k, v in tags.items()
+                        )
+                    ):
+                        raise ValueError(f"swap tags must be a str->str map, got {tags!r}")
+                    rec = await asyncio.get_running_loop().run_in_executor(
+                        None, swap_fn, tags
+                    )
                     reply = {"id": msg.get("id"), "ok": True, "swap": rec}
                 except (FileNotFoundError, ValueError, RuntimeError) as e:
                     # a missing/mismatched checkpoint is a client-visible
@@ -486,6 +591,26 @@ async def _handle(reader, writer, loop_, swap_fn: Callable[[], dict] | None) -> 
                     # old params keep serving (swap_params validated first)
                     reply = {"id": msg.get("id"), "ok": False,
                              "reason": f"swap_failed: {e}"}
+            writer.write((json.dumps(reply) + "\n").encode())
+            await writer.drain()
+            continue
+        if isinstance(msg, dict) and msg.get("op") == "scale":
+            # replica autoscaling verb: resize the pool under live traffic
+            # (drain-safe — ReplicaPool.remove_replica never sheds a queue
+            # peers still drain). The fleet controller's remote lever.
+            if not hasattr(loop_, "scale_to"):
+                reply = {"id": msg.get("id"), "ok": False,
+                         "reason": "scale_unavailable: server is not a replica pool"}
+            else:
+                try:
+                    n = int(msg["replicas"])
+                    rec = await asyncio.get_running_loop().run_in_executor(
+                        None, loop_.scale_to, n
+                    )
+                    reply = {"id": msg.get("id"), "ok": True, "scale": rec}
+                except (KeyError, TypeError, ValueError) as e:
+                    reply = {"id": msg.get("id"), "ok": False,
+                             "reason": f"bad_request: {e}"}
             writer.write((json.dumps(reply) + "\n").encode())
             await writer.drain()
             continue
@@ -516,13 +641,14 @@ async def serve_async(
     host: str,
     port: int,
     ready: "asyncio.Future | None" = None,
-    swap_fn: Callable[[], dict] | None = None,
+    swap_fn: "Callable[..., dict] | None" = None,
 ) -> None:
     """Accept connections until cancelled; resolves ``ready`` with the bound
     port (port=0 binds an ephemeral port — how the tests avoid collisions).
     ``loop_`` is a :class:`ServeLoop` or :class:`ReplicaPool` (both expose
-    ``submit``/``live_metrics``); ``swap_fn`` arms the ``{"op": "swap"}``
-    verb."""
+    ``submit``/``live_metrics``; a pool additionally serves the ``{"op":
+    "scale"}`` autoscaling verb); ``swap_fn(tags=None)`` arms the ``{"op":
+    "swap"}`` verb (``tags`` pins explicit checkpoint tags per family)."""
     server = await asyncio.start_server(
         lambda r, w: _handle(r, w, loop_, swap_fn), host=host, port=port
     )
@@ -561,7 +687,11 @@ def run_server(
         ),
         flush=True,
     )
-    swap_fn = None if workdir is None else (lambda: engine.swap_from_workdir(workdir))
+    swap_fn = (
+        None
+        if workdir is None
+        else (lambda tags=None: engine.swap_from_workdir(workdir, tags=tags))
+    )
     try:
         asyncio.run(serve_async(pool, cfg.serve.host, cfg.serve.port, swap_fn=swap_fn))
     except KeyboardInterrupt:

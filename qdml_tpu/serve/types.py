@@ -48,6 +48,11 @@ class Prediction:
     # (completed before/after it), None when it had none. Feeds the
     # serve_summary slo-attainment figure (ServeMetrics).
     deadline_met: bool | None = None
+    # Classifier confidence: probability of the routed class (exp of the max
+    # log-prob), None when the serving path predates the stat. Feeds the
+    # per-scenario confidence histogram the drift detectors watch
+    # (docs/CONTROL.md).
+    confidence: float | None = None
 
     @property
     def ok(self) -> bool:

@@ -1,0 +1,316 @@
+"""Closed-loop fleet-control dryrun on virtual devices (ISSUE 10).
+
+The control-plane twin of serve_fleet_dryrun: force a multi-device CPU
+backend, train a small HDCE + scenario classifier, serve them, inject
+channel-family drift into the offered traffic mid-run, and let the
+:class:`~qdml_tpu.control.loop.FleetController` close the loop — detector
+fires, ONLY the drifted trunk fine-tunes, the canary gates the candidate,
+the explicit-tag hot-swap deploys it with zero request-path compiles, and
+the served NMSE on the (still drifted) traffic recovers to pre-drift
+levels. Writes ``results/control_dryrun/``:
+
+- ``loadgen_baseline_t{N}.jsonl`` — phase A: stationary traffic on the
+  original checkpoint, the pre-drift reference (interleaved best-of-N
+  trials, one fresh engine each — per-phase NMSE is deterministic, only
+  the 2-core host's timing needs the best-of, same as serve_fleet_dryrun);
+- ``loadgen_drift.jsonl``  — phase B: ``--drift-at`` traffic against an
+  external pool the controller is polling live; carries the ``drift_event``
+  + ``control_event`` records of detection and adaptation;
+- ``loadgen_recovered_t{N}.jsonl`` — phase C: all-drifted traffic on a
+  fresh engine restarted onto the PROMOTED tag
+  (``from_workdir(tags={"hdce": "hdce_last"})``), interleaved with phase A;
+- ``CONTROL_DRYRUN.json`` — the headline: per-phase NMSE on the drifting
+  family, the detection/finetune/canary/swap records, the zero-compile
+  gates, and the report-gate exit code;
+- ``report_control.md`` — ``qdml-tpu report`` over recovered-vs-baseline
+  (exit 0 = the loop healed the fleet back to its committed reference).
+
+Compile accounting: the controller fine-tunes IN PROCESS here (a real
+fleet runs the trainer out-of-process), so serving-window compile gates are
+measured per phase: phases A/C use the engine's post-warmup snapshot,
+phase B the traffic-window counter delta, and the swap record carries its
+own all-zero delta. Detection runs under live traffic (the controller
+thread polls the pool during phase B in dry-run/report-only mode);
+adaptation executes between phases for deterministic, uncontended phase
+timings on a 2-core host.
+
+Run: ``python scripts/control_dryrun.py [--devices=4] [--n=768] [--rate=80]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+DRIFT_SCENARIO = 0
+DRIFT_STEP = 4
+
+
+def main(argv: list[str]) -> int:
+    devices = int(next((a.split("=", 1)[1] for a in argv if a.startswith("--devices=")), 4))
+    n = int(next((a.split("=", 1)[1] for a in argv if a.startswith("--n=")), 768))
+    rate = float(next((a.split("=", 1)[1] for a in argv if a.startswith("--rate=")), 80.0))
+    force_cpu(devices)
+
+    from qdml_tpu.config import (
+        ControlConfig, DataConfig, ExperimentConfig, MeshConfig, ModelConfig,
+        ServeConfig, TrainConfig,
+    )
+    from qdml_tpu.control.loop import FleetController, PoolPoller
+    from qdml_tpu.parallel.mesh import serve_mesh
+    from qdml_tpu.serve import ReplicaPool, ServeEngine, run_loadgen
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.telemetry.report import report_main
+    from qdml_tpu.train.hdce import train_hdce
+    from qdml_tpu.train.qsc import train_classifier
+    from qdml_tpu.utils.compile_cache import compile_cache_stats
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    out_dir = os.path.join("results", "control_dryrun")
+    os.makedirs(out_dir, exist_ok=True)
+    workdir = os.path.join("workspace", "control_dryrun")
+
+    cfg = ExperimentConfig(
+        name="control_dryrun",
+        data=DataConfig(n_ant=32, n_sub=16, n_beam=8, data_len=512),
+        model=ModelConfig(features=16),
+        train=TrainConfig(batch_size=32, n_epochs=6, probe_every=0),
+        mesh=MeshConfig(data_axis=devices, model_axis=1, fed_axis=1),
+        serve=ServeConfig(
+            max_batch=32, buckets=(8, 16, 32), max_wait_ms=2.0, max_queue=512,
+            drift_step=DRIFT_STEP, drift_scenario=DRIFT_SCENARIO,
+        ),
+        control=ControlConfig(
+            ft_steps=300, ft_batch=32, probe_n=96,
+            min_gain_db=0.3, tol_db=0.5, watch_ticks=2,
+            autoscale=False,  # the drift loop is the story; scaling is pinned in tests
+        ),
+    )
+    headline: dict = {
+        "devices": devices, "n": n, "rate": rate,
+        "drift": {"scenario": DRIFT_SCENARIO, "step": DRIFT_STEP},
+        "workdir": workdir, "phases": {},
+    }
+
+    # -- train the fleet's models (once per dryrun; checkpoints land in the
+    # workdir the serving engine and the controller share) -------------------
+    if not os.path.isdir(os.path.join(workdir, "hdce_best")):
+        log = MetricsLogger(os.path.join(out_dir, "train.log.jsonl"), echo=False,
+                           manifest=run_manifest(cfg))
+        try:
+            train_hdce(cfg, logger=log, workdir=workdir)
+            import dataclasses
+
+            sc_cfg = dataclasses.replace(
+                cfg, train=dataclasses.replace(cfg.train, n_epochs=10)
+            )
+            train_classifier(sc_cfg, quantum=False, logger=log, workdir=workdir)
+        finally:
+            log.close()
+        os.remove(os.path.join(out_dir, "train.log.jsonl"))  # not an artifact
+
+    mesh = serve_mesh(cfg)
+
+    def fresh_engine(tags=None) -> ServeEngine:
+        return ServeEngine.from_workdir(cfg, workdir, mesh=mesh, tags=tags)
+
+    def run_phase(name, engine, path, drift_at=None, pool=None):
+        logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+        try:
+            summary = run_loadgen(
+                cfg, engine, rate=rate, n=n, deadline_ms=2000.0, logger=logger,
+                drift_at=drift_at, pool=pool,
+            )
+        finally:
+            logger.close()
+        print(f"[{name}] rps={summary['rps']} nmse_served={summary['nmse_db_served']} "
+              f"compiles={summary['compile_cache_after_warmup']}")
+        return summary
+
+    # -- phase B: drift injected mid-run, controller watching live ----------
+    drift_path = os.path.join(out_dir, "loadgen_drift.jsonl")
+    logger_b = MetricsLogger(drift_path, echo=False, manifest=run_manifest(cfg))
+    engine = fresh_engine()
+    pool = ReplicaPool(engine, sink=logger_b.telemetry, log_requests=False).start()
+    ctrl = FleetController(
+        cfg, workdir, PoolPoller(pool, engine, workdir), engine=engine,
+        sink=logger_b.telemetry, drift_step_hint=DRIFT_STEP,
+    )
+    # detection-only while traffic runs (report, don't act): adaptation is
+    # executed between phases so the 2-core host's phase timings stay clean
+    ctrl.dry_run = True
+    thread, stop = ctrl.run_in_thread(interval_s=0.25)
+    try:
+        summary_b = run_loadgen(
+            cfg, engine, rate=rate, n=n, deadline_ms=2000.0, logger=logger_b,
+            drift_at=n // 2, pool=pool,
+        )
+    finally:
+        stop.set()
+        thread.join(timeout=10.0)
+    print(f"[drift] windows pre={summary_b['windows']['pre_drift']['nmse_db_drift_scenario']} "
+          f"post={summary_b['windows']['post_drift']['nmse_db_drift_scenario']} "
+          f"live_detector_state={ctrl.monitor.state()}")
+
+    # ground-truth windowed parity: replay phase B's chunked windows into the
+    # controller's nmse_parity detector for the DRIFTING family (the
+    # loadgen harness knows h_perf; a production fleet would feed labeled
+    # shadow traffic here)
+    parity_events = []
+    for chunk in summary_b["windows"]["chunks"]:
+        db = chunk.get("nmse_db_drift_scenario")
+        if db is not None:
+            ev = ctrl.observe_parity(DRIFT_SCENARIO, db)
+            if ev:
+                parity_events.append(ev)
+    fired = ctrl.monitor.active()
+    print(f"[detect] fired={fired} parity_events={parity_events}")
+    if not any(s == DRIFT_SCENARIO for s, _ in fired):
+        print("FATAL: drift was never detected"); return 1
+
+    # -- adapt: finetune -> canary -> explicit-tag swap ----------------------
+    ctrl.dry_run = False
+    ctrl.deployer.dry_run = False
+    pre_adapt_cache = compile_cache_stats()
+    out = ctrl.tick()
+    adapted = [e for e in out["events"] if e.get("action") == "adapted"]
+    if not adapted:
+        print("FATAL: adaptation did not complete:", out["events"]); return 1
+    rec = adapted[0]
+    assert rec["canary"]["passed"] is True
+    assert rec["deploy"]["swap"]["compile"] == {"hits": 0, "misses": 0, "requests": 0}
+    assert rec["deploy"]["swap"]["tags"]["hdce"] == "hdce_last"
+    adapt_compiles = {
+        k: v - pre_adapt_cache.get(k, 0) for k, v in compile_cache_stats().items()
+    }
+    headline["phases"]["drift"] = {
+        "rps": summary_b["rps"],
+        "windows": {k: summary_b["windows"][k] for k in ("pre_drift", "post_drift")},
+        "compile_cache_traffic_window": summary_b["compile_cache_after_warmup"],
+        "drift_events": {
+            "live_confidence_streams": ctrl.monitor.state(),
+            "parity": parity_events,
+        },
+    }
+    headline["adaptation"] = {
+        "finetune": rec["finetune"],
+        "canary": rec["canary"],
+        "swap": rec["deploy"]["swap"],
+        "control_plane_compiles_during_adapt": adapt_compiles,
+        "note": (
+            "fine-tune + canary compile in the controller (control plane); "
+            "the swap record's own counter delta is the request-path gate "
+            "and is all-zero"
+        ),
+    }
+
+    # phase B's pool retires before phase C opens a fresh one on the SAME
+    # (now adapted) engine; the controller's later watch ticks poll the
+    # stopped pool, which is defined behavior for the metrics view
+    pool.stop()
+
+    # -- phases A (baseline) + C (recovered): interleaved best-of-N ----------
+    # Per-trial fresh engines: phase A restores the ORIGINAL checkpoint
+    # (hdce_best via newest-tag resolution — the stale-best behavior the
+    # deployer's explicit tags exist to bypass); phase C restarts onto the
+    # PROMOTED tag (from_workdir's explicit-tag pin, the restart twin of the
+    # swap fix; the phase-B engine already proved the LIVE swap above).
+    # Interleaved trials, best-of per phase, exactly like serve_fleet_dryrun:
+    # on a contended 2-core host per-run latency swings far past the report
+    # gate's 10%, and blocked A-A-A-C-C-C ordering hands whichever phase ran
+    # in the quiet window a fake win — NMSE per phase is deterministic (same
+    # data, same params every trial); only the timing needs the best-of.
+    trials = 3
+    best: dict = {}
+    trial_stats: dict = {"baseline": [], "recovered": []}
+    for t in range(trials):
+        for name, tags, drift_at in (
+            ("baseline", None, None),
+            ("recovered", {"hdce": "hdce_last"}, 0),
+        ):
+            path = os.path.join(out_dir, f"loadgen_{name}_t{t}.jsonl")
+            summary = run_phase(
+                f"{name} t{t}", fresh_engine(tags=tags), path, drift_at=drift_at
+            )
+            p50 = (summary["latency_ms"] or {}).get("p50_ms")
+            trial_stats[name].append({"rps": summary["rps"], "p50_ms": p50})
+            if name not in best or (p50 or 1e9) < (
+                (best[name][0]["latency_ms"] or {}).get("p50_ms") or 1e9
+            ):
+                best[name] = (summary, path)
+    sA, base_path = best["baseline"]
+    summary_c, rec_path = best["recovered"]
+    headline["phases"]["baseline"] = {
+        "rps": sA["rps"], "nmse_db_served": sA["nmse_db_served"],
+        "slo": sA["slo"], "trials": trial_stats["baseline"],
+        "compile_cache_after_warmup": sA["compile_cache_after_warmup"],
+    }
+
+    # watch window: feed the recovered parity, confirm the deploy
+    try:
+        confirm = None
+        for _ in range(cfg.control.watch_ticks + 1):
+            ctrl.observe_parity(
+                DRIFT_SCENARIO,
+                summary_c["windows"]["post_drift"]["nmse_db_drift_scenario"],
+            )
+            out = ctrl.tick()
+            confirm = next(
+                (e for e in out["events"] if e.get("action") == "deploy_confirmed"),
+                confirm,
+            )
+        if confirm is None:
+            print("FATAL: deploy was not confirmed (rollback?)"); return 1
+        print(f"[recovered] confirm={confirm}")
+    finally:
+        logger_b.close()
+
+    pre_db = summary_b["windows"]["pre_drift"]["nmse_db_drift_scenario"]
+    degraded_db = summary_b["windows"]["post_drift"]["nmse_db_drift_scenario"]
+    recovered_db = summary_c["windows"]["post_drift"]["nmse_db_drift_scenario"]
+    headline["phases"]["recovered"] = {
+        "rps": summary_c["rps"], "nmse_db_served": summary_c["nmse_db_served"],
+        "nmse_db_drift_scenario": recovered_db,
+        "slo": summary_c["slo"], "trials": trial_stats["recovered"],
+        "compile_cache_after_warmup": summary_c["compile_cache_after_warmup"],
+        "watch_confirmed": confirm,
+    }
+    frac = (recovered_db - degraded_db) / (pre_db - degraded_db)
+    headline["recovery"] = {
+        "drift_family_nmse_db": {
+            "pre_drift": pre_db, "degraded": degraded_db, "recovered": recovered_db,
+        },
+        "degradation_db": round(degraded_db - pre_db, 3),
+        "recovered_vs_pre_drift_db": round(recovered_db - pre_db, 3),
+        "fraction_of_degradation_recovered": round(frac, 3),
+        # "recovered to pre-drift levels": back within half a dB of the
+        # pre-drift window AND most of the degradation undone (phase windows
+        # are different sample draws — ~0.3 dB of window noise is inherent;
+        # the residual gap is the un-retrained classifier's misrouting tail,
+        # see docs/CONTROL.md)
+        "recovered_to_pre_drift_levels": bool(
+            recovered_db <= pre_db + 0.5 and frac >= 0.6
+        ),
+    }
+
+    # -- report round-trip: recovered vs baseline ----------------------------
+    report_md = os.path.join(out_dir, "report_control.md")
+    rc = report_main(
+        [f"--current={rec_path}", f"--baseline={base_path}", f"--out={report_md}"]
+    )
+    headline["report_gate"] = {"exit_code": rc, "markdown": report_md}
+    with open(os.path.join(out_dir, "CONTROL_DRYRUN.json"), "w") as fh:
+        json.dump(headline, fh, indent=2)
+    print(json.dumps(headline, indent=2))
+    if rc != 0 or not headline["recovery"]["recovered_to_pre_drift_levels"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -602,9 +602,9 @@ def test_serve_checkify_parity_and_trip():
     _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
     engine = ServeEngine(cfg, hdce_vars, {"params": sc_state.params})
     samples = make_request_samples(cfg, 8)
-    offline_h, offline_pred = engine.offline_forward(samples["x"])
+    offline_h, offline_pred, _ = engine.offline_forward(samples["x"])
     engine.warmup()
-    h, pred, bucket = engine.infer(samples["x"][:3])
+    h, pred, _conf, bucket = engine.infer(samples["x"][:3])
     np.testing.assert_allclose(h, offline_h[:3], rtol=1e-5, atol=1e-5)
     np.testing.assert_array_equal(pred, offline_pred[:3])
     assert all(v == 0 for v in engine.request_path_compiles().values())
@@ -613,5 +613,5 @@ def test_serve_checkify_parity_and_trip():
     with pytest.raises(DivergenceError, match="serve checkify"):
         engine.infer(bad)
     # the engine survives the trip: the next clean batch still serves
-    h2, _, _ = engine.infer(samples["x"][:2])
+    h2, _, _, _ = engine.infer(samples["x"][:2])
     np.testing.assert_allclose(h2, offline_h[:2], rtol=1e-5, atol=1e-5)

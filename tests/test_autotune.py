@@ -267,7 +267,7 @@ def test_serve_warmup_autotunes_with_zero_request_path_compiles():
     )
     x = np.random.default_rng(0).standard_normal((3, *cfg.image_hw, 2)).astype(np.float32)
     for _ in range(3):
-        h, pred, bucket = engine.infer(x)
+        h, pred, _conf, bucket = engine.infer(x)
         assert h.shape[0] == 3 and bucket == 4
     assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
 
@@ -297,7 +297,7 @@ def test_serve_mps_impl_baked_into_aot_bucket_zero_compiles():
     assert warm["quantum_impl"]["4"]["mps_chi"] == 4
     x = np.random.default_rng(0).standard_normal((3, *cfg.image_hw, 2)).astype(np.float32)
     for _ in range(3):
-        h, pred, bucket = engine.infer(x)
+        h, pred, _conf, bucket = engine.infer(x)
         assert h.shape[0] == 3 and bucket == 4
     assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
 

@@ -299,11 +299,11 @@ def test_serve_sparse_buckets_zero_compiles_and_parity():
     cfg = _mini_cfg()
     eng = _mini_engine(cfg)
     x = np.random.default_rng(0).standard_normal((11, 8, 4, 2)).astype(np.float32)
-    off_h, off_p = eng.offline_forward(x)
+    off_h, off_p, _ = eng.offline_forward(x)
     warm = eng.warmup()
     assert set(warm["dispatch"]["mode"].values()) == {"sparse"}
     for _ in range(3):
-        h, p, b = eng.infer(x)
+        h, p, _c, b = eng.infer(x)
     np.testing.assert_array_equal(p, off_p)
     np.testing.assert_allclose(h, off_h, atol=1e-5)
     assert all(v == 0 for v in eng.request_path_compiles().values())
@@ -324,7 +324,7 @@ def test_serve_auto_dispatch_below_window_stays_dense_no_race():
     race = warm["dispatch"]["race"]["8"]
     assert race["candidates"]["dense"] == {"only_candidate": True}
     x = np.random.default_rng(1).standard_normal((5, 8, 4, 2)).astype(np.float32)
-    h, p, b = eng.infer(x)
+    h, p, _c, b = eng.infer(x)
     assert h.shape == (5, cfg.h_out_dim)
     assert eng.dispatch_summary()["mode"] == "dense"
     assert eng.dispatch_summary()["overflow_rate"] is None  # nothing sparse ran

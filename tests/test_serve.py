@@ -164,7 +164,7 @@ def warmed():
     _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
     engine = ServeEngine(cfg, hdce_vars, {"params": sc_state.params})
     samples = make_request_samples(cfg, 32)
-    offline_h, offline_pred = engine.offline_forward(samples["x"])
+    offline_h, offline_pred, _ = engine.offline_forward(samples["x"])
     engine.warmup()
     return cfg, engine, samples, offline_h, offline_pred
 
@@ -181,7 +181,7 @@ def test_infer_parity_across_buckets(warmed):
     eval forward on the same checkpoint — padding rows cannot leak."""
     cfg, engine, samples, offline_h, offline_pred = warmed
     for n in (1, 3, 4, 5, 8):
-        h, pred, bucket = engine.infer(samples["x"][:n])
+        h, pred, _conf, bucket = engine.infer(samples["x"][:n])
         assert bucket == pick_bucket(n, engine.buckets)
         assert h.shape == (n, cfg.h_out_dim)
         np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
@@ -193,7 +193,7 @@ def test_oversize_batch_serves_in_largest_bucket_chunks(warmed):
     n = 19  # > largest bucket (8): 8 + 8 + 3-padded-to-4
     x = np.concatenate([samples["x"]] * 2)[:n]
     ref = np.concatenate([offline_h] * 2)[:n]
-    h, pred, bucket = engine.infer(x)
+    h, pred, _conf, bucket = engine.infer(x)
     assert bucket == engine.buckets[-1] and h.shape[0] == n
     np.testing.assert_allclose(h, ref, rtol=1e-5, atol=1e-5)
 

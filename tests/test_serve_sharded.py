@@ -74,7 +74,7 @@ def sharded():
     hdce_vars, clf_vars = _vars(cfg)
     engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
     samples = make_request_samples(cfg, 32)
-    offline_h, offline_pred = engine.offline_forward(samples["x"])
+    offline_h, offline_pred, _ = engine.offline_forward(samples["x"])
     warm = engine.warmup()
     return cfg, engine, samples, offline_h, offline_pred, warm
 
@@ -117,7 +117,7 @@ def test_sharded_infer_parity_and_zero_compiles(sharded):
     pinned as the single-device one."""
     cfg, engine, samples, offline_h, offline_pred, _ = sharded
     for n in (1, 3, 4, 5, 8):
-        h, pred, bucket = engine.infer(samples["x"][:n])
+        h, pred, _conf, bucket = engine.infer(samples["x"][:n])
         assert h.shape == (n, cfg.h_out_dim)
         np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
         np.testing.assert_array_equal(pred, offline_pred[:n])
@@ -153,7 +153,7 @@ def test_expert_sharded_trunks_parity():
     hdce_vars, clf_vars = _vars(cfg)
     engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
     samples = make_request_samples(cfg, 16)
-    offline_h, offline_pred = engine.offline_forward(samples["x"])
+    offline_h, offline_pred, _ = engine.offline_forward(samples["x"])
     warm = engine.warmup()
     assert warm["mesh"]["expert_sharding"] is True
     # trunk params are genuinely fed-sharded on device
@@ -161,7 +161,7 @@ def test_expert_sharded_trunks_parity():
     stacked = [l for p, l in leaves if "StackedConvP128" in str(p)]
     assert stacked and all("fed" in str(l.sharding.spec) for l in stacked)
     for n in (3, 8):
-        h, pred, _ = engine.infer(samples["x"][:n])
+        h, pred, _, _ = engine.infer(samples["x"][:n])
         np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
         np.testing.assert_array_equal(pred, offline_pred[:n])
     assert engine.request_path_compiles() == ZERO
@@ -189,11 +189,11 @@ def test_hot_swap_under_traffic_zero_compiles_exact_parity():
     new_hdce, new_clf = _vars(cfg, seed=123)
     engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
     samples = make_request_samples(cfg, 16)
-    offline_h, _ = engine.offline_forward(samples["x"])
+    offline_h, _, _ = engine.offline_forward(samples["x"])
     # the NEW checkpoint's parity reference, through the same engine family,
     # compiled before the gate arms
     ref_engine = ServeEngine(cfg, new_hdce, new_clf, mesh=mesh)
-    new_h, new_pred = ref_engine.offline_forward(samples["x"])
+    new_h, new_pred, _ = ref_engine.offline_forward(samples["x"])
     engine.warmup()
 
     pool = ReplicaPool(engine, replicas=2).start()
@@ -224,7 +224,7 @@ def test_hot_swap_under_traffic_zero_compiles_exact_parity():
     assert engine.request_path_compiles() == ZERO
     # swaps are repeatable: back to the original checkpoint, still zero
     assert engine.swap_params(hdce_vars, clf_vars)["compile"] == ZERO
-    h, _, _ = engine.infer(samples["x"][:4])
+    h, _, _, _ = engine.infer(samples["x"][:4])
     np.testing.assert_allclose(h, offline_h[:4], rtol=1e-5, atol=1e-5)
     assert engine.request_path_compiles() == ZERO
 
@@ -243,7 +243,7 @@ def test_swap_rejects_mismatched_checkpoint(sharded):
     wrong_h, wrong_c = _vars(wrong_cfg)
     with pytest.raises(ValueError, match="hot-swap"):
         engine.swap_params(wrong_h, wrong_c)
-    h, _, _ = engine.infer(samples["x"][:4])
+    h, _, _, _ = engine.infer(samples["x"][:4])
     np.testing.assert_allclose(h, offline_h[:4], rtol=1e-5, atol=1e-5)
 
 
@@ -270,14 +270,14 @@ def test_swap_from_workdir_redeploys_newest(tmp_path):
     engine = ServeEngine.from_workdir(cfg, wd)
     samples = make_request_samples(cfg, 8)
     engine.warmup()
-    before, _, _ = engine.infer(samples["x"][:4])
+    before, _, _, _ = engine.infer(samples["x"][:4])
     # a better checkpoint lands (best beats last in tag discovery)
     save_checkpoint(wd, "hdce_best", h1)
     save_checkpoint(wd, "sc_best", c1)
     rec = engine.swap_from_workdir(wd)
     assert rec["tags"] == {"hdce": "hdce_best", "sc": "sc_best"}
     assert rec["compile"] == ZERO
-    after, _, _ = engine.infer(samples["x"][:4])
+    after, _, _, _ = engine.infer(samples["x"][:4])
     assert np.max(np.abs(after - before)) > 0  # the deploy actually landed
     assert engine.request_path_compiles() == ZERO
 
