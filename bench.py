@@ -894,13 +894,29 @@ def run_scenario_scaling_child(out_path: str | None = None) -> int:
     return 0
 
 
-def _bench_serve_infer(max_steps: int, budget_s: float, bucket: int = 64) -> dict:
+def _bench_serve_infer(
+    max_steps: int,
+    budget_s: float,
+    bucket: int = 64,
+    batching: str = "bucket",
+    fill: float = 1.0,
+) -> dict:
     """Request-path throughput of the online serving engine
-    (:mod:`qdml_tpu.serve`): one warmed full-bucket ``infer`` per iteration —
-    classify -> all-trunks -> top-1 route through a pre-compiled executable —
-    i.e. the saturated-batcher steady state. Random-init params: serving cost
-    is architecture-dependent, not weight-dependent. The record carries the
-    zero-request-path-compile gate alongside the rate."""
+    (:mod:`qdml_tpu.serve`): one warmed ``infer`` per iteration — classify ->
+    all-trunks -> top-1 route through a pre-compiled executable — i.e. the
+    saturated-batcher steady state. Random-init params: serving cost is
+    architecture-dependent, not weight-dependent. The record carries the
+    zero-request-path-compile gate alongside the rate.
+
+    ``batching``/``fill`` size the ragged variant (``serve_ragged_infer``):
+    ``fill < 1`` serves a PARTIAL batch of ``ceil(fill * bucket)`` valid rows
+    through the single capacity-tier executable — the production-fill regime
+    the ragged mode targets — and the record reports goodput (valid rows/s,
+    what ``samples_per_sec`` counts here) plus the padding-waste fraction, so
+    the bucket-vs-ragged comparison in a bench session is apples-to-apples
+    with the loadgen dryrun's columns."""
+    import math
+
     import numpy as np
 
     from qdml_tpu.config import ExperimentConfig, ServeConfig, TrainConfig
@@ -911,7 +927,7 @@ def _bench_serve_infer(max_steps: int, budget_s: float, bucket: int = 64) -> dic
 
     cfg = ExperimentConfig(
         train=TrainConfig(batch_size=_CELL_BS, n_epochs=1),
-        serve=ServeConfig(max_batch=bucket, buckets=(bucket,)),
+        serve=ServeConfig(max_batch=bucket, buckets=(bucket,), batching=batching),
     )
     _, hdce_state = init_hdce_state(cfg, steps_per_epoch=100)
     hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
@@ -920,14 +936,15 @@ def _bench_serve_infer(max_steps: int, budget_s: float, bucket: int = 64) -> dic
     t0 = time.perf_counter()
     warm = engine.warmup()
     warmup_s = time.perf_counter() - t0
+    n_valid = max(1, min(bucket, math.ceil(fill * bucket)))
     x = (
         np.random.default_rng(0)
-        .standard_normal((bucket, *cfg.image_hw, 2))
+        .standard_normal((n_valid, *cfg.image_hw, 2))
         .astype(np.float32)
     )
     # one probe sizes the loop (infer is synchronous: it device_gets results)
     t0 = time.perf_counter()
-    engine.infer(x)
+    _, _, _, info = engine.infer(x)
     est = max(time.perf_counter() - t0, 1e-4)
     n = max(3, min(max_steps, int(budget_s / est)))
     hist = Histogram()
@@ -937,9 +954,14 @@ def _bench_serve_infer(max_steps: int, budget_s: float, bucket: int = 64) -> dic
         engine.infer(x)
         hist.add(time.perf_counter() - t1)
     wall = time.perf_counter() - t0
-    return {
-        "samples_per_sec": round(n * bucket / wall, 1),
+    rec = {
+        # valid rows/s == goodput: padded rows never count, in either mode
+        "samples_per_sec": round(n * n_valid / wall, 1),
+        "goodput_rps": round(n * n_valid / wall, 1),
+        "padding_waste": round(1.0 - n_valid / info.rows, 4),
         "bucket": bucket,
+        "batching": info.mode,
+        "n_valid": n_valid,
         "warmup_s": round(warmup_s, 3),
         "batch_ms": hist.summary(),
         "compile_cache_after_warmup": engine.request_path_compiles(),
@@ -947,6 +969,7 @@ def _bench_serve_infer(max_steps: int, budget_s: float, bucket: int = 64) -> dic
         # executable, so peak temp memory is available here)
         "cost": warm["cost"].get(str(bucket), {"available": False, "reason": "no bucket cost"}),
     }
+    return rec
 
 
 def _bench_error_entry(e: BaseException) -> dict:
@@ -1084,6 +1107,16 @@ def run_child(platform: str) -> int:
         # platforms) — the steady-state rate `qdml-tpu serve` sustains with
         # a saturated batcher, plus its zero-compile gate
         ("serve_infer", lambda: _bench_serve_infer(max_steps, budget / 4)),
+        # the ragged twin at a production (3/4) fill level: the traced
+        # valid-count executable serving a partial batch — goodput and
+        # padding-waste columns match the loadgen dryrun's, so a bench
+        # session carries the bucket-vs-ragged per-dispatch comparison too
+        (
+            "serve_ragged_infer",
+            lambda: _bench_serve_infer(
+                max_steps, budget / 4, batching="ragged", fill=0.75
+            ),
+        ),
     ]
     if on_tpu:
         # The QSC K=1 step is ~entirely host dispatch gap at this model size

@@ -847,6 +847,70 @@ def rule_collective_outside_shardmap(ctx: ModuleContext) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 16. pad-to-bucket-in-serve — request batches padded to static buckets
+#     outside the sanctioned batcher path
+# ---------------------------------------------------------------------------
+
+
+def rule_pad_to_bucket_in_serve(ctx: ModuleContext) -> list[Finding]:
+    """A function that picks a static bucket (``pick_bucket``) AND pads data
+    into a fresh zeros/empty allocation via slice assignment (``xp[:n] = x``)
+    is re-implementing the serve engine's pad-to-bucket step outside the one
+    sanctioned path — exactly the shape the ragged batching mode exists to
+    account for (every such pad is compute on rows nobody asked for, and a
+    second pad site dodges the DispatchInfo goodput/padding-waste ledger the
+    report gates watch). The engine's own ``infer`` carries the suppression
+    with the reason written next to it; anything else is a finding.
+
+    Deliberately NOT caught: picking a bucket without padding (shape-table
+    readers, metrics labels), padding without a bucket pick (fixed-shape
+    scratch buffers), and jnp-level ``.at[].set`` scatter (the in-program
+    packing ``sparse_dispatch`` does is the fix, not the bug)."""
+    out: list[Finding] = []
+    for fn, qual in ctx.functions:
+        picks = [
+            sub
+            for sub in ast.walk(fn)
+            if isinstance(sub, ast.Call)
+            and (ctx.canonical(sub.func) or dotted_name(sub.func) or "").rsplit(
+                ".", 1
+            )[-1] == "pick_bucket"
+        ]
+        if not picks:
+            continue
+        allocates = any(
+            isinstance(sub, ast.Call)
+            and (ctx.canonical(sub.func) or dotted_name(sub.func) or "").rsplit(
+                ".", 1
+            )[-1] in ("zeros", "empty", "zeros_like", "empty_like")
+            for sub in ast.walk(fn)
+        )
+        pad_assign = any(
+            isinstance(sub, ast.Assign)
+            and any(
+                isinstance(t, ast.Subscript) and isinstance(t.slice, ast.Slice)
+                for t in sub.targets
+            )
+            for sub in ast.walk(fn)
+        )
+        if allocates and pad_assign:
+            out.append(
+                ctx.finding(
+                    "pad-to-bucket-in-serve",
+                    picks[0],
+                    f"{qual!r} picks a static bucket and pads a batch into it "
+                    "outside the sanctioned batcher path "
+                    "(serve/engine.ServeEngine.infer) — route the batch "
+                    "through the engine so the pad rows land in the "
+                    "DispatchInfo goodput/padding-waste ledger (or serve the "
+                    "tier ragged), instead of burning unaccounted FLOPs on "
+                    "padding",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -910,6 +974,10 @@ RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
     "collective-outside-shardmap": (
         rule_collective_outside_shardmap,
         "ppermute/psum in quantum/ outside a shard_map region (deadlock shape)",
+    ),
+    "pad-to-bucket-in-serve": (
+        rule_pad_to_bucket_in_serve,
+        "request batch padded to a static bucket outside the sanctioned batcher path",
     ),
     # "slow-marker" is data-driven (needs a --durations report) and lives in
     # qdml_tpu.analysis.slowmarkers; the CLI folds it in when given the data.

@@ -30,7 +30,11 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
                                   # buckets (mesh-sharded when >1 device),
                                   # replica pool, JSON/TCP loop ({"op":
                                   # "metrics"} live counters; {"op": "swap"}
-                                  # zero-downtime checkpoint hot-swap)
+                                  # zero-downtime checkpoint hot-swap);
+                                  # --serve.batching=auto|bucket|ragged picks
+                                  # pad-to-bucket coalescing vs traced
+                                  # valid-count continuous batching (auto =
+                                  # per-capacity race table, docs/SERVING.md)
     python -m qdml_tpu.cli loadgen [--rate=RPS] [--n=N] [--drift-at=K]
                                   # open-loop traffic
                                   # (--serve.arrival=poisson|bursty|diurnal)

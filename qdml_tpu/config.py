@@ -274,6 +274,17 @@ class ServeConfig:
     # Larger f buys fewer overflow-fallback batches under skewed routing at
     # ~f*B trunk-rows of compute; overflow is NEVER dropped (dense fallback).
     capacity_factor: float = 1.25
+    # Batch-admission/executable mode (serve/batcher.py + serve/engine.py,
+    # docs/SERVING.md "Ragged continuous batching"): "bucket" pads every
+    # coalesced batch to its power-of-two bucket and flushes on bucket edges
+    # (full batch or max_wait) — the PR-2..10 behavior; "ragged" compiles each
+    # capacity tier with a TRACED valid-row count (pad rows masked inert
+    # inside the program) and admits continuously — the batcher dispatches
+    # whenever the engine is free instead of waiting out the coalescing
+    # window; "auto" consults/fills the measured per-(platform, capacity)
+    # race table (serve/batching_autotune.py) at warmup, exactly like the
+    # routing and circuit-impl autotuners.
+    batching: str = "auto"
     # Replica pool size: N ServeLoops sharing ONE warmup, ONE autotune table
     # and ONE MicroBatcher feed (serve/server.py ReplicaPool). Per-replica
     # ServeMetrics merge exactly via Histogram.merge.

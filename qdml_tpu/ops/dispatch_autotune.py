@@ -34,10 +34,11 @@ Contracts (mirroring the quantum dispatcher):
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Any, Callable
+
+from qdml_tpu.utils.tune_table import TableStore
 
 SCHEMA = 1
 DEFAULT_TABLE = os.path.join("results", "autotune", "routing_dispatch.json")
@@ -52,23 +53,19 @@ SPARSE_MIN_SCENARIOS = 6
 
 _MODES = ("dense", "sparse")
 
-# In-process table cache: {abspath -> entries dict}; status mirrors
-# quantum.autotune ("ok"|"missing"|"corrupt"|"alien"|"unreadable").
-_CACHE: dict[str, dict] = {}
-_STATUS: dict[str, str] = {}
-_ACTIVE_PATH: str | None = None
+# Table persistence/caching lives in the shared store (utils/tune_table.py);
+# the module-level functions stay as this dispatcher's public API.
+_STORE = TableStore(DEFAULT_TABLE, ENV_TABLE, "routing_dispatch_table",
+                    "ops.dispatch_autotune")
 
 
 def set_table_path(path: str | None) -> None:
     """Install (or clear) the process-wide routing-table location."""
-    global _ACTIVE_PATH
-    _ACTIVE_PATH = os.path.abspath(path) if path else None
+    _STORE.set_path(path)
 
 
 def table_path(path: str | None = None) -> str:
-    return os.path.abspath(
-        path or _ACTIVE_PATH or os.environ.get(ENV_TABLE) or DEFAULT_TABLE
-    )
+    return _STORE.path(path)
 
 
 def table_key(
@@ -98,66 +95,21 @@ def eligible_modes(n_scenarios: int) -> list[str]:
 def load_table(path: str | None = None) -> dict:
     """entries dict; {} on missing/corrupt/alien — a broken table degrades to
     dense, never raises (same contract as the quantum dispatcher)."""
-    p = table_path(path)
-    if p in _CACHE:
-        return _CACHE[p]
-    entries: dict = {}
-    status = "ok"
-    try:
-        with open(p) as fh:
-            data = json.load(fh)
-        if isinstance(data, dict) and isinstance(data.get("entries"), dict):
-            entries = data["entries"]
-        else:
-            status = "alien"
-    except FileNotFoundError:
-        status = "missing"
-    except json.JSONDecodeError:
-        status = "corrupt"
-    except OSError:
-        status = "unreadable"
-    except (ValueError, TypeError):
-        status = "corrupt"
-    _CACHE[p] = entries
-    _STATUS[p] = status
-    return entries
+    return _STORE.load(path)
 
 
 def table_status(path: str | None = None) -> str:
-    load_table(path)
-    return _STATUS.get(table_path(path), "ok")
+    return _STORE.status(path)
 
 
 def save_table(entries: dict, path: str | None = None) -> str:
     """Atomically persist the manifest-headed table; best-effort (serving
     must survive a read-only results dir)."""
-    p = table_path(path)
-    from qdml_tpu.telemetry import run_manifest
-
-    payload = {
-        "schema": SCHEMA,
-        "kind": "routing_dispatch_table",
-        "manifest": run_manifest(argv=["ops.dispatch_autotune"], include_jax=True),
-        "entries": entries,
-    }
-    try:
-        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
-        tmp = f"{p}.tmp.{os.getpid()}"
-        with open(tmp, "w") as fh:
-            json.dump(payload, fh, indent=2)
-            fh.write("\n")
-        os.replace(tmp, p)
-    except OSError:
-        pass
-    _CACHE[p] = entries
-    _STATUS[p] = "ok"
-    return p
+    return _STORE.save(entries, path, schema=SCHEMA)
 
 
 def invalidate_cache() -> None:
-    _CACHE.clear()
-    _STATUS.clear()
-    set_table_path(None)
+    _STORE.invalidate()
 
 
 def lookup(

@@ -8,8 +8,14 @@ serve heavy traffic). A checkpoint goes online in three layers:
   per batch bucket at warmup, and proves the request path never compiles
   (compile-cache counters);
 - :class:`~qdml_tpu.serve.batcher.MicroBatcher` — bounded queue, dynamic
-  max-batch/max-wait coalescing into power-of-two buckets, deadline-aware
-  admission that sheds typed ``Overloaded`` results;
+  max-batch/max-wait coalescing into power-of-two buckets OR continuous
+  admission (the ragged batching mode: dispatch whenever the engine is
+  free), deadline-aware admission that sheds typed ``Overloaded`` results;
+  which mode serves is the third measured-dispatch race
+  (:mod:`qdml_tpu.serve.batching_autotune`, ``serve.batching=auto``) —
+  bucket pad-and-slice vs traced valid-count ragged executables, raced per
+  capacity tier at warmup with goodput/padding-waste accounting as
+  first-class :class:`~qdml_tpu.serve.metrics.ServeMetrics`;
 - :class:`~qdml_tpu.serve.server.ServeLoop` /
   :class:`~qdml_tpu.serve.server.ReplicaPool` / ``qdml-tpu serve`` — the
   worker pump, the N-replica pool sharing one warmup + one batcher feed,

@@ -1,12 +1,21 @@
-"""Dynamic micro-batcher: bounded queue, max-batch/max-wait coalescing.
+"""Dynamic micro-batcher: bounded queue, coalescing OR continuous admission.
 
-The policy is the standard serving tradeoff (TorchServe/Triton-style dynamic
-batching, applied to the MoE-style top-1 HDCE pipeline): requests coalesce
-until either ``max_batch`` of them are waiting (flush immediately — a full
-bucket) or the OLDEST waiting request has aged ``max_wait_ms`` (flush partial
-— latency floor beats fill). Batches then pad up to the next power-of-two
-bucket so every shape hitting the engine was AOT-compiled at warmup
-(:mod:`qdml_tpu.serve.engine`).
+Two admission policies share the queue/shedding machinery:
+
+- **coalesce** (default, the TorchServe/Triton-style dynamic batching the
+  bucket engine mode uses): requests coalesce until either ``max_batch`` of
+  them are waiting (flush immediately — a full bucket) or the OLDEST waiting
+  request has aged ``max_wait_ms`` (flush partial — latency floor beats
+  fill). Batches then pad up to the next power-of-two bucket so every shape
+  hitting the engine was AOT-compiled at warmup
+  (:mod:`qdml_tpu.serve.engine`).
+- **continuous** (``continuous=True``, the ragged engine mode's policy —
+  vLLM-style continuous batching applied to this pipeline): ``next_batch``
+  returns everything queued (up to ``max_batch``) the moment ANY request is
+  waiting — the worker dispatches whenever the engine is free instead of
+  sleeping out the coalescing window, so an idle engine never sits on a
+  non-empty queue. Batching still happens, implicitly: while one dispatch is
+  in flight, new arrivals queue and the next dispatch admits them all.
 
 Admission control is deadline-aware and sheds load as typed
 :class:`~qdml_tpu.serve.types.Overloaded` results instead of letting the
@@ -69,6 +78,7 @@ class MicroBatcher:
         max_wait_s: float = 0.002,
         max_queue: int = 256,
         clock: Callable[[], float] = time.monotonic,
+        continuous: bool = False,
     ):
         if max_queue < max_batch:
             raise ValueError(
@@ -79,6 +89,12 @@ class MicroBatcher:
         self.max_wait_s = max_wait_s
         self.max_queue = max_queue
         self.clock = clock
+        # continuous admission (the ragged engine mode): next_batch returns
+        # whatever is queued instead of waiting out the coalescing window.
+        # Mutable on purpose — ServeLoop/ReplicaPool sync it from the warmed
+        # engine's measured batching mode (the "auto" race resolves at
+        # warmup, after the batcher exists).
+        self.continuous = bool(continuous)
         self._q: deque[Request] = deque()
         self._lock = threading.Lock()
         # Wake signal owned by the QUEUE, not any one consumer: a replica
@@ -134,19 +150,26 @@ class MicroBatcher:
                 self._q = live
             if not self._q:
                 return [], shed
-            full = len(self._q) >= self.max_batch
-            aged = (now - self._q[0].enqueue_ts) >= self.max_wait_s
-            if not (full or aged):
-                return [], shed
+            if not self.continuous:
+                full = len(self._q) >= self.max_batch
+                aged = (now - self._q[0].enqueue_ts) >= self.max_wait_s
+                if not (full or aged):
+                    return [], shed
             take = min(len(self._q), self.max_batch)
             return [self._q.popleft() for _ in range(take)], shed
 
     def wait_hint(self, now: float | None = None) -> float:
-        """Seconds until the oldest queued request hits ``max_wait_s`` (the
-        serve loop's idle sleep bound); ``max_wait_s`` when the queue is
-        empty."""
+        """Seconds until the serve loop should next pump: in coalesce mode,
+        until the oldest queued request hits ``max_wait_s``; in continuous
+        mode, 0 whenever anything is queued (an idle engine must never sleep
+        on a non-empty queue — the one race a submit's wake can lose is a
+        worker that checked the queue just before the enqueue, and a zero
+        hint closes it). ``max_wait_s`` when the queue is empty (the idle
+        sleep bound; submits wake the loop sooner)."""
         now = self.clock() if now is None else now
         with self._lock:
             if not self._q:
                 return self.max_wait_s
+            if self.continuous:
+                return 0.0
             return max(0.0, self.max_wait_s - (now - self._q[0].enqueue_ts))

@@ -1,0 +1,180 @@
+"""Autotuned bucket-vs-ragged batching dispatch for the serve request path.
+
+Third instance of the repo's measured-dispatch pattern (quantum circuit impls
+-> ``quantum/autotune.py``; dense-vs-sparse routing ->
+``ops/dispatch_autotune.py``): the serving engine can compile each capacity
+tier either as the classic **bucket** program (pad to the static shape, slice
+back — pad rows are inert by row-independence) or as the **ragged** program
+(same static shape plus a TRACED valid-row count that masks pad rows inert by
+construction, one executable serving every fill level of the tier — PR 9's
+``n_valid`` pattern generalized from sparse dispatch to the whole forward).
+
+Per dispatch the two programs do identical FLOPs at identical shapes; the
+only cost ragged can ADD is the input mask, and the only way to know whether
+that mask is free on a given platform/shape is to time it — so the choice is
+raced at warmup per ``(platform, capacity, route, dtype)`` and cached in a
+table, never assumed. Where the mask measures free (every shape measured so
+far), ragged wins the race and brings continuous admission with it — the
+end-to-end p99/goodput win the committed ``results/serve_ragged/`` dryrun
+measures under MMPP/diurnal load. Where masking is NOT free, bucket wins and
+the engine keeps the coalescing batcher: the race is the guard that the
+ragged mode can only ever be adopted where it measures at least as fast.
+
+Contracts (identical to the routing dispatcher):
+
+- ``ensure_batching()`` is HOST-side and eager: serve warmup calls it per
+  capacity tier when ``serve.batching="auto"`` — never a traced function,
+  never the serve request path; its candidate jits land inside the warmup
+  compile window, so the zero-request-path-compile pin is intact in both
+  modes.
+- ``lookup()`` is read-only and cheap; any table pathology degrades to the
+  ``bucket`` incumbent, never raises.
+- Forced modes (``serve.batching="bucket"|"ragged"``) never race — the
+  committed dryrun drives both modes explicitly through exactly that path.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable
+
+from qdml_tpu.utils.tune_table import TableStore
+
+SCHEMA = 1
+DEFAULT_TABLE = os.path.join("results", "autotune", "serve_batching.json")
+ENV_TABLE = "QDML_SERVE_BATCHING_TABLE"
+
+_MODES = ("bucket", "ragged")
+
+# Table persistence/caching lives in the shared store (utils/tune_table.py,
+# the same machinery the routing dispatcher delegates to); the module-level
+# functions stay as this dispatcher's public API.
+_STORE = TableStore(DEFAULT_TABLE, ENV_TABLE, "serve_batching_table",
+                    "serve.batching_autotune")
+
+
+def set_table_path(path: str | None) -> None:
+    """Install (or clear) the process-wide batching-table location."""
+    _STORE.set_path(path)
+
+
+def table_path(path: str | None = None) -> str:
+    return _STORE.path(path)
+
+
+def table_key(
+    platform: str,
+    capacity: int,
+    route: str = "dense",
+    dtype: str = "float32",
+    checkify: bool = False,
+) -> str:
+    """Entry key. ``route`` (the tier's dense/sparse routing dispatch),
+    ``dtype`` (the model's activation dtype) and ``checkify`` are part of the
+    raced SHAPE, not metadata: the ragged mask rides a different program
+    under sparse dispatch (the valid-count already feeds capacity accounting
+    there), a bf16 forward is not the f32 one, and the checkified program
+    carries functionalized error plumbing the unchecked twin does not — a
+    winner raced on any one variant says nothing about the others, so each
+    gets its own entry (the engine races the checkified pair when
+    ``serve.checkify`` is on)."""
+    return f"{platform}/cap{capacity}/{route}/{dtype}" + ("/ck" if checkify else "")
+
+
+def load_table(path: str | None = None) -> dict:
+    """entries dict; {} on missing/corrupt/alien — a broken table degrades to
+    the bucket incumbent, never raises (same contract as the routing
+    dispatcher)."""
+    return _STORE.load(path)
+
+
+def table_status(path: str | None = None) -> str:
+    return _STORE.status(path)
+
+
+def save_table(entries: dict, path: str | None = None) -> str:
+    """Atomically persist the manifest-headed table; best-effort (serving
+    must survive a read-only results dir)."""
+    return _STORE.save(entries, path, schema=SCHEMA)
+
+
+def invalidate_cache() -> None:
+    _STORE.invalidate()
+
+
+def lookup(
+    capacity: int,
+    route: str = "dense",
+    dtype: str = "float32",
+    path: str | None = None,
+    checkify: bool = False,
+) -> str | None:
+    """The tuned batching mode for this shape, or ``None`` (caller falls back
+    to the bucket incumbent). Never raises, never benchmarks — safe
+    anywhere."""
+    try:
+        import jax
+
+        entries = load_table(path)
+        entry = entries.get(
+            table_key(jax.default_backend(), int(capacity), route, dtype, checkify)
+        )
+        if not isinstance(entry, dict):
+            return None
+        sel = entry.get("best_infer")
+        return sel if sel in _MODES else None
+    except Exception:  # lint: disable=broad-except(batching lookup must degrade to the bucket incumbent on ANY table pathology — tuning can speed serving up, never crash it)
+        return None
+
+
+def ensure_batching(
+    candidates: dict[str, tuple[Callable, tuple]],
+    capacity: int,
+    route: str = "dense",
+    dtype: str = "float32",
+    path: str | None = None,
+    force: bool = False,
+    budget_s: float = 0.2,
+    checkify: bool = False,
+) -> dict:
+    """Return this capacity tier's table entry, racing and persisting it
+    first if absent (or ``force``).
+
+    ``candidates`` maps ``"bucket"``/``"ragged"`` to ``(callable, args)`` at
+    the full-fill tier shape (the engine passes its two candidate forwards
+    with jit applied but untraced — a table hit compiles NOTHING). Timing is
+    :func:`qdml_tpu.ops.dispatch_autotune.measure` — median-of-reps wall ms,
+    so the three dispatcher races in this repo are comparable measurements.
+    """
+    import jax
+
+    platform = jax.default_backend()
+    key = table_key(platform, int(capacity), route, dtype, checkify)
+    entries = dict(load_table(path))
+    entry = entries.get(key)
+    if not force and isinstance(entry, dict) and entry.get("best_infer") in _MODES:
+        return entry
+    from qdml_tpu.ops.dispatch_autotune import measure
+
+    cands = measure(candidates, budget_s=budget_s)
+    timed = {
+        m: v["infer_ms"]
+        for m, v in cands.items()
+        if isinstance(v.get("infer_ms"), (int, float))
+    }
+    best = min(timed, key=timed.get) if timed else "bucket"
+    entry = {
+        "key": key,
+        "platform": platform,
+        "capacity": int(capacity),
+        "route": route,
+        "dtype": dtype,
+        "checkify": bool(checkify),
+        "candidates": cands,
+        "best_infer": best,
+        "ts": round(time.time(), 3),
+    }
+    entries[key] = entry
+    save_table(entries, path)
+    return entry

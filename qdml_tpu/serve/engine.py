@@ -41,11 +41,27 @@ between batches; in-flight batches keep the old committed arrays (XLA holds
 the buffers until their dispatches retire), so no request ever sees a torn
 checkpoint.
 
-Padding: batches pad with zeros up to the bucket size and outputs are sliced
-back to the real count. Every per-sample op in the pipeline (convs, eval-mode
-BatchNorm over running stats, dense heads, the routing gather) is
-row-independent, so padding rows cannot perturb real rows — the "mask" is the
-valid-count slice.
+Padding & batching modes: batches pad with zeros up to the tier's static
+shape and outputs are sliced back to the real count. HOW the tier's program
+treats the pad tail is the third measured-dispatch choice
+(``serve.batching``, ``serve/batching_autotune.py``):
+
+- **bucket** (the PR-2..10 incumbent): the plain program — pad rows are inert
+  because every per-sample op in the pipeline (convs, eval-mode BatchNorm
+  over running stats, dense heads, the routing gather) is row-independent;
+  the "mask" is the valid-count slice, and the batcher coalesces to bucket
+  edges (full batch or max_wait).
+- **ragged**: the program takes the valid-row count as a TRACED scalar and
+  masks the pad tail inert INSIDE the executable (garbage in pad rows
+  provably cannot reach valid outputs — pinned), so one AOT program serves
+  every fill level of its capacity tier, and the batcher switches to
+  continuous admission (dispatch whenever the engine is free, never sleep on
+  a non-empty queue). Goodput/padding-waste accounting rides every dispatch
+  as a :class:`~qdml_tpu.serve.types.DispatchInfo`.
+- **auto**: raced at warmup per (platform, capacity, route) exactly like the
+  routing and circuit-impl autotuners; the race's jits land inside the
+  warmup compile window, so the zero-request-path-compile pin holds in both
+  modes.
 """
 
 from __future__ import annotations
@@ -64,7 +80,9 @@ from qdml_tpu.models.cnn import SCP128
 from qdml_tpu.models.qsc import QSCP128
 from qdml_tpu.ops import dispatch_autotune
 from qdml_tpu.ops.routing import select_expert, sparse_dispatch
+from qdml_tpu.serve import batching_autotune
 from qdml_tpu.serve.batcher import pick_bucket, power_of_two_buckets
+from qdml_tpu.serve.types import DispatchInfo
 from qdml_tpu.telemetry import span
 from qdml_tpu.telemetry import cost as _cost
 from qdml_tpu.telemetry.spans import get_sink
@@ -178,6 +196,17 @@ class ServeEngine:
             )
         self.dispatch_mode: dict[str, str] = {}
         self.dispatch_race: dict[str, Any] = {}
+        # batch-admission/executable mode per capacity tier ("bucket" |
+        # "ragged") and the measured race entry behind each choice — warmup
+        # fills them exactly like dispatch_mode (serve.batching "auto" ->
+        # batching_autotune race; an explicit mode is forced into every tier,
+        # race skipped — the committed dryrun drives both forced modes)
+        if cfg.serve.batching not in ("auto", "bucket", "ragged"):
+            raise ValueError(
+                f"serve.batching must be auto|bucket|ragged, got {cfg.serve.batching!r}"
+            )
+        self.batching_mode: dict[str, str] = {}
+        self.batching_race: dict[str, Any] = {}
         # sparse-overflow accounting across worker threads (overflow rows are
         # served by the dense fallback, never dropped — the RATE is the
         # capacity_factor health signal serve_summary reports and the report
@@ -464,6 +493,88 @@ class ServeEngine:
         )
         return h, pred, conf, overflow
 
+    def _mask_padding(self, x: jnp.ndarray, n_valid: jnp.ndarray) -> jnp.ndarray:
+        """Zero the pad tail INSIDE the traced program: rows at or past the
+        traced ``n_valid`` become exact zeros before any compute, so garbage
+        in pad rows (NaN/Inf included) provably cannot reach valid outputs —
+        stronger than the bucket mode's row-independence argument, and what
+        lets one ragged executable serve every fill level of its tier."""
+        valid = jnp.arange(x.shape[0]) < n_valid
+        return jnp.where(
+            valid.reshape((x.shape[0],) + (1,) * (x.ndim - 1)), x, jnp.zeros_like(x)
+        )
+
+    def _forward_ragged(
+        self, hdce_vars: dict, clf_vars: dict, x: jnp.ndarray, n_valid: jnp.ndarray
+    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Ragged twin of :meth:`_forward`: identical pipeline at the tier's
+        static shape, pad tail masked inert from the traced valid count."""
+        return self._forward(hdce_vars, clf_vars, self._mask_padding(x, n_valid))
+
+    def _forward_sparse_ragged(
+        self, hdce_vars: dict, clf_vars: dict, x: jnp.ndarray, n_valid: jnp.ndarray
+    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Ragged twin of :meth:`_forward_sparse`: the valid count already
+        feeds capacity accounting there; ragged additionally masks the pad
+        INPUT rows so their garbage is inert before the classifier runs."""
+        return self._forward_sparse(
+            hdce_vars, clf_vars, self._mask_padding(x, n_valid), n_valid
+        )
+
+    def _tier_batching(self, b: int, route_mode: str) -> str:
+        """Resolve tier ``b``'s batching mode at warmup time: a forced
+        ``serve.batching`` wins outright; ``auto`` is the measured race
+        (``batching_autotune.ensure_batching`` — table-cached per (platform,
+        capacity, route), so repeat warmups read, not re-time). The race's
+        candidate jits land inside the warmup compile window, keeping the
+        zero-request-path-compile pin intact in both modes."""
+        mode = self.cfg.serve.batching
+        if mode != "auto":
+            self.batching_race[str(b)] = {"forced": mode}
+            return mode
+        hdce_live, clf_live = self.live_vars()
+        sparse = route_mode == "sparse"
+        base = self._forward_sparse if sparse else self._forward
+        ragged = self._forward_sparse_ragged if sparse else self._forward_ragged
+        if self._checkify:
+            # race the programs that actually deploy: with serve.checkify the
+            # tier executables are the CHECKIFIED forwards, and a winner
+            # timed on the unchecked twins could pick the loser of the real
+            # pair (the functionalized error plumbing is not mask-free)
+            from jax.experimental import checkify as _checkify
+
+            from qdml_tpu.telemetry.sanitizer import checks
+
+            base = _checkify.checkify(base, errors=checks())
+            ragged = _checkify.checkify(ragged, errors=checks())
+        # VARIED race inputs (not zeros): the candidates run the full forward
+        # through the LIVE classifier, so identical rows would collapse every
+        # prediction onto one expert and — on sparse tiers — time the
+        # overflow-fallback branch instead of the steady state (the PR-9
+        # degenerate-argmax lesson). Both candidates still consume the SAME
+        # rows, so whatever the classifier routes, they execute the same
+        # branch and the race's DELTA stays the mask cost it exists to
+        # measure; varied rows keep the absolute path realistic too.
+        x = (
+            np.random.default_rng(0)
+            .standard_normal((b, *self.cfg.image_hw, 2))
+            .astype(np.float32)
+        )
+        args_b: tuple = (hdce_live, clf_live, x) + ((np.int32(b),) if sparse else ())
+        args_r: tuple = (hdce_live, clf_live, x, np.int32(b))
+        entry = batching_autotune.ensure_batching(
+            {"bucket": (jax.jit(base), args_b), "ragged": (jax.jit(ragged), args_r)},
+            capacity=b,
+            route=route_mode,
+            # program-variant dimensions of the raced shape: a winner timed
+            # on the f32 unchecked pair must not decide for a bf16 or
+            # checkified deployment (each variant gets its own table entry)
+            dtype=self.cfg.model.dtype,
+            checkify=self._checkify,
+        )
+        self.batching_race[str(b)] = entry
+        return entry.get("best_infer") or "bucket"
+
     def _bucket_dispatch(self, b: int) -> str:
         """Resolve bucket ``b``'s routing dispatch at warmup time: a forced
         ``serve.dispatch`` wins outright; ``auto`` is the measured race
@@ -555,13 +666,29 @@ class ServeEngine:
                         rec_impl["autotuned"] = True
                         rec_impl["candidates"] = entry["candidates"]
                     self.quantum_impl[str(b)] = rec_impl
-                # the routing dispatch is decided here — measured (auto) or
-                # forced — and BAKED into the bucket's executable exactly
-                # like the sharding and the autotuned circuit impl; the
-                # race's own jits land inside the warmup compile window
+                # the routing dispatch AND the batching mode are decided here
+                # — measured (auto) or forced — and BAKED into the bucket's
+                # executable exactly like the sharding and the autotuned
+                # circuit impl; both races' own jits land inside the warmup
+                # compile window
                 mode = self._bucket_dispatch(b)
                 self.dispatch_mode[str(b)] = mode
-                base_fwd = self._forward_sparse if mode == "sparse" else self._forward
+                bmode = self._tier_batching(b, mode)
+                self.batching_mode[str(b)] = bmode
+                if bmode == "ragged":
+                    base_fwd = (
+                        self._forward_sparse_ragged
+                        if mode == "sparse"
+                        else self._forward_ragged
+                    )
+                else:
+                    base_fwd = (
+                        self._forward_sparse if mode == "sparse" else self._forward
+                    )
+                # both the sparse route and the ragged batching thread the
+                # valid-row count through as a traced scalar, so one
+                # executable serves every fill level of the bucket/tier
+                takes_valid = mode == "sparse" or bmode == "ragged"
                 fwd = (
                     _checkify.checkify(base_fwd, errors=checks())
                     if self._checkify
@@ -570,9 +697,7 @@ class ServeEngine:
                 x_spec = jax.ShapeDtypeStruct((b, *hw, 2), jnp.float32)
                 specs: list[Any] = [*var_specs, x_spec]
                 args: list[Any] = [hdce_live, clf_live, np.zeros((b, *hw, 2), np.float32)]
-                if mode == "sparse":
-                    # the valid-row count rides as a traced scalar, so one
-                    # executable serves every fill level of the bucket
+                if takes_valid:
                     specs.append(jax.ShapeDtypeStruct((), jnp.int32))
                     args.append(np.int32(b))
                 jit_kwargs: dict[str, Any] = {}
@@ -583,7 +708,7 @@ class ServeEngine:
                     # params per the placement trees — one SPMD program per
                     # bucket, collectives on ICI, nothing decided per request
                     shardings: tuple = (*self._var_shardings, x_sh)
-                    if mode == "sparse":
+                    if takes_valid:
                         shardings = (*shardings, NamedSharding(self.mesh, P()))
                     jit_kwargs["in_shardings"] = shardings
                     self.bucket_sharding[str(b)] = (
@@ -621,6 +746,11 @@ class ServeEngine:
                 "capacity_factor": float(self.cfg.serve.capacity_factor),
                 "race": self.dispatch_race,
             },
+            "batching": {
+                "mode": dict(self.batching_mode),
+                "continuous_admission": self.continuous_admission,
+                "race": self.batching_race,
+            },
         }
         if self.mesh is not None:
             out["mesh"] = self.mesh_topology()
@@ -628,6 +758,27 @@ class ServeEngine:
         if self.quantum_impl:
             out["quantum_impl"] = self.quantum_impl
         return out
+
+    @property
+    def continuous_admission(self) -> bool:
+        """True when the engine's batching mode calls for continuous
+        admission (the largest tier — the capacity production fills live in —
+        resolved to ragged at warmup). ServeLoop/ReplicaPool sync their
+        self-created batcher's admission policy from this after warmup."""
+        return self.batching_mode.get(str(self.buckets[-1])) == "ragged"
+
+    def batching_summary(self) -> dict:
+        """The serve_summary/fleet ``batching`` block: per-capacity-tier
+        batching modes (collapsed to one word when uniform) and whether the
+        batcher admits continuously — how a fleet reader tells a ragged
+        deployment from a bucket one per tier."""
+        modes = set(self.batching_mode.values())
+        mode = modes.pop() if len(modes) == 1 else ("mixed" if modes else "bucket")
+        return {
+            "mode": mode,
+            "per_tier": dict(self.batching_mode),
+            "continuous_admission": self.continuous_admission,
+        }
 
     def dispatch_summary(self) -> dict:
         """The serve_summary ``dispatch`` block: per-bucket routing modes
@@ -660,16 +811,22 @@ class ServeEngine:
 
     def infer(
         self, x: np.ndarray
-    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
-        """Serve one coalesced batch: pad to its bucket, run the pre-compiled
-        executable, slice back. ``x``: (n, n_sub, n_beam, 2). Returns
-        ``(h (n, 2*h_dim), pred (n,), conf (n,), bucket)`` — ``conf`` is the
-        routed class's probability, the per-request confidence stat the
-        serve metrics histogram and the drift detectors consume.
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, DispatchInfo]:
+        """Serve one coalesced batch: pad to its bucket/capacity tier, run
+        the pre-compiled executable (ragged tiers additionally thread the
+        valid count as a traced scalar), slice back. ``x``: (n, n_sub,
+        n_beam, 2). Returns ``(h (n, 2*h_dim), pred (n,), conf (n,), info)``
+        — ``conf`` is the routed class's probability, the per-request
+        confidence stat the serve metrics histogram and the drift detectors
+        consume; ``info`` is the :class:`~qdml_tpu.serve.types.DispatchInfo`
+        the goodput/padding-waste accounting consumes.
 
         Oversized batches (n > largest bucket — only reachable by direct
         callers; the micro-batcher caps at ``max_batch``) fall back to
-        largest-bucket chunks rather than compiling a fresh shape.
+        largest-bucket chunks rather than compiling a fresh shape; ``info``
+        sums the STATIC rows of every chunk (the final chunk picks its own
+        smallest-fitting tier), so chunked fill/pad stats stay honest
+        instead of reporting n/largest_bucket fills past 1.0.
         """
         if not self._warm:
             raise RuntimeError("ServeEngine.infer before warmup() — request path would compile")
@@ -678,26 +835,39 @@ class ServeEngine:
             raise ValueError("empty batch")
         largest = self.buckets[-1]
         if n > largest:
-            hs, preds, confs = [], [], []
+            hs, preds, confs, infos = [], [], [], []
             for lo in range(0, n, largest):
-                h, p, c, _ = self.infer(x[lo : lo + largest])
+                h, p, c, sub = self.infer(x[lo : lo + largest])
                 hs.append(h)
                 preds.append(p)
                 confs.append(c)
+                infos.append(sub)
+            modes = {i.mode for i in infos}
             return (
                 np.concatenate(hs),
                 np.concatenate(preds),
                 np.concatenate(confs),
-                largest,
+                # the aggregate labels the LARGEST tier dispatched (the final
+                # chunk may have dropped to a smaller one) and collapses the
+                # per-chunk batching modes honestly — with batching=auto,
+                # tiers can resolve to different race winners
+                DispatchInfo(
+                    bucket=max(i.bucket for i in infos),
+                    n=n,
+                    rows=sum(i.rows for i in infos),
+                    chunks=sum(i.chunks for i in infos),
+                    mode=modes.pop() if len(modes) == 1 else "mixed",
+                ),
             )
-        b = pick_bucket(n, self.buckets)
+        b = pick_bucket(n, self.buckets)  # lint: disable=pad-to-bucket-in-serve(THE sanctioned pad site: every request batch reaches XLA through this one tier pick + pad, where DispatchInfo accounts the waste)
         xp = np.zeros((b, *x.shape[1:]), np.float32)
         xp[:n] = x
         # one atomic read of the live checkpoint per batch: a swap that lands
         # mid-batch applies to the NEXT dequeue, never tears this one
         hdce_live, clf_live = self.live_vars()
         mode = self.dispatch_mode.get(str(b), "dense")
-        if mode == "sparse":
+        bmode = self.batching_mode.get(str(b), "bucket")
+        if mode == "sparse" or bmode == "ragged":
             out = self._compiled[b](hdce_live, clf_live, xp, np.int32(n))
         else:
             out = self._compiled[b](hdce_live, clf_live, xp)
@@ -736,5 +906,5 @@ class ServeEngine:
             np.asarray(jax.device_get(h))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
             np.asarray(jax.device_get(pred))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
             np.asarray(jax.device_get(conf))[:n],  # lint: disable=host-sync-hot-path(per-request confidence fetched with the reply it annotates — same dispatch, no extra stall)
-            b,
+            DispatchInfo(bucket=b, n=n, rows=b, chunks=1, mode=bmode),
         )

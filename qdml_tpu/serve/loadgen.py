@@ -26,6 +26,10 @@ Each run reports the acceptance numbers for the serving engine:
   plus served-vs-offline NMSE in dB;
 - tail latency + SLO — p50/p95/p99 per-request latency, throughput, batch
   fill, and (when deadlines are offered) the SLO-attainment fraction;
+- goodput — useful-rows/s (``goodput_rps``) and the padding-waste fraction
+  (dispatched rows XLA computed for nothing), identical columns in bucket
+  and ragged batching modes so the committed bucket-vs-ragged dryrun
+  (``results/serve_ragged/``) compares apples to apples;
 - fleet — replica count, total workers, mesh topology and per-bucket batch
   sharding, so ``qdml-tpu report`` can gate fleet-level rps / p99 / SLO.
 
@@ -380,6 +384,10 @@ def run_loadgen(
             else:
                 metrics_all.observe_shed(r, had_deadline=deadline_ms is not None)
         metrics_all.completed = len(done)
+        # goodput is exact from results alone (observe_prediction counted the
+        # useful rows); the executable-side row ledger is not — rows_dispatched
+        # stays 0, so padding_waste reports None, never a fabricated perfect
+        # fill
     else:
         # aggregate across every replica's every worker (== the single loop's
         # metrics when replicas=workers=1); any one collector alone would
@@ -412,6 +420,11 @@ def run_loadgen(
         # traffic skew is a silent O(S) compute leak)
         n_scenarios=cfg.data.n_scenarios,
         dispatch=engine.dispatch_summary(),
+        # batching facts for the report gate and the bucket-vs-ragged dryrun:
+        # which mode each capacity tier serves (measured or forced) and
+        # whether the feed admitted continuously — serve_summary.fleet's
+        # batching_mode per tier
+        batching=engine.batching_summary(),
         bucket_sharding=engine.bucket_sharding or None,
         warmup=warm,
         server_metrics=live_slim,

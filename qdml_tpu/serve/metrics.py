@@ -20,20 +20,36 @@ from __future__ import annotations
 
 import time
 
-from qdml_tpu.serve.types import Overloaded, Prediction
+from qdml_tpu.serve.types import DispatchInfo, Overloaded, Prediction
 from qdml_tpu.telemetry import Histogram
 from qdml_tpu.telemetry.spans import get_sink
 
 
 class ServeMetrics:
-    """Latency/fill/depth collector for one serving window."""
+    """Latency/fill/depth/goodput collector for one serving window."""
 
     def __init__(self, sink=None, log_requests: bool = True):
         self._sink = sink
         self.log_requests = log_requests
         self.latency = Histogram()       # per-request enqueue -> result
-        self.batch_fill = Histogram()    # n / bucket per served batch (0..1)
+        self.batch_fill = Histogram()    # valid/static rows per dispatch (0..1)
         self.queue_depth = Histogram()   # depth at dequeue (stored as "seconds")
+        # Goodput-first row accounting. Three row ledgers, three meanings:
+        # - rows_useful: rows the client could USE — completed within their
+        #   deadline, or completed with no deadline offered (the serving
+        #   literature's goodput numerator: a row delivered after its SLO is
+        #   throughput, not goodput); fed per prediction.
+        # - rows_valid: real (non-padding) rows dispatched (DispatchInfo.n).
+        # - rows_dispatched: what XLA actually computed (static bucket/tier
+        #   shapes, every chunk counted) — the gap to rows_valid is padding
+        #   waste, the number the ragged batching mode exists to account for
+        #   and the report gate watches.
+        # Kept as raw sums so windowed pollers can difference snapshots
+        # exactly, like the confidence sums.
+        self.rows_useful = 0
+        self.rows_valid = 0
+        self.rows_dispatched = 0
+        self.dispatches = 0              # executable launches (chunks included)
         # classifier-confidence histogram (routed-class probability per
         # prediction; raw samples, so Histogram.merge aggregates exactly) +
         # per-scenario prediction counts and confidence SUMS. The sums exist
@@ -58,10 +74,20 @@ class ServeMetrics:
     def _target(self):
         return self._sink if self._sink is not None else get_sink()
 
-    def observe_batch(self, preds: list[Prediction], bucket: int, depth: int, dur_s: float) -> None:
+    def observe_batch(
+        self, preds: list[Prediction], info: DispatchInfo, depth: int, dur_s: float
+    ) -> None:
+        """One engine dispatch's worth of results. ``info`` is the engine's
+        :class:`DispatchInfo`: its static-row total keeps fill/pad accounting
+        honest even for oversize batches served in chunks (``n / rows`` is
+        never > 1 — the pre-ragged accounting divided by the last chunk's
+        bucket alone and inflated chunked fills past 1.0)."""
         self.batches += 1
         self.completed += len(preds)
-        self.batch_fill.add(len(preds) / bucket)
+        self.rows_valid += info.n
+        self.rows_dispatched += info.rows
+        self.dispatches += info.chunks
+        self.batch_fill.add(info.fill)
         self.queue_depth.add(float(depth))
         target = self._target()
         active = target is not None and getattr(target, "active", False)
@@ -73,7 +99,9 @@ class ServeMetrics:
                 depth=1,
                 dur_s=round(dur_s, 6),
                 n=len(preds),
-                bucket=bucket,
+                bucket=info.bucket,
+                rows=info.rows,
+                batching=info.mode,
                 queue_depth=depth,
             )
         for p in preds:
@@ -86,7 +114,7 @@ class ServeMetrics:
                     depth=2,
                     dur_s=round(p.latency_s, 6),
                     rid=p.rid,
-                    bucket=bucket,
+                    bucket=info.bucket,
                 )
 
     def observe_prediction(self, p: Prediction) -> None:
@@ -94,6 +122,9 @@ class ServeMetrics:
         windowed loadgen summaries (which replay results into a fresh
         collector): latency, SLO, per-scenario counts and confidence."""
         self.latency.add(p.latency_s)
+        # goodput numerator: a late completion is throughput, not goodput
+        if p.deadline_met is not False:
+            self.rows_useful += 1
         if p.deadline_met is not None:
             self.slo_total += 1
             self.slo_met += int(p.deadline_met)
@@ -123,6 +154,10 @@ class ServeMetrics:
         self.confidence.merge(other.confidence)
         self.batches += other.batches
         self.completed += other.completed
+        self.rows_useful += other.rows_useful
+        self.rows_valid += other.rows_valid
+        self.rows_dispatched += other.rows_dispatched
+        self.dispatches += other.dispatches
         for k, v in other.shed.items():
             self.shed[k] = self.shed.get(k, 0) + v
         for k, v in other.scenario_counts.items():
@@ -145,6 +180,31 @@ class ServeMetrics:
             "n": self.slo_total,
             "met": self.slo_met,
             "attainment": round(self.slo_met / self.slo_total, 4),
+        }
+
+    def padding_waste(self) -> float | None:
+        """Fraction of dispatched rows that were padding (``1 -
+        valid/dispatched``), or ``None`` before any dispatch was OBSERVED
+        (a window rebuilt from results alone — the loadgen external-pool
+        replay — has no executable-side row counts, and a fabricated 0.0
+        would read as perfect fill that was never measured)."""
+        if self.rows_dispatched == 0:
+            return None
+        return round(1.0 - self.rows_valid / self.rows_dispatched, 4)
+
+    def rows(self) -> dict | None:
+        """The raw row ledger behind goodput/padding-waste (``None`` before
+        any observed dispatch): useful vs valid vs dispatched rows and
+        executable launches — snapshot-differencable, like the confidence
+        sums."""
+        if self.rows_dispatched == 0:
+            return None
+        return {
+            "useful": self.rows_useful,
+            "valid": self.rows_valid,
+            "dispatched": self.rows_dispatched,
+            "padded": self.rows_dispatched - self.rows_valid,
+            "dispatches": self.dispatches,
         }
 
     def per_scenario(self) -> dict | None:
@@ -184,6 +244,7 @@ class ServeMetrics:
         accumulating (the final summary sees the whole run)."""
         target = self._target()
         if target is not None and getattr(target, "active", False):
+            elapsed = time.perf_counter() - self._t0
             target.emit(
                 "counters",
                 name="serve",
@@ -192,6 +253,11 @@ class ServeMetrics:
                 queue_depth=self._scaled(self.queue_depth),
                 batches=self.batches,
                 completed=self.completed,
+                goodput_rps=(
+                    round(self.rows_useful / elapsed, 2) if elapsed > 0 else None
+                ),
+                padding_waste=self.padding_waste(),
+                rows=self.rows(),
                 shed=dict(self.shed),
                 slo=self.slo(),
                 confidence=self._scaled(self.confidence),
@@ -219,6 +285,17 @@ class ServeMetrics:
             "batches": self.batches,
             "shed": dict(self.shed),
             "rps": round(self.completed / elapsed, 2) if elapsed > 0 else None,
+            # goodput = USEFUL rows/s: completed within deadline (or with no
+            # deadline offered — a request is one row here), so sheds, LATE
+            # completions and the window's drain all cost goodput while mere
+            # rows/s hides them; padding waste is the dispatched-row fraction
+            # XLA computed for nothing — the pair the report gates,
+            # docs/SERVING.md "Ragged continuous batching"
+            "goodput_rps": (
+                round(self.rows_useful / elapsed, 2) if elapsed > 0 else None
+            ),
+            "padding_waste": self.padding_waste(),
+            "rows": self.rows(),
             "slo": self.slo(),
             "latency_ms": self.latency.summary(),
             "batch_fill": self._scaled(self.batch_fill),

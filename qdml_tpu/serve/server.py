@@ -101,10 +101,17 @@ class ServeLoop:
         serve_cfg = engine.cfg.serve
         self.engine = engine
         self.name = name
+        # remember whether the batcher is loop-owned: start() syncs an owned
+        # batcher's admission policy (coalesce vs continuous) from the warmed
+        # engine's measured batching mode; an injected batcher is the
+        # caller's to configure (the replica pool injects its shared one and
+        # syncs it itself; fake-clock tests pin the policy they test)
+        self._own_batcher = batcher is None
         self.batcher = batcher or MicroBatcher(
             max_batch=serve_cfg.max_batch,
             max_wait_s=serve_cfg.max_wait_ms / 1e3,
             max_queue=serve_cfg.max_queue,
+            continuous=engine.continuous_admission,
         )
         self.metrics = metrics or ServeMetrics()
         self.workers = max(1, int(workers if workers is not None else serve_cfg.workers))
@@ -177,6 +184,11 @@ class ServeLoop:
     def start(self) -> "ServeLoop":
         if not self.engine._compiled:
             self.engine.warmup()
+        if self._own_batcher:
+            # the "auto" batching race resolves at warmup, after the batcher
+            # exists: sync the admission policy to the measured mode (ragged
+            # -> continuous dispatch, bucket -> coalesce to bucket edges)
+            self.batcher.continuous = self.engine.continuous_admission
         self._stop.clear()
         self._threads = [
             threading.Thread(
@@ -239,6 +251,7 @@ class ServeLoop:
             buckets=list(self.engine.buckets),
             swap_epoch=self.engine.swap_epoch,
             dispatch=self.engine.dispatch_summary(),
+            batching=self.engine.batching_summary(),
         )
 
     def _serve_one(self, metrics: ServeMetrics | None = None) -> bool:
@@ -261,7 +274,7 @@ class ServeLoop:
             # stack INSIDE the guard: a shape-mismatched request failing the
             # stack must strand nobody, exactly like an engine failure
             x = np.stack([r.x for r in batch])
-            h, pred, conf, bucket = self.engine.infer(x)
+            h, pred, conf, info = self.engine.infer(x)
         except BaseException as e:
             # a dying batch must not strand its clients: forward the failure
             # into every future, then let the loop's finally drain the rest
@@ -278,7 +291,7 @@ class ServeLoop:
                 h=h[i],
                 scenario=int(pred[i]),
                 latency_s=now - r.enqueue_ts,
-                bucket=bucket,
+                bucket=info.bucket,
                 batch_n=len(batch),
                 deadline_met=None if r.deadline is None else now <= r.deadline,
                 confidence=float(conf[i]),
@@ -286,7 +299,7 @@ class ServeLoop:
             preds.append(p)
         # metrics before resolution: a client awaiting the future must be able
         # to read a consistent histogram the moment its result arrives
-        metrics.observe_batch(preds, bucket, depth, dur)
+        metrics.observe_batch(preds, info, depth, dur)
         for r, p in zip(batch, preds):
             if r.future is not None:
                 r.future.set_result(p)
@@ -361,10 +374,12 @@ class ReplicaPool:
         n_replicas = max(
             1, int(replicas if replicas is not None else serve_cfg.replicas)
         )
+        self._own_batcher = batcher is None
         self.batcher = batcher or MicroBatcher(
             max_batch=serve_cfg.max_batch,
             max_wait_s=serve_cfg.max_wait_ms / 1e3,
             max_queue=serve_cfg.max_queue,
+            continuous=engine.continuous_admission,
         )
         self._exit = ExitCoordinator()
         self._sink = sink
@@ -412,6 +427,10 @@ class ReplicaPool:
     def start(self) -> "ReplicaPool":
         if not self.engine._compiled:
             self.engine.warmup()  # ONE warmup, shared by every replica
+        if self._own_batcher:
+            # post-warmup sync, same as ServeLoop: the measured batching mode
+            # decides whether the SHARED feed coalesces or admits continuously
+            self.batcher.continuous = self.engine.continuous_admission
         for r in self.replicas:
             r.start()
         self._started = True
@@ -513,6 +532,7 @@ class ReplicaPool:
             buckets=list(self.engine.buckets),
             swap_epoch=self.engine.swap_epoch,
             dispatch=self.engine.dispatch_summary(),
+            batching=self.engine.batching_summary(),
         )
 
 
@@ -674,6 +694,7 @@ def run_server(
             {
                 "serving": f"{cfg.serve.host}:{cfg.serve.port}",
                 "buckets": list(engine.buckets),
+                "batching": engine.batching_summary(),
                 "replicas": pool.n_replicas,
                 "workers": pool.workers,
                 "mesh": engine.mesh_topology(),

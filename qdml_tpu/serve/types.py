@@ -35,6 +35,32 @@ class Request:
 
 
 @dataclass
+class DispatchInfo:
+    """Accounting for one :meth:`ServeEngine.infer` call — what was actually
+    dispatched to XLA, not just what the caller asked for. ``rows`` is the
+    total STATIC rows across every executable launch the call made (one per
+    chunk for oversize batches), so ``n / rows`` is the honest fill and
+    ``rows - n`` the honest pad waste even when an oversize batch is served
+    in largest-bucket chunks whose final chunk is near-empty (the PR-2..10
+    accounting recorded ``n / largest_bucket`` there, inflating fill past
+    1.0). ``ServeMetrics.observe_batch`` consumes this record directly."""
+
+    bucket: int          # static batch shape dispatched (largest tier, if chunked)
+    n: int               # valid (real) rows served
+    rows: int            # total static rows dispatched across all chunks
+    chunks: int = 1      # executable launches this call made
+    mode: str = "bucket"  # tier batching mode ("bucket"|"ragged"; "mixed" across chunks)
+
+    @property
+    def fill(self) -> float:
+        return self.n / self.rows if self.rows else 0.0
+
+    @property
+    def padded(self) -> int:
+        return self.rows - self.n
+
+
+@dataclass
 class Prediction:
     """Successful result: routed channel estimate + predicted scenario."""
 

@@ -107,6 +107,9 @@ def _serving_from(obj: dict) -> dict | None:
         "n_scenarios": None,
         "dispatch": None,
         "overflow_rate": None,
+        "goodput_rps": None,
+        "padding_waste": None,
+        "batching": None,
     }
     lat = obj.get("latency_ms") or {}
     for key in ("p50_ms", "p95_ms", "p99_ms"):
@@ -114,6 +117,20 @@ def _serving_from(obj: dict) -> dict | None:
             out["latency"][key] = float(lat[key])
     if isinstance(obj.get("rps"), (int, float)):
         out["rps"] = float(obj["rps"])
+    # goodput-first serving metrics (ragged-batching PR): useful-rows/s gates
+    # like rps (lower = regression); padding waste — the dispatched-row
+    # fraction XLA computed for nothing — gates absolutely like the sparse
+    # overflow rate (near-zero baselines make ratios meaningless)
+    if isinstance(obj.get("goodput_rps"), (int, float)):
+        out["goodput_rps"] = float(obj["goodput_rps"])
+    if isinstance(obj.get("padding_waste"), (int, float)):
+        out["padding_waste"] = float(obj["padding_waste"])
+    batching = obj.get("batching")
+    if isinstance(batching, dict):
+        out["batching"] = {
+            "mode": batching.get("mode"),
+            "continuous_admission": batching.get("continuous_admission"),
+        }
     slo = obj.get("slo")
     if isinstance(slo, dict) and isinstance(slo.get("attainment"), (int, float)):
         out["slo_attainment"] = float(slo["attainment"])
@@ -184,6 +201,10 @@ def extract(path: str) -> dict:
                 # completed-request throughput rides the existing gate
                 # (lower = regression, same as samples/sec)
                 src["throughput"]["serve.rps"] = serving["rps"]
+            if serving["goodput_rps"] is not None:
+                # goodput (useful-rows/s) rides the same gate: padded rows
+                # never count, so a mode that pads more cannot inflate it
+                src["throughput"]["serve.goodput_rps"] = serving["goodput_rps"]
             if serving["platform"] and not src["platform"]:
                 # serving-only artifacts carry their backend too, so the
                 # platform-mismatch disarm covers latency gates (a bench
@@ -311,6 +332,13 @@ PROGRAM_CHANGE_PCT = 1.0
 # rates, not ratios — 2 points of new overflow is a capacity-factor misfit
 # worth failing on, whatever the baseline was.
 OVERFLOW_RATE_SLACK = 0.02
+
+# Absolute slack on the serving padding-waste fraction (padded rows /
+# dispatched rows), gated like the overflow rate and for the same reason: a
+# well-tiered deployment sits near 0 where ratios explode. 5 points of new
+# padding is a tier ladder (or admission policy) that no longer fits the
+# traffic's fill distribution — FLOPs burned on rows nobody asked for.
+PADDING_WASTE_SLACK = 0.05
 
 
 def _lint_gate(lint_path: str | None) -> dict | None:
@@ -604,6 +632,15 @@ def build_report_data(
                 s += f" {disp['mode']}-dispatch"
                 if serving.get("overflow_rate") is not None:
                     s += f" (overflow {serving['overflow_rate']:.2%})"
+            # batching mode rides the fleet line too: a p99/goodput delta
+            # between a bucket fleet and a ragged one is a MODE change, and
+            # the reader must see it named (the bucket-vs-ragged dryrun's
+            # whole comparison hangs on this label)
+            bat = serving.get("batching")
+            if bat and bat.get("mode"):
+                s += f" {bat['mode']}-batching"
+                if serving.get("padding_waste") is not None:
+                    s += f" (pad waste {serving['padding_waste']:.2%})"
             return s
 
         base_fleet = _fleet_str(base)
@@ -714,52 +751,60 @@ def build_report_data(
                 + f"{status_md}"
             )
 
-    # Sparse-dispatch overflow gate: the fraction of routed rows the
-    # capacity buckets could NOT hold (served by the dense fallback — never
-    # dropped, but each one is O(S) compute for O(1) work). An ABSOLUTE
-    # comparison, not a ratio: healthy baselines sit at/near 0.0 where a
-    # relative delta is undefined or explosive. Regression when the current
-    # rate exceeds the baseline by more than OVERFLOW_RATE_SLACK — the
-    # capacity factor no longer fits the traffic skew.
-    b_ovf = (base.get("serving") or {}).get("overflow_rate")
-    c_ovf = None
-    for c_src in curs:
-        v = (c_src.get("serving") or {}).get("overflow_rate")
-        if v is not None:
-            c_ovf = v
-    if b_ovf is not None or c_ovf is not None:
-        if b_ovf is None or c_ovf is None:
-            only = "current-only" if b_ovf is None else "baseline-only"
+    # Absolute-slack serving gates (one shared shape, two metrics): both
+    # compare ABSOLUTELY, not as ratios — healthy baselines sit at/near 0.0
+    # where a relative delta is undefined or explosive. Regression when the
+    # current fraction exceeds the baseline by more than the metric's slack.
+    def _absolute_gate(field: str, metric: str, kind: str, slack: float,
+                       label: str) -> None:
+        b_val = (base.get("serving") or {}).get(field)
+        c_val = None
+        for c_src in curs:
+            v = (c_src.get("serving") or {}).get(field)
+            if v is not None:
+                c_val = v
+        if b_val is None and c_val is None:
+            return
+        if b_val is None or c_val is None:
+            only = "current-only" if b_val is None else "baseline-only"
             gates.append(
-                {"metric": "serve.overflow_rate", "kind": "dispatch",
-                 "baseline": b_ovf, "current": c_ovf, "delta_pct": None,
-                 "status": only}
+                {"metric": metric, "kind": kind, "baseline": b_val,
+                 "current": c_val, "delta_pct": None, "status": only}
             )
             lines.append(
-                f"- sparse-dispatch overflow rate: "
-                f"{'—' if b_ovf is None else f'{b_ovf:g}'} -> "
-                f"{'—' if c_ovf is None else f'{c_ovf:g}'} ({only})"
+                f"- {label}: {'—' if b_val is None else f'{b_val:g}'} -> "
+                f"{'—' if c_val is None else f'{c_val:g}'} ({only})"
             )
+            return
+        if c_val > b_val + slack:
+            status_key, status_md = "regression", "**REGRESSION**"
+            regressions.append(
+                {"metric": metric, "baseline": b_val, "current": c_val,
+                 "delta_pct": None}
+            )
+        elif c_val < b_val - slack:
+            status_key = status_md = "improved"
         else:
-            if c_ovf > b_ovf + OVERFLOW_RATE_SLACK:
-                status_key, status_md = "regression", "**REGRESSION**"
-                regressions.append(
-                    {"metric": "serve.overflow_rate", "baseline": b_ovf,
-                     "current": c_ovf, "delta_pct": None}
-                )
-            elif c_ovf < b_ovf - OVERFLOW_RATE_SLACK:
-                status_key = status_md = "improved"
-            else:
-                status_key = status_md = "ok"
-            gates.append(
-                {"metric": "serve.overflow_rate", "kind": "dispatch",
-                 "baseline": b_ovf, "current": c_ovf, "delta_pct": None,
-                 "status": status_key}
-            )
-            lines.append(
-                f"- sparse-dispatch overflow rate: {b_ovf:g} -> {c_ovf:g} "
-                f"{status_md}"
-            )
+            status_key = status_md = "ok"
+        gates.append(
+            {"metric": metric, "kind": kind, "baseline": b_val,
+             "current": c_val, "delta_pct": None, "status": status_key}
+        )
+        lines.append(f"- {label}: {b_val:g} -> {c_val:g} {status_md}")
+
+    # Sparse-dispatch overflow: the fraction of routed rows the capacity
+    # buckets could NOT hold (served by the dense fallback — never dropped,
+    # but each one is O(S) compute for O(1) work); rising = the capacity
+    # factor no longer fits the traffic skew.
+    _absolute_gate("overflow_rate", "serve.overflow_rate", "dispatch",
+                   OVERFLOW_RATE_SLACK, "sparse-dispatch overflow rate")
+    # Serving padding waste: the fraction of dispatched rows that were
+    # padding (serve_summary.padding_waste — goodput's complement); rising =
+    # the tier ladder (or admission policy) no longer fits the traffic's
+    # fill levels — compute the goodput gate cannot see while rps still
+    # looks healthy.
+    _absolute_gate("padding_waste", "serve.padding_waste", "batching",
+                   PADDING_WASTE_SLACK, "serving padding waste")
 
     # Roofline section: achieved-vs-roofline fraction per train sub-bench
     # (bench.py details.*.roofline.fraction — telemetry/cost.py). The sign is

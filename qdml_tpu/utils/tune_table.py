@@ -1,0 +1,109 @@
+"""Shared persistence for measured-dispatch autotune tables.
+
+The repo now carries three dispatcher races (circuit impls, dense-vs-sparse
+routing, bucket-vs-ragged batching); the two newer ones
+(:mod:`qdml_tpu.ops.dispatch_autotune`,
+:mod:`qdml_tpu.serve.batching_autotune`) share this one table store instead
+of each re-implementing the load/atomic-save/status/cache machinery — a fix
+to the shared contract (status taxonomy, manifest header, atomic replace)
+lands once. :mod:`qdml_tpu.quantum.autotune` predates the store and still
+carries its original copy (its tests reach into the module-level cache);
+migrate it onto the store the next time that subsystem is touched — the
+routing dispatcher's delegation is the template.
+
+Contract (inherited from the quantum dispatcher and unchanged):
+
+- loads NEVER raise: any pathology degrades to ``{}`` entries with a status
+  in ``ok|missing|corrupt|alien|unreadable`` — tuning can speed a hot path
+  up, never crash it;
+- saves are atomic (tmp + ``os.replace``) and best-effort: serving must
+  survive a read-only results directory;
+- an in-process cache keyed on the absolute path makes repeat lookups free;
+  ``invalidate()`` clears it (tests point the store at tmp tables).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class TableStore:
+    """One autotune table's path resolution, cache, load and atomic save."""
+
+    def __init__(self, default_path: str, env_var: str, kind: str, argv_tag: str):
+        self.default_path = default_path
+        self.env_var = env_var
+        self.kind = kind          # payload "kind" stamped into saved tables
+        self.argv_tag = argv_tag  # manifest argv label for provenance
+        self._cache: dict[str, dict] = {}
+        self._status: dict[str, str] = {}
+        self._active: str | None = None
+
+    def set_path(self, path: str | None) -> None:
+        """Install (or clear) the process-wide table location."""
+        self._active = os.path.abspath(path) if path else None
+
+    def path(self, path: str | None = None) -> str:
+        return os.path.abspath(
+            path or self._active or os.environ.get(self.env_var) or self.default_path
+        )
+
+    def load(self, path: str | None = None) -> dict:
+        """entries dict; {} on missing/corrupt/alien — never raises."""
+        p = self.path(path)
+        if p in self._cache:
+            return self._cache[p]
+        entries: dict = {}
+        status = "ok"
+        try:
+            with open(p) as fh:
+                data = json.load(fh)
+            if isinstance(data, dict) and isinstance(data.get("entries"), dict):
+                entries = data["entries"]
+            else:
+                status = "alien"
+        except FileNotFoundError:
+            status = "missing"
+        except json.JSONDecodeError:
+            status = "corrupt"
+        except OSError:
+            status = "unreadable"
+        except (ValueError, TypeError):
+            status = "corrupt"
+        self._cache[p] = entries
+        self._status[p] = status
+        return entries
+
+    def status(self, path: str | None = None) -> str:
+        self.load(path)
+        return self._status.get(self.path(path), "ok")
+
+    def save(self, entries: dict, path: str | None = None, schema: int = 1) -> str:
+        """Atomically persist the manifest-headed table; best-effort."""
+        p = self.path(path)
+        from qdml_tpu.telemetry import run_manifest
+
+        payload = {
+            "schema": schema,
+            "kind": self.kind,
+            "manifest": run_manifest(argv=[self.argv_tag], include_jax=True),
+            "entries": entries,
+        }
+        try:
+            os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+            tmp = f"{p}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, indent=2)
+                fh.write("\n")
+            os.replace(tmp, p)
+        except OSError:
+            pass
+        self._cache[p] = entries
+        self._status[p] = "ok"
+        return p
+
+    def invalidate(self) -> None:
+        self._cache.clear()
+        self._status.clear()
+        self.set_path(None)

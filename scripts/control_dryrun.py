@@ -84,6 +84,10 @@ def main(argv: list[str]) -> int:
         serve=ServeConfig(
             max_batch=32, buckets=(8, 16, 32), max_wait_ms=2.0, max_queue=512,
             drift_step=DRIFT_STEP, drift_scenario=DRIFT_SCENARIO,
+            # the committed control baselines were measured under bucket
+            # coalescing; a regenerated artifact must not silently flip
+            # admission policy via the auto batching table
+            batching="bucket",
         ),
         control=ControlConfig(
             ft_steps=300, ft_batch=32, probe_n=96,

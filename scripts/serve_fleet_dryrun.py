@@ -64,7 +64,10 @@ def main(argv: list[str]) -> int:
         model=ModelConfig(features=32),
         train=TrainConfig(batch_size=16, n_epochs=1),
         mesh=MeshConfig(data_axis=devices, model_axis=1, fed_axis=1),
-        serve=ServeConfig(max_batch=32, buckets=(8, 16, 32), max_wait_ms=2.0, max_queue=512),
+        serve=ServeConfig(max_batch=32, buckets=(8, 16, 32), max_wait_ms=2.0,
+                          max_queue=512, batching="bucket"),  # the committed
+        # baselines were measured under bucket coalescing; a regenerated
+        # artifact must not silently flip admission policy via the auto table
     )
     mesh = serve_mesh(cfg)
     _, hdce_state = init_hdce_state(cfg, 4)

@@ -1,0 +1,243 @@
+"""Bucket-vs-ragged continuous batching dryrun on virtual devices (ISSUE 12).
+
+The serving twin of the scenario/qubit crossover artifacts: force the
+8-virtual-device CPU backend (``utils.platform.force_cpu``), drive the SAME
+warmed engine family through loadgen in both batching modes — ``bucket``
+(pad-to-power-of-two + coalesce to bucket edges) and ``ragged`` (traced
+valid-count executables + continuous admission) — under the bursty-MMPP and
+diurnal arrival processes at two offered-load levels, interleaved best-of-N
+trials, and feed each condition's artifacts through the ``qdml-tpu report``
+goodput/padding-waste/p99 gates. Writes ``results/serve_ragged/``:
+
+- ``loadgen_{mode}_{process}_r{rate}_t{trial}.jsonl`` — manifest-headed
+  telemetry, one file per trial;
+- ``RAGGED_DRYRUN.json`` — the headline comparison per condition (p99,
+  goodput, padding waste, sheds, zero-compile gate) + report exit codes;
+- ``report_{process}_r{rate}.md`` — the rendered gate (ragged current vs
+  bucket baseline).
+
+It also warms ONE ``serve.batching=auto`` engine first, which runs the
+bucket-vs-ragged race per capacity tier and persists the measured winners to
+``results/autotune/serve_batching.json`` — the committed table production
+warmups read instead of re-timing.
+
+Config choices that make the comparison honest rather than rigged:
+
+- both modes run the IDENTICAL config; the bucket path's coalescing window
+  (``max_wait_ms=10``) is sized the way an SLO-aware bucket deployment
+  sizes it — well under the offered deadline (16 ms), leaving service-time
+  margin — because the window IS that mode's fill mechanism, and the ragged
+  mode's point is not needing one;
+- the tier ladder is the full power-of-two ladder, so a small continuous
+  dispatch lands in a small tier — continuous admission is NOT allowed to
+  win latency by burning padding (the padding-waste gate checks exactly
+  this);
+- deadlines are offered (SLO serving) and goodput counts USEFUL rows —
+  completed within deadline (the serving-literature definition) — so the
+  coalescing window's hold converts into measurable goodput loss: a row the
+  bucket path delivers after its deadline is throughput, not goodput.
+
+Run: ``python scripts/serve_ragged_dryrun.py [--n=384] [--trials=3]
+[--rates=80,400] [--deadline-ms=16] [--max-wait-ms=10]``
+Virtual-device timings measure dispatch/coalescing behavior, not ICI — the
+per-dispatch cost is nearly flat in batch size on this harness (the
+launch-bound regime real accelerators live in), which is exactly the regime
+where coalescing windows pay pure latency for fill the ragged path gets for
+free. On a real pod the same artifacts re-run and the same gates arm on TPU
+numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+
+def _arg(argv: list[str], name: str, default: str) -> str:
+    return next((a.split("=", 1)[1] for a in argv if a.startswith(f"--{name}=")), default)
+
+
+def main(argv: list[str]) -> int:
+    devices = int(_arg(argv, "devices", "8"))
+    n = int(_arg(argv, "n", "384"))
+    trials = int(_arg(argv, "trials", "3"))
+    rates = [float(r) for r in _arg(argv, "rates", "80,400").split(",")]
+    deadline_ms = float(_arg(argv, "deadline-ms", "16"))
+    max_wait_ms = float(_arg(argv, "max-wait-ms", "10"))
+    force_cpu(devices)
+
+    import dataclasses
+
+    from qdml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        MeshConfig,
+        ModelConfig,
+        ServeConfig,
+        TrainConfig,
+    )
+    from qdml_tpu.parallel.mesh import serve_mesh
+    from qdml_tpu.serve import ServeEngine, run_loadgen
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.telemetry.report import report_main
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    out_dir = os.path.join("results", "serve_ragged")
+    os.makedirs(out_dir, exist_ok=True)
+
+    def cfg_for(batching: str) -> ExperimentConfig:
+        # Model sized so per-dispatch service time (~5-15ms here) sits in the
+        # launch-bound regime real accelerators serve this pipeline in — the
+        # regime where the bucket path's coalescing window is a comparable
+        # (not negligible) share of the latency budget. The fleet dryrun's
+        # deliberately heavy model measures replica overlap; this one
+        # measures admission policy.
+        return ExperimentConfig(
+            name="serve_ragged_dryrun",
+            data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+            model=ModelConfig(features=8),
+            train=TrainConfig(batch_size=16, n_epochs=1),
+            mesh=MeshConfig(data_axis=devices, model_axis=1, fed_axis=1),
+            serve=ServeConfig(
+                max_batch=32,
+                buckets=(1, 2, 4, 8, 16, 32),
+                max_wait_ms=max_wait_ms,
+                max_queue=512,
+                batching=batching,
+            ),
+        )
+
+    cfg = cfg_for("bucket")
+    mesh = serve_mesh(cfg)
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    clf_vars = {"params": sc_state.params}
+
+    # 1) the measured race: one auto-mode warmup persists the per-capacity
+    # bucket-vs-ragged winners (results/autotune/serve_batching.json) — the
+    # committed table production auto warmups read instead of re-timing
+    auto_engine = ServeEngine(
+        cfg_for("auto"), hdce_vars, clf_vars, mesh=serve_mesh(cfg_for("auto"))
+    )
+    auto_warm = auto_engine.warmup()
+    race = {
+        tier: {
+            "best": entry.get("best_infer"),
+            "candidates": entry.get("candidates"),
+        }
+        for tier, entry in auto_warm["batching"]["race"].items()
+    }
+    print("auto race:", json.dumps(race, indent=2))
+
+    headline: dict = {
+        "devices": devices,
+        "n": n,
+        "trials": trials,
+        "deadline_ms": deadline_ms,
+        "max_wait_ms": cfg.serve.max_wait_ms,
+        "buckets": list(cfg.serve.buckets),
+        "auto_race": race,
+        "note": (
+            "interleaved best-of-N trials per (mode, process, rate): one "
+            "contended CPU host swings per-run numbers, so each setting's "
+            "best-goodput run approximates its uncontended capability (all "
+            "trials recorded); per-dispatch cost is ~flat in batch size on "
+            "this harness (launch-bound), so the bucket path's coalescing "
+            "window is pure latency tax — the regime real accelerators "
+            "live in"
+        ),
+        "conditions": {},
+    }
+
+    conditions = [(proc, rate) for proc in ("bursty", "diurnal") for rate in rates]
+    all_pass = True
+    for proc, rate in conditions:
+        best: dict = {}
+        trial_stats: dict = {"bucket": [], "ragged": []}
+        for trial in range(trials):
+            for mode in ("bucket", "ragged"):
+                # fresh engine per run: each run's warmup/compile gate and
+                # metrics window stand alone (repeat warmups hit the
+                # persistent compile cache)
+                mcfg = dataclasses.replace(
+                    cfg_for(mode),
+                    serve=dataclasses.replace(cfg_for(mode).serve, arrival=proc),
+                )
+                engine = ServeEngine(mcfg, hdce_vars, clf_vars, mesh=mesh)
+                path = os.path.join(
+                    out_dir, f"loadgen_{mode}_{proc}_r{int(rate)}_t{trial}.jsonl"
+                )
+                logger = MetricsLogger(path, echo=False, manifest=run_manifest(mcfg))
+                try:
+                    summary = run_loadgen(
+                        mcfg, engine, rate=rate, n=n, deadline_ms=deadline_ms,
+                        logger=logger, process=proc,
+                    )
+                finally:
+                    logger.close()
+                stat = {
+                    "trial": trial,
+                    "goodput_rps": summary["goodput_rps"],
+                    "p99_ms": (summary["latency_ms"] or {}).get("p99_ms"),
+                    "p50_ms": (summary["latency_ms"] or {}).get("p50_ms"),
+                    "padding_waste": summary["padding_waste"],
+                    "n_shed": summary["n_shed"],
+                    "slo": summary["slo"],
+                    "compile_cache_after_warmup": summary["compile_cache_after_warmup"],
+                }
+                trial_stats[mode].append(stat)
+                if mode not in best or (summary["goodput_rps"] or 0) > (
+                    best[mode][0]["goodput_rps"] or 0
+                ):
+                    best[mode] = (summary, path, stat)
+        key = f"{proc}_r{int(rate)}"
+        report_md = os.path.join(out_dir, f"report_{key}.md")
+        rc = report_main(
+            [
+                f"--current={best['ragged'][1]}",
+                f"--baseline={best['bucket'][1]}",
+                f"--out={report_md}",
+            ]
+        )
+        all_pass = all_pass and rc == 0
+        b, r = best["bucket"][2], best["ragged"][2]
+        headline["conditions"][key] = {
+            "process": proc,
+            "offered_rate": rate,
+            "bucket": {**b, "trials": trial_stats["bucket"]},
+            "ragged": {**r, "trials": trial_stats["ragged"]},
+            "p99_speedup": (
+                round(b["p99_ms"] / r["p99_ms"], 3)
+                if b["p99_ms"] and r["p99_ms"]
+                else None
+            ),
+            "goodput_gain": (
+                round(r["goodput_rps"] / b["goodput_rps"], 3)
+                if b["goodput_rps"] and r["goodput_rps"]
+                else None
+            ),
+            "report_gate": {"exit_code": rc, "markdown": report_md},
+        }
+        print(
+            f"{key}: bucket p99={b['p99_ms']}ms goodput={b['goodput_rps']} "
+            f"shed={b['n_shed']} | ragged p99={r['p99_ms']}ms "
+            f"goodput={r['goodput_rps']} shed={r['n_shed']} | gate rc={rc}"
+        )
+
+    headline["report_gates_all_pass"] = all_pass
+    with open(os.path.join(out_dir, "RAGGED_DRYRUN.json"), "w") as fh:
+        json.dump(headline, fh, indent=2)
+    print(json.dumps({k: v for k, v in headline.items() if k != "conditions"}, indent=2))
+    return 0 if all_pass else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

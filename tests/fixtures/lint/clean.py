@@ -149,3 +149,17 @@ def static_size_nonzero(x, ids):
     # exactly what the data-dependent-shape rule asks callers to provide
     (idx,) = jnp.nonzero(x > 0, size=4, fill_value=0)
     return idx, jnp.unique(ids, size=4, fill_value=0)
+
+
+def reads_bucket_table(n, buckets):
+    # pad-to-bucket-in-serve's legitimate twins: picking a bucket WITHOUT
+    # padding into it (shape-table readers, metrics labels) is fine...
+    return pick_bucket(n, buckets)  # noqa: F821 — AST fixture
+
+
+def fixed_scratch_fill(x):
+    # ...and zeros + slice assignment WITHOUT a bucket pick is an ordinary
+    # fixed-shape scratch buffer, not a request-batch pad
+    scratch = np.zeros((16, 4), np.float32)
+    scratch[: len(x)] = x
+    return scratch

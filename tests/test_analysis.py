@@ -55,6 +55,7 @@ def test_violation_fixture_trips_every_rule():
     # nonzero + unique + 1-arg where + direct mask + mask-local (2 on 1 line
     # dedup to their own lines: direct and via-local sit on separate lines)
     assert rules["data-dependent-shape-in-jit"] == 5
+    assert rules["pad-to-bucket-in-serve"] == 1    # bucket pick + zeros pad
     # every finding carries a usable anchor
     for f in findings:
         assert f.path.endswith("violations.py") and f.line > 0 and f.message
@@ -595,7 +596,7 @@ def test_serve_checkify_parity_and_trip():
         data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
         model=ModelConfig(features=8),
         train=TrainConfig(batch_size=16, n_epochs=1),
-        serve=ServeConfig(max_batch=4, buckets=(4,), checkify=True),
+        serve=ServeConfig(max_batch=4, buckets=(4,), checkify=True, batching="bucket"),
     )
     _, hdce_state = init_hdce_state(cfg, 4)
     hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}

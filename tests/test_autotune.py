@@ -247,7 +247,7 @@ def test_serve_warmup_autotunes_with_zero_request_path_compiles():
         model=ModelConfig(features=8),
         quantum=QuantumConfig(n_qubits=3, n_layers=1, autotune="on"),
         train=TrainConfig(batch_size=16, n_epochs=1),
-        serve=ServeConfig(max_batch=4, buckets=(4,), max_wait_ms=1.0, max_queue=32),
+        serve=ServeConfig(max_batch=4, buckets=(4,), max_wait_ms=1.0, max_queue=32, batching="bucket"),
     )
     _, hdce_state = init_hdce_state(cfg, 4)
     hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
@@ -267,8 +267,8 @@ def test_serve_warmup_autotunes_with_zero_request_path_compiles():
     )
     x = np.random.default_rng(0).standard_normal((3, *cfg.image_hw, 2)).astype(np.float32)
     for _ in range(3):
-        h, pred, _conf, bucket = engine.infer(x)
-        assert h.shape[0] == 3 and bucket == 4
+        h, pred, _conf, info = engine.infer(x)
+        assert h.shape[0] == 3 and info.bucket == 4
     assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
 
 
@@ -286,7 +286,7 @@ def test_serve_mps_impl_baked_into_aot_bucket_zero_compiles():
         model=ModelConfig(features=8),
         quantum=QuantumConfig(n_qubits=3, n_layers=1, impl="mps", mps_chi=4),
         train=TrainConfig(batch_size=16, n_epochs=1),
-        serve=ServeConfig(max_batch=4, buckets=(4,), max_wait_ms=1.0, max_queue=32),
+        serve=ServeConfig(max_batch=4, buckets=(4,), max_wait_ms=1.0, max_queue=32, batching="bucket"),
     )
     _, hdce_state = init_hdce_state(cfg, 4)
     hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
@@ -297,8 +297,8 @@ def test_serve_mps_impl_baked_into_aot_bucket_zero_compiles():
     assert warm["quantum_impl"]["4"]["mps_chi"] == 4
     x = np.random.default_rng(0).standard_normal((3, *cfg.image_hw, 2)).astype(np.float32)
     for _ in range(3):
-        h, pred, _conf, bucket = engine.infer(x)
-        assert h.shape[0] == 3 and bucket == 4
+        h, pred, _conf, info = engine.infer(x)
+        assert h.shape[0] == 3 and info.bucket == 4
     assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
 
 

@@ -41,7 +41,7 @@ def _tiny_cfg(**control_overrides) -> ExperimentConfig:
         data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=96),
         model=ModelConfig(features=8),
         train=TrainConfig(batch_size=16, n_epochs=1),
-        serve=ServeConfig(max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=64),
+        serve=ServeConfig(max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=64, batching="bucket"),
         control=ControlConfig(
             **{
                 "ft_steps": 4, "ft_batch": 16, "probe_n": 12, "min_window": 4,

@@ -276,7 +276,7 @@ def _mini_cfg(n_scenarios=8, dispatch="sparse", buckets=(8, 16)):
         data=DataConfig(n_ant=16, n_sub=8, n_beam=4, n_scenarios=n_scenarios),
         model=ModelConfig(features=8),
         train=TrainConfig(batch_size=8, n_epochs=1),
-        serve=ServeConfig(max_batch=max(buckets), buckets=buckets, dispatch=dispatch),
+        serve=ServeConfig(max_batch=max(buckets), buckets=buckets, dispatch=dispatch, batching="bucket"),
     )
 
 

@@ -6,6 +6,7 @@ import json
 import threading
 import time
 
+import jax
 import numpy as np
 import pytest
 
@@ -124,6 +125,44 @@ def test_bounded_queue_sheds_instead_of_collapsing():
     assert mb.depth == 3  # rejected request never enqueued
 
 
+def test_continuous_admission_dispatches_without_waiting():
+    """Continuous mode (the ragged engine's batcher policy): next_batch
+    returns whatever is queued the moment anything is queued — no bucket-edge
+    coalescing, no max-wait stall — and wait_hint is 0 on a non-empty queue
+    (an idle engine must never sleep on work)."""
+    clock = FakeClock()
+    mb = MicroBatcher(max_batch=4, max_wait_s=0.005, max_queue=8, clock=clock,
+                      continuous=True)
+    assert mb.submit(_req(1)) is None
+    # t=0, far from aged, far from full: continuous flushes anyway
+    assert mb.wait_hint() == 0.0
+    batch, shed = mb.next_batch()
+    assert [r.rid for r in batch] == [1] and shed == []
+    # a backlog still caps at max_batch per dispatch
+    for i in range(2, 8):
+        assert mb.submit(_req(i)) is None
+    batch, _ = mb.next_batch()
+    assert [r.rid for r in batch] == [2, 3, 4, 5]
+    assert mb.depth == 2
+    # empty queue: the idle sleep bound is unchanged
+    mb.next_batch()
+    assert mb.wait_hint() == mb.max_wait_s
+
+
+def test_continuous_admission_still_sheds_expired_deadlines():
+    """Deadline shedding is admission machinery, not coalescing machinery —
+    continuous mode keeps it bit-for-bit."""
+    clock = FakeClock()
+    mb = MicroBatcher(max_batch=4, max_wait_s=0.005, max_queue=8, clock=clock,
+                      continuous=True)
+    assert mb.submit(_req(1, deadline=0.003)) is None
+    assert mb.submit(_req(2, deadline=1.0)) is None
+    clock.t = 0.01
+    batch, shed = mb.next_batch()
+    assert [(r.rid, o.reason) for r, o in shed] == [(1, DEADLINE_AT_DEQUEUE)]
+    assert [r.rid for r in batch] == [2]
+
+
 def test_bucket_overflow_falls_back_to_largest():
     buckets = (1, 2, 4, 8)
     assert pick_bucket(3, buckets) == 4
@@ -147,7 +186,14 @@ def _tiny_cfg():
         data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
         model=ModelConfig(features=8),
         train=TrainConfig(batch_size=16, n_epochs=1),
-        serve=ServeConfig(max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=32),
+        # batching pinned to the bucket incumbent: these are the coalescing
+        # path's pins; the ragged twins live below (and the auto race, which
+        # would otherwise time+persist a table entry at warmup, is exercised
+        # against a tmp table in test_batching_auto_race_*)
+        serve=ServeConfig(
+            max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=32,
+            batching="bucket",
+        ),
     )
 
 
@@ -181,8 +227,10 @@ def test_infer_parity_across_buckets(warmed):
     eval forward on the same checkpoint — padding rows cannot leak."""
     cfg, engine, samples, offline_h, offline_pred = warmed
     for n in (1, 3, 4, 5, 8):
-        h, pred, _conf, bucket = engine.infer(samples["x"][:n])
-        assert bucket == pick_bucket(n, engine.buckets)
+        h, pred, _conf, info = engine.infer(samples["x"][:n])
+        assert info.bucket == pick_bucket(n, engine.buckets)
+        assert info.n == n and info.rows == info.bucket and info.chunks == 1
+        assert info.mode == "bucket"
         assert h.shape == (n, cfg.h_out_dim)
         np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
         np.testing.assert_array_equal(pred, offline_pred[:n])
@@ -193,9 +241,31 @@ def test_oversize_batch_serves_in_largest_bucket_chunks(warmed):
     n = 19  # > largest bucket (8): 8 + 8 + 3-padded-to-4
     x = np.concatenate([samples["x"]] * 2)[:n]
     ref = np.concatenate([offline_h] * 2)[:n]
-    h, pred, _conf, bucket = engine.infer(x)
-    assert bucket == engine.buckets[-1] and h.shape[0] == n
+    h, pred, _conf, info = engine.infer(x)
+    assert info.bucket == engine.buckets[-1] and h.shape[0] == n
     np.testing.assert_allclose(h, ref, rtol=1e-5, atol=1e-5)
+    # regression (oversize accounting): the final near-empty chunk used to be
+    # invisible — fill was reported as n/largest = 19/8 = 2.375, inflating
+    # batch-fill stats past 1.0. DispatchInfo sums the STATIC rows of every
+    # chunk executable (8 + 8 + pad-to-4), so fill/pad accounting is honest.
+    assert info.n == 19 and info.rows == 20 and info.chunks == 3
+    assert info.fill == pytest.approx(19 / 20) and info.padded == 1
+    from qdml_tpu.serve.metrics import ServeMetrics
+    from qdml_tpu.serve.types import Prediction as P
+
+    m = ServeMetrics()
+    m.observe_batch(
+        [P(rid=i, h=h[i], scenario=0, latency_s=0.0, bucket=info.bucket,
+           batch_n=n) for i in range(n)],
+        info, depth=0, dur_s=0.01,
+    )
+    fill = m._scaled(m.batch_fill)
+    assert fill["max"] <= 1.0  # never >1 again
+    assert m.rows() == {
+        "useful": 19, "valid": 19, "dispatched": 20, "padded": 1,
+        "dispatches": 3,
+    }
+    assert m.padding_waste() == pytest.approx(0.05)
 
 
 def test_serve_smoke_zero_request_path_compiles(warmed):
@@ -805,6 +875,52 @@ def test_report_serving_slo_gate_and_fleet_line(tmp_path):
     assert report_main([f"--current={ok}", f"--baseline={base}"]) == EXIT_OK
 
 
+def test_report_goodput_and_padding_waste_gates(tmp_path):
+    """The ragged-batching gates: goodput_rps rides the throughput gate
+    (lower = regression), padding_waste gates ABSOLUTELY like the overflow
+    rate (current > baseline + 0.05 fails; near-zero baselines make ratios
+    meaningless), and the fleet line names the batching mode."""
+    from qdml_tpu.telemetry.report import (
+        EXIT_OK,
+        EXIT_REGRESSION,
+        build_report,
+        report_main,
+    )
+
+    def rec(goodput, waste, mode):
+        r = _serve_summary_rec(5.0, 9.0, 12.0, 800.0)
+        r["goodput_rps"] = goodput
+        r["padding_waste"] = waste
+        r["batching"] = {"mode": mode, "continuous_admission": mode == "ragged"}
+        r["replicas"] = 1
+        r["workers"] = 1
+        return r
+
+    base = _write(tmp_path, "base.jsonl", rec(760.0, 0.08, "bucket"))
+    # goodput -40%, padding waste +9 points: both must gate
+    bad = _write(tmp_path, "bad.jsonl", rec(456.0, 0.17, "bucket"))
+    md, regressions, armed = build_report([bad], base, 10.0)
+    assert armed
+    names = {r["metric"] for r in regressions}
+    assert {"serve.goodput_rps", "serve.padding_waste"} <= names
+    assert "serving padding waste" in md
+    assert report_main([f"--current={bad}", f"--baseline={base}"]) == EXIT_REGRESSION
+
+    # the ragged win direction: goodput up, waste down, mode named on the
+    # fleet line — no regression, exit 0 (the dryrun's round-trip shape)
+    good = _write(tmp_path, "good.jsonl", rec(840.0, 0.01, "ragged"))
+    md, regressions, armed = build_report([good], base, 10.0)
+    assert not regressions
+    assert "ragged-batching" in md and "bucket-batching" in md
+    assert "pad waste" in md
+    assert report_main([f"--current={good}", f"--baseline={base}"]) == EXIT_OK
+
+    # inside the slack band: ok, not improved/regressed
+    near = _write(tmp_path, "near.jsonl", rec(800.0, 0.10, "bucket"))
+    _, regressions, _ = build_report([near], base, 10.0)
+    assert not regressions
+
+
 def test_report_serving_platform_mismatch_disarms(tmp_path):
     """A CPU loadgen run diffed against a TPU baseline compares hardware,
     not code: deltas shown, serving gate disarmed (loadgen stamps its
@@ -820,3 +936,196 @@ def test_report_serving_platform_mismatch_disarms(tmp_path):
     md, regressions, armed = build_report([cur], base, 10.0)
     assert regressions and not armed and "platform mismatch" in md
     assert report_main([f"--current={cur}", f"--baseline={base}"]) == EXIT_OK
+
+
+# ---------------------------------------------------------------------------
+# Ragged continuous batching: traced valid-count tiers, parity, goodput
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ragged(warmed):
+    """The warmed bucket engine's ragged twin on the SAME params: every
+    capacity tier compiled with a traced valid-count (module scope — each
+    tier is an XLA compile)."""
+    import dataclasses
+
+    cfg, engine, samples, offline_h, offline_pred = warmed
+    rcfg = dataclasses.replace(
+        cfg, serve=dataclasses.replace(cfg.serve, batching="ragged")
+    )
+    rengine = ServeEngine(rcfg, *engine.live_vars())
+    warm = rengine.warmup()
+    return rcfg, rengine, warm
+
+
+def test_ragged_vs_bucket_bit_exact_at_every_fill(warmed, ragged):
+    """The ragged-vs-bucket parity pin: at EVERY fill level 1..capacity the
+    ragged executable (traced n_valid, masked pad tail) returns bit-identical
+    fp32 outputs to the bucket executable on the same params — the mask may
+    not perturb a single ulp of any valid row."""
+    cfg, bengine, samples, offline_h, offline_pred = warmed
+    rcfg, rengine, _ = ragged
+    assert rengine.batching_mode == {"4": "ragged", "8": "ragged"}
+    assert rengine.continuous_admission is True
+    for n in range(1, rengine.buckets[-1] + 1):
+        hb, pb, cb, ib = bengine.infer(samples["x"][:n])
+        hr, pr, cr, ir = rengine.infer(samples["x"][:n])
+        assert ib.bucket == ir.bucket and ir.mode == "ragged"
+        np.testing.assert_array_equal(hr, hb)
+        np.testing.assert_array_equal(pr, pb)
+        np.testing.assert_array_equal(cr, cb)
+        np.testing.assert_allclose(hr, offline_h[:n], rtol=1e-5, atol=1e-5)
+    assert rengine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+
+
+def test_ragged_padded_rows_never_leak(warmed, ragged):
+    """Garbage (NaN/Inf) in the pad tail of a ragged tier cannot perturb
+    valid outputs: the traced mask zeroes pad rows INSIDE the program, so
+    the proof is by construction, not by row-independence convention."""
+    cfg, bengine, samples, offline_h, _ = warmed
+    rcfg, rengine, _ = ragged
+    xp = np.full((8, *cfg.image_hw, 2), np.nan, np.float32)
+    xp[5:7] = np.inf
+    xp[:3] = samples["x"][:3]
+    out = rengine._compiled[8](*rengine.live_vars(), xp, np.int32(3))
+    h = np.asarray(jax.device_get(out[0]))[:3]
+    np.testing.assert_allclose(h, offline_h[:3], rtol=1e-5, atol=1e-5)
+    # and the pad rows came out finite (the zero-masked forward), proving the
+    # mask ran before any compute could propagate the garbage
+    assert np.isfinite(np.asarray(jax.device_get(out[0]))).all()
+
+
+def test_ragged_zero_compiles_across_warmup_traffic_and_swap():
+    """The ragged twin of the hot-swap acceptance pin: a ragged engine
+    serves traffic through the full loop, hot-swaps a checkpoint, serves
+    again — zero request-path compiles across the whole window (the traced
+    valid-count executables cover every fill level by construction)."""
+    import dataclasses
+
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = _tiny_cfg()
+    cfg = dataclasses.replace(
+        cfg, serve=dataclasses.replace(cfg.serve, batching="ragged")
+    )
+
+    def _vars(seed):
+        c = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, seed=seed))
+        _, hdce_state = init_hdce_state(c, 4)
+        _, sc_state = init_sc_state(c, quantum=False, steps_per_epoch=4)
+        return (
+            {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats},
+            {"params": sc_state.params},
+        )
+
+    h0, c0 = _vars(0)
+    h1, c1 = _vars(123)
+    engine = ServeEngine(cfg, h0, c0)
+    samples = make_request_samples(cfg, 12)
+    old_h, _, _ = engine.offline_forward(samples["x"])
+    ref = ServeEngine(cfg, h1, c1)
+    new_h, _, _ = ref.offline_forward(samples["x"])
+    engine.warmup()
+
+    loop = ServeLoop(engine).start()
+    try:
+        assert loop.batcher.continuous is True  # admission synced at start()
+        pre = [loop.submit(samples["x"][i], rid=i) for i in range(12)]
+        pre_res = [f.result(timeout=30.0) for f in pre]
+        rec = engine.swap_params(h1, c1)
+        post = [loop.submit(samples["x"][i], rid=100 + i) for i in range(12)]
+        post_res = [f.result(timeout=30.0) for f in post]
+    finally:
+        loop.stop()
+    assert rec["compile"] == {"hits": 0, "misses": 0, "requests": 0}
+    for r in pre_res:
+        assert isinstance(r, Prediction)
+        np.testing.assert_allclose(r.h, old_h[r.rid], rtol=1e-5, atol=1e-5)
+    for r in post_res:
+        assert isinstance(r, Prediction)
+        np.testing.assert_allclose(r.h, new_h[r.rid - 100], rtol=1e-5, atol=1e-5)
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+    # goodput/padding accounting rode along (every dispatch observed)
+    m = loop.merged_metrics()
+    assert m.rows()["valid"] == 24 and m.rows()["dispatched"] >= 24
+    assert m.padding_waste() is not None
+
+
+def test_batching_auto_race_persists_and_rereads(warmed, tmp_path):
+    """serve.batching=auto: warmup races bucket-vs-ragged per capacity tier
+    against a tmp table, persists the measured winner, and a second warmup
+    READS the table instead of re-timing (entry identity pins it)."""
+    import dataclasses
+
+    from qdml_tpu.serve import batching_autotune
+
+    cfg, engine, *_ = warmed
+    acfg = dataclasses.replace(
+        cfg, serve=dataclasses.replace(cfg.serve, batching="auto", buckets=(4,),
+                                       max_batch=4)
+    )
+    table = str(tmp_path / "serve_batching.json")
+    batching_autotune.invalidate_cache()
+    batching_autotune.set_table_path(table)
+    try:
+        e1 = ServeEngine(acfg, *engine.live_vars())
+        warm = e1.warmup()
+        entry = warm["batching"]["race"]["4"]
+        assert entry["best_infer"] in ("bucket", "ragged")
+        assert {"bucket", "ragged"} <= set(entry["candidates"])
+        assert all(
+            isinstance(c.get("infer_ms"), float) for c in entry["candidates"].values()
+        )
+        assert e1.batching_mode["4"] == entry["best_infer"]
+        # persisted: a fresh load sees the same entry, and a second engine's
+        # warmup resolves from the table (same ts pins "read, not re-raced")
+        batching_autotune.invalidate_cache()
+        batching_autotune.set_table_path(table)
+        saved = batching_autotune.load_table()[entry["key"]]
+        assert saved["ts"] == entry["ts"]
+        e2 = ServeEngine(acfg, *engine.live_vars())
+        warm2 = e2.warmup()
+        assert warm2["batching"]["race"]["4"]["ts"] == entry["ts"]
+        assert batching_autotune.lookup(4, "dense") == entry["best_infer"]
+    finally:
+        batching_autotune.invalidate_cache()
+
+
+def test_batching_config_validation():
+    import dataclasses
+
+    cfg = _tiny_cfg()
+    bad = dataclasses.replace(
+        cfg, serve=dataclasses.replace(cfg.serve, batching="loose")
+    )
+    with pytest.raises(ValueError, match="serve.batching"):
+        from qdml_tpu.train.hdce import init_hdce_state
+
+        _, hdce_state = init_hdce_state(cfg, 4)
+        ServeEngine(bad, {"params": hdce_state.params}, {"params": {}})
+
+
+def test_loadgen_ragged_summary_carries_goodput_and_batching(ragged, tmp_path):
+    """run_loadgen over a ragged engine: the summary's goodput/padding/rows
+    columns are filled, the batching block names the mode per tier, and the
+    zero-compile gate holds — the committed dryrun's per-run shape."""
+    rcfg, rengine, _ = ragged
+    summary = run_loadgen(rcfg, rengine, rate=2000.0, n=32, deadline_ms=30000.0)
+    assert summary["completed"] == 32 and summary["n_shed"] == 0
+    assert summary["compile_cache_after_warmup"] == {"hits": 0, "misses": 0, "requests": 0}
+    assert summary["batching"] == {
+        "mode": "ragged",
+        "per_tier": {"4": "ragged", "8": "ragged"},
+        "continuous_admission": True,
+    }
+    # every request completed within its (generous) deadline -> goodput == rps
+    assert summary["goodput_rps"] == pytest.approx(summary["rps"], abs=0.02)
+    rows = summary["rows"]
+    assert rows["useful"] == rows["valid"] == 32
+    assert rows["dispatched"] >= 32 and rows["padded"] == rows["dispatched"] - 32
+    assert summary["padding_waste"] == pytest.approx(
+        rows["padded"] / rows["dispatched"], abs=1e-4  # summary rounds to 4dp
+    )
+    assert summary["parity_max_abs_err"] < 1e-4

@@ -39,6 +39,7 @@ ZERO = {"hits": 0, "misses": 0, "requests": 0}
 
 
 def _cfg(**serve_kw):
+    serve_kw.setdefault("batching", "bucket")  # the coalescing path's pins
     serve = ServeConfig(max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=64, **serve_kw)
     return ExperimentConfig(
         data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
@@ -164,6 +165,44 @@ def test_expert_sharded_trunks_parity():
         h, pred, _, _ = engine.infer(samples["x"][:n])
         np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
         np.testing.assert_array_equal(pred, offline_pred[:n])
+    assert engine.request_path_compiles() == ZERO
+
+
+def test_ragged_sharded_sparse_expert_padded_rows_never_leak():
+    """The strongest padded-rows-never-leak pin: a RAGGED engine with FORCED
+    sparse dispatch and fed-sharded experts on the 8-virtual-device mesh —
+    NaN/Inf garbage in the pad tail of the capacity tier cannot perturb any
+    valid output (the traced mask zeroes pad rows before the classifier, so
+    garbage can neither route, consume sparse capacity, nor reach a trunk),
+    and the mesh-sharded ragged request path still never compiles."""
+    cfg = _cfg(expert_sharding=True, dispatch="sparse", batching="ragged")
+    cfg = override(cfg, "mesh.fed_axis", 3)
+    cfg = override(cfg, "mesh.data_axis", 2)
+    mesh = serve_mesh(cfg)
+    hdce_vars, clf_vars = _vars(cfg)
+    engine = ServeEngine(cfg, hdce_vars, clf_vars, mesh=mesh)
+    samples = make_request_samples(cfg, 8)
+    offline_h, offline_pred, _ = engine.offline_forward(samples["x"])
+    warm = engine.warmup()
+    assert engine.batching_mode == {"4": "ragged", "8": "ragged"}
+    assert engine.dispatch_mode == {"4": "sparse", "8": "sparse"}
+    assert warm["mesh"]["expert_sharding"] is True
+
+    # clean-path parity first (sparse + ragged + sharded composes)
+    for n in (2, 5, 8):
+        h, pred, _, info = engine.infer(samples["x"][:n])
+        assert info.mode == "ragged"
+        np.testing.assert_allclose(h, offline_h[:n], rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(pred, offline_pred[:n])
+
+    # garbage pad tail straight into the compiled sparse executable
+    xp = np.full((8, *cfg.image_hw, 2), np.nan, np.float32)
+    xp[6] = np.inf
+    xp[:3] = samples["x"][:3]
+    out = engine._compiled[8](*engine.live_vars(), xp, np.int32(3))
+    h = np.asarray(jax.device_get(out[0]))
+    np.testing.assert_allclose(h[:3], offline_h[:3], rtol=1e-5, atol=1e-5)
+    assert np.isfinite(h).all()  # the mask ran before any compute
     assert engine.request_path_compiles() == ZERO
 
 
