@@ -63,7 +63,27 @@ LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
     # served history)
     "qdml_tpu/serve/server.py": {
         "ExitCoordinator": {"_live": "_lock"},
-        "ReplicaPool": {"_replicas": "_pool_lock", "_retired": "_pool_lock"},
+        # _quarantined rides _pool_lock like the replica/retired lists: the
+        # supervisor thread moves crash-looping replicas there while health/
+        # metrics readers iterate; the dedup cache's entry map is shared
+        # between the event loop (inserts) and worker threads (the
+        # forget-unless-served done-callbacks)
+        "ReplicaPool": {
+            "_replicas": "_pool_lock",
+            "_retired": "_pool_lock",
+            "_quarantined": "_pool_lock",
+        },
+        "DedupCache": {"_entries": "_lock"},
+    },
+    # breaker state machine: every submit (any thread) runs allow() and the
+    # health/metrics paths read summary() — all transitions and counters
+    # live under the one lock
+    "qdml_tpu/serve/breaker.py": {
+        "CircuitBreaker": {
+            "_state": "_lock",
+            "_opens": "_lock",
+            "_fast_fails": "_lock",
+        }
     },
     # fleet-control shared state (docs/CONTROL.md): the controller tick
     # thread writes these while status/report paths read them
@@ -193,6 +213,55 @@ DATA_DEP_SHAPE_CALLS: frozenset[str] = frozenset(
         "unique_inverse",
         "unique_values",
     }
+)
+
+# Socket/stream IO calls a retry loop re-attempts (rule retry-without-backoff):
+# matched on the callee's last attribute segment inside a try body inside a
+# host-side loop. Deliberately narrow — `result`/`get` are far too generic,
+# and flagging them would make the rule cry wolf on every future drain.
+RETRY_IO_CALLS: frozenset[str] = frozenset(
+    {
+        "create_connection",
+        "connect",
+        "connect_ex",
+        "open_connection",
+        "sendall",
+        "send",
+        "recv",
+        "recv_into",
+        "readline",
+        "readexactly",
+        "readuntil",
+        "urlopen",
+    }
+)
+
+# Calls that count as backoff between retry attempts (rule
+# retry-without-backoff looks for ANY of these in the loop body; the repo's
+# sanctioned shape is ServeClient._backoff -> time.sleep).
+BACKOFF_CALLS: frozenset[str] = frozenset({"sleep", "wait", "backoff", "_backoff"})
+
+# Exception names whose catch marks a loop's try as a transient-IO retry.
+TRANSIENT_IO_EXCEPTIONS: frozenset[str] = frozenset(
+    {
+        "ConnectionError",
+        "ConnectionResetError",
+        "ConnectionRefusedError",
+        "BrokenPipeError",
+        "OSError",
+        "IOError",
+        "TimeoutError",
+        "timeout",
+        "ServeClientError",
+    }
+)
+
+# Async stream reads that must be timeout-bounded in serve paths (rule
+# unbounded-readline): a bare `await reader.readline()` is how one dead peer
+# pins a connection slot forever — the sanctioned form routes through
+# asyncio.wait_for (serve/server._read_line).
+UNBOUNDED_READ_CALLS: frozenset[str] = frozenset(
+    {"readline", "readexactly", "readuntil"}
 )
 
 # Per-gate matrix constructors (quantum/circuits.py, quantum/statevector.py):

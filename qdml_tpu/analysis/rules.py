@@ -911,6 +911,131 @@ def rule_pad_to_bucket_in_serve(ctx: ModuleContext) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 17/18. Resilience discipline — retry loops without backoff, unbounded reads
+# ---------------------------------------------------------------------------
+
+
+def rule_retry_without_backoff(ctx: ModuleContext) -> list[Finding]:
+    """A host-side loop that (a) re-attempts a socket/stream IO call
+    (``project.RETRY_IO_CALLS``) inside a ``try``, (b) catches a
+    transient-IO error (``ConnectionError``/``OSError``/``TimeoutError``
+    family, or a broad except) WITHOUT leaving the loop (no raise/return/
+    break in the handler — falling through IS the retry), and (c) contains
+    no backoff call (``project.BACKOFF_CALLS``: sleep/wait) anywhere in its
+    body. Hammering a struggling peer in a tight loop is how a retrying
+    client turns a blip into an outage — the repo's sanctioned shape is
+    ``ServeClient.call``'s jittered exponential backoff. Deliberately NOT
+    caught: loops whose handler exits (raise/return/break — give-up, not
+    retry), IO loops with any sleep/wait (the fix), and generic
+    ``.result()``/``.get()`` drains (far too common to flag)."""
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        # loops inside a nested function body belong to that function's own
+        # analysis pass; ast.walk of the module reaches each exactly once
+        has_backoff = any(
+            isinstance(sub, ast.Call)
+            and (
+                (ctx.canonical(sub.func) or dotted_name(sub.func) or "").rsplit(
+                    ".", 1
+                )[-1]
+                in project.BACKOFF_CALLS
+            )
+            for sub in ast.walk(node)
+        )
+        if has_backoff:
+            continue
+        for t in ast.walk(node):
+            if not isinstance(t, ast.Try):
+                continue
+            io_calls = [
+                sub
+                for stmt in t.body
+                for sub in ast.walk(stmt)
+                if isinstance(sub, ast.Call)
+                and (
+                    (ctx.canonical(sub.func) or dotted_name(sub.func) or "")
+                    .rsplit(".", 1)[-1]
+                    in project.RETRY_IO_CALLS
+                )
+            ]
+            if not io_calls:
+                continue
+            retrying = False
+            for h in t.handlers:
+                names: list[str] = []
+                if h.type is None:
+                    names = ["Exception"]
+                else:
+                    for e in ast.walk(h.type):
+                        nm = dotted_name(e)
+                        if nm:
+                            names.append(nm.rsplit(".", 1)[-1])
+                transient = any(
+                    nm in project.TRANSIENT_IO_EXCEPTIONS
+                    or nm in ("Exception", "BaseException")
+                    for nm in names
+                )
+                exits = any(
+                    isinstance(sub, (ast.Raise, ast.Return, ast.Break))
+                    for sub in ast.walk(h)
+                )
+                if transient and not exits:
+                    retrying = True
+            if retrying:
+                out.append(
+                    ctx.finding(
+                        "retry-without-backoff",
+                        io_calls[0],
+                        "loop retries an IO call after a transient "
+                        "connection error with NO sleep/backoff between "
+                        "attempts — a tight retry loop turns a peer's blip "
+                        "into an outage; back off jittered-exponentially "
+                        "between attempts (serve/client.ServeClient.call is "
+                        "the sanctioned shape)",
+                    )
+                )
+                break  # one finding per loop: the loop is the unit of fix
+    return out
+
+
+def rule_unbounded_readline(ctx: ModuleContext) -> list[Finding]:
+    """A bare ``await reader.readline()`` (or readexactly/readuntil,
+    ``project.UNBOUNDED_READ_CALLS``) in a serve-path module: with no
+    timeout, one dead or slow-loris peer pins a connection slot (and its
+    handler task) forever — the exact shape ``serve.conn_timeout_s`` exists
+    to bound. The sanctioned form awaits ``asyncio.wait_for(...)`` around
+    the read (``serve/server._read_line``), which this rule recognizes
+    because the ``await``'s direct operand is then ``wait_for``, not the
+    read. Scoped to ``serve/`` paths — async reads elsewhere (test drivers,
+    offline tooling) bound their own lifetimes."""
+    path = ctx.path.replace("\\", "/")
+    if "serve/" not in path:
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Await) or not isinstance(node.value, ast.Call):
+            continue
+        callee = (
+            ctx.canonical(node.value.func) or dotted_name(node.value.func) or ""
+        ).rsplit(".", 1)[-1]
+        if callee in project.UNBOUNDED_READ_CALLS:
+            out.append(
+                ctx.finding(
+                    "unbounded-readline",
+                    node,
+                    f"bare `await ...{callee}()` in a serve path — with no "
+                    "timeout one dead peer pins this connection slot "
+                    "forever; wrap in asyncio.wait_for with "
+                    "serve.conn_timeout_s (serve/server._read_line is the "
+                    "sanctioned helper)",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -978,6 +1103,14 @@ RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
     "pad-to-bucket-in-serve": (
         rule_pad_to_bucket_in_serve,
         "request batch padded to a static bucket outside the sanctioned batcher path",
+    ),
+    "retry-without-backoff": (
+        rule_retry_without_backoff,
+        "IO retry loop with no sleep/backoff between attempts",
+    ),
+    "unbounded-readline": (
+        rule_unbounded_readline,
+        "await reader.readline() with no timeout in serve paths",
     ),
     # "slow-marker" is data-driven (needs a --durations report) and lives in
     # qdml_tpu.analysis.slowmarkers; the CLI folds it in when given the data.

@@ -28,9 +28,13 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
     python -m qdml_tpu.cli serve  [--serve.port=8377 --serve.replicas=N ...]
                                   # online inference: restore ckpt, AOT-warm
                                   # buckets (mesh-sharded when >1 device),
-                                  # replica pool, JSON/TCP loop ({"op":
-                                  # "metrics"} live counters; {"op": "swap"}
-                                  # zero-downtime checkpoint hot-swap);
+                                  # SUPERVISED replica pool (crash restart/
+                                  # quarantine, docs/RESILIENCE.md), hardened
+                                  # JSON/TCP loop ({"op": "metrics"} live
+                                  # counters; {"op": "health"} cheap liveness;
+                                  # {"op": "swap"} zero-downtime checkpoint
+                                  # hot-swap; conn timeouts + idempotent-id
+                                  # dedup; --serve.breaker=true brownout);
                                   # --serve.batching=auto|bucket|ragged picks
                                   # pad-to-bucket coalescing vs traced
                                   # valid-count continuous batching (auto =

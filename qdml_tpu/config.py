@@ -324,6 +324,45 @@ class ServeConfig:
     # (docs/CONTROL.md). 0 disables the drifted phase.
     drift_step: int = 0
     drift_scenario: int = 0
+    # -- fault tolerance (docs/RESILIENCE.md) -------------------------------
+    # Replica supervision: the pool's supervisor thread detects dead workers
+    # (thread liveness; plus heartbeat age when stall_timeout_s > 0) and
+    # auto-restarts the replica with jittered exponential backoff
+    # (restart_backoff_s * 2^k, up to restart_budget restarts per slot). A
+    # slot that exhausts its budget is QUARANTINED (structured
+    # `replica_quarantined` event; peers keep serving).
+    supervise: bool = True
+    supervise_interval_s: float = 0.05
+    restart_backoff_s: float = 0.05
+    restart_budget: int = 3
+    # Heartbeat-age stall detection: a replica whose newest worker heartbeat
+    # is older than this WHILE the queue is non-empty is treated as dead
+    # (a hung worker pins requests exactly like a crashed one). 0 disables —
+    # thread-liveness-only supervision, the safe default on contended CI.
+    stall_timeout_s: float = 0.0
+    # Circuit breaker (brownout): when queue depth crosses
+    # breaker_high_frac * max_queue the breaker OPENS and fast-fails new
+    # submits with typed Overloaded("breaker_open") BEFORE they enqueue;
+    # after breaker_open_s it goes HALF-OPEN and admits breaker_probes
+    # probe requests — depth back under breaker_low_frac * max_queue closes
+    # it, still-high depth re-opens. False = no breaker (PR-2..12 behavior).
+    breaker: bool = False
+    breaker_high_frac: float = 0.8
+    breaker_low_frac: float = 0.3
+    breaker_open_s: float = 0.25
+    breaker_probes: int = 4
+    # Per-connection protocol hardening (serve/server.py): a connection idle
+    # (no complete line) for conn_timeout_s is reaped with a typed
+    # idle_timeout reply (0 disables); a line longer than max_line_bytes gets
+    # a typed bad_request reply and the connection closes (framing is lost
+    # mid-line — resyncing would misparse the tail as fresh requests).
+    conn_timeout_s: float = 30.0
+    max_line_bytes: int = 8_388_608
+    # Server-side idempotent-request dedup window: a retried request id
+    # re-attaches to the in-flight/just-completed result instead of
+    # double-dispatching (the client retry contract, docs/RESILIENCE.md).
+    # Entries expire after dedup_ttl_s; 0 disables dedup.
+    dedup_ttl_s: float = 30.0
     # Local socket endpoint for `qdml-tpu serve`.
     host: str = "127.0.0.1"
     port: int = 8377

@@ -40,11 +40,20 @@ from qdml_tpu.serve.batcher import (  # noqa: F401
     pick_bucket,
     power_of_two_buckets,
 )
+from qdml_tpu.serve.breaker import CircuitBreaker  # noqa: F401
+from qdml_tpu.serve.client import ServeClient, ServeClientError  # noqa: F401
 from qdml_tpu.serve.engine import ServeEngine  # noqa: F401
+from qdml_tpu.serve.faults import (  # noqa: F401
+    FAULT_CLASSES,
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+)
 from qdml_tpu.serve.loadgen import (  # noqa: F401
     arrival_times,
     make_request_samples,
     run_loadgen,
+    run_loadgen_socket,
 )
 from qdml_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from qdml_tpu.serve.server import (  # noqa: F401

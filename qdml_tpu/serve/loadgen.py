@@ -41,7 +41,9 @@ attainment into the serving-SLO gate).
 
 from __future__ import annotations
 
+import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import jax.numpy as jnp
 import numpy as np
@@ -330,7 +332,22 @@ def run_loadgen(
         # drain must not dilute the offered rate, or an overloaded server
         # would look like a slow generator and mask its own overload
         offered_elapsed = time.perf_counter() - t0
-        results = [f.result(timeout=60.0) for f in futures]
+        # Resolution accounting is part of the measurement (docs/RESILIENCE.md):
+        # a future that RESOLVES with a failure is a typed error the client
+        # saw (failed_requests); a future that never resolves is a STRANDED
+        # client — the invariant the serving stack promises never to break,
+        # and the always-armed report gate (serve.stranded_futures == 0)
+        # checks. Neither aborts the measurement.
+        results = []
+        stranded = 0
+        failed = 0
+        for f in futures:
+            try:
+                results.append(f.result(timeout=60.0))
+            except FuturesTimeout:
+                stranded += 1
+            except Exception:  # lint: disable=broad-except(a worker-forwarded failure can be ANY engine/chaos exception type — the measurement's job is to COUNT the typed closure the client saw and keep measuring, not to die on the first injected fault)
+                failed += 1
     if external_pool:
         cache_after = {
             k: max(0, v - cache_before.get(k, 0))
@@ -402,6 +419,12 @@ def run_loadgen(
         target_rps=rate,
         n_requests=n,
         n_shed=len(shed),
+        # resilience accounting (docs/RESILIENCE.md): a stranded future is a
+        # client hung forever — the always-armed report gate requires 0;
+        # failed_requests resolved WITH a typed error (clients saw closure)
+        stranded_futures=stranded,
+        failed_requests=failed,
+        breaker=None if pool.breaker is None else pool.breaker.summary(),
         arrival={"process": process, "burstiness": cfg.serve.burstiness},
         deadline_ms=deadline_ms,
         parity_max_abs_err=parity_max,
@@ -465,6 +488,184 @@ def run_loadgen(
         summary["rps_per_replica"] = round(summary["rps"] / pool.n_replicas, 2)
     metrics_all.flush(
         compile_cache=cache_after, workers=pool.workers, replicas=pool.n_replicas
+    )
+    if logger is not None:
+        logger.telemetry.write_raw(summary)
+    return summary
+
+
+def run_loadgen_socket(
+    cfg: ExperimentConfig,
+    address: tuple[str, int],
+    rate: float = 200.0,
+    n: int = 256,
+    seed: int = 0,
+    deadline_ms: float | None = None,
+    logger=None,
+    process: str | None = None,
+    clients: int = 8,
+    timeout_s: float = 30.0,
+    retries: int = 3,
+    x: np.ndarray | None = None,
+) -> dict:
+    """Open-loop traffic over the SOCKET protocol against a running server.
+
+    The wire twin of :func:`run_loadgen` (which drives an in-process pool):
+    a pool of ``clients`` :class:`~qdml_tpu.serve.client.ServeClient`
+    connections offers requests on the arrival-process clock, each exchange
+    carrying the full retry discipline — per-request timeouts, deadline
+    propagation, reconnect-with-jittered-backoff on transient resets — so a
+    mid-run ``ECONNRESET``/``BrokenPipeError`` (a restarting server, a
+    chaos-injected drop) is RECORDED (``reconnects``/``retries`` in the
+    summary) instead of aborting the measurement, and a retried id never
+    double-dispatches (server-side dedup).
+
+    Writes a ``serve_summary``-shaped record (latency measured client-side,
+    wire-to-wire; sheds from typed replies; SLO from the offered deadline;
+    ``server_metrics`` from an end-of-run ``{"op": "metrics"}`` poll, which
+    also carries the server's compile gate, faults/restarts and breaker
+    state). ``x`` overrides the request samples (the chaos harness reuses
+    one set across phases so per-phase NMSE windows are comparable)."""
+    process = process or cfg.serve.arrival
+    if process not in ARRIVAL_PROCESSES:
+        raise ValueError(
+            f"unknown arrival process {process!r} (have {ARRIVAL_PROCESSES})"
+        )
+    from concurrent.futures import ThreadPoolExecutor
+
+    from qdml_tpu.serve.client import ServeClient, ServeClientError
+
+    if x is None:
+        x = make_request_samples(cfg, n)["x"]
+    host, port = address
+    pool = [
+        ServeClient(
+            host, port, timeout_s=timeout_s, retries=retries, seed=seed + i
+        )
+        for i in range(max(1, int(clients)))
+    ]
+    rng = np.random.default_rng(seed)
+    arrivals = arrival_times(
+        n, rate, rng, process=process, burstiness=cfg.serve.burstiness
+    )
+    metrics = ServeMetrics(
+        sink=None if logger is None else logger.telemetry, log_requests=False
+    )
+    # ONE collector shared by every client thread: ServeMetrics is
+    # single-thread by contract (the serve loop gives each worker its own),
+    # so the harness serializes its bookkeeping — read-modify-write counter
+    # interleavings would silently undercount the very numbers the chaos
+    # gates read (SLO rows, sheds)
+    mlock = threading.Lock()
+    shed_counts: dict[str, int] = {}
+    give_ups = 0
+    replies: list[dict | None] = [None] * n
+
+    def _one(i: int) -> None:
+        client = pool[i % len(pool)]
+        t_req = time.perf_counter()
+        try:
+            rep = client.request(
+                x[i], rid=f"lg{seed}-{i}", deadline_ms=deadline_ms
+            )
+        except ServeClientError:
+            # counted via the client's give_ups ledger; a give-up under an
+            # offered deadline is an SLO miss (the client never got a usable
+            # answer within its budget)
+            if deadline_ms is not None:
+                with mlock:
+                    metrics.slo_total += 1
+            return
+        replies[i] = rep
+        wall = time.perf_counter() - t_req
+        if rep.get("ok"):
+            p = Prediction(
+                rid=rep.get("id"),
+                h=np.asarray(rep.get("h", ()), np.float32),
+                scenario=int(rep.get("pred", -1)),
+                latency_s=wall,
+                bucket=int(rep.get("bucket", 0)),
+                batch_n=0,
+                deadline_met=(
+                    None if deadline_ms is None else wall * 1e3 <= deadline_ms
+                ),
+                confidence=None,
+            )
+            with mlock:
+                metrics.observe_prediction(p)
+        else:
+            reason = str(rep.get("reason", "error"))
+            with mlock:
+                shed_counts[reason] = shed_counts.get(reason, 0) + 1
+                if deadline_ms is not None:
+                    metrics.slo_total += 1  # typed rejection under an SLO = a miss
+
+    t0 = time.perf_counter()
+    with span("loadgen_socket_traffic", rate_rps=rate, n=n, process=process):
+        with ThreadPoolExecutor(max_workers=len(pool)) as ex:
+            jobs = []
+            for i in range(n):
+                lag = t0 + arrivals[i] - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                jobs.append(ex.submit(_one, i))
+            offered_elapsed = time.perf_counter() - t0
+            stranded = 0
+            for j in jobs:
+                try:
+                    j.result(timeout=timeout_s * (retries + 2))
+                except FuturesTimeout:
+                    stranded += 1  # a client call that never returned at all
+    give_ups = sum(c.give_ups for c in pool)
+    server_metrics = None
+    try:
+        server_metrics = pool[0].metrics().get("metrics")
+    except (ServeClientError, ConnectionError, OSError):
+        pass  # end-of-run observability poll is best-effort
+    for c in pool:
+        c.close_connection()
+
+    import jax
+
+    metrics.completed = sum(1 for r in replies if r is not None and r.get("ok"))
+    metrics.shed = dict(shed_counts)
+    metrics._t0 = t0
+    summary = metrics.summary(
+        compile_cache=(server_metrics or {}).get("compile_cache_after_warmup"),
+        platform=jax.default_backend(),
+        transport="socket",
+        offered_rps=round(n / offered_elapsed, 2),
+        target_rps=rate,
+        n_requests=n,
+        n_shed=sum(shed_counts.values()),
+        stranded_futures=stranded,
+        give_ups=give_ups,
+        # deadline-exhausted give-ups are typed SLO misses (the client
+        # honored its budget); the DIFFERENCE — retries exhausted against a
+        # live server — is the resilience signal the chaos checks gate on
+        deadline_give_ups=sum(c.deadline_give_ups for c in pool),
+        # the resilience ledger the reconnect-instead-of-abort bugfix exists
+        # to report: transient resets during the window, retries spent
+        reconnects=sum(c.reconnects for c in pool),
+        retries=sum(c.retries_used for c in pool),
+        clients=len(pool),
+        arrival={"process": process, "burstiness": cfg.serve.burstiness},
+        deadline_ms=deadline_ms,
+        # lifted from the server poll so the report's breaker gate reads
+        # socket summaries exactly like in-process ones
+        breaker=(server_metrics or {}).get("breaker"),
+        server_metrics=(
+            None
+            if server_metrics is None
+            else {
+                k: server_metrics.get(k)
+                for k in (
+                    "workers", "replicas", "replica_completed", "queue_depth_now",
+                    "buckets", "completed", "swap_epoch", "faults", "restarts",
+                    "breaker",
+                )
+            }
+        ),
     )
     if logger is not None:
         logger.telemetry.write_raw(summary)

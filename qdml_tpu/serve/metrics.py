@@ -63,6 +63,12 @@ class ServeMetrics:
         self.batches = 0
         self.completed = 0
         self.shed: dict[str, int] = {}
+        # fault/recovery accounting (docs/RESILIENCE.md): worker crashes and
+        # batch-level engine failures observed by the serve loop (injected
+        # chaos faults included — FaultInjected counts under its kind), and
+        # supervised replica restarts. Raw sums, snapshot-differencable.
+        self.faults: dict[str, int] = {}
+        self.restarts = 0
         # SLO attainment: of the requests that CARRIED a deadline, how many
         # resolved within it. Completions feed via Prediction.deadline_met;
         # a shed request that had a deadline is a miss by definition (the
@@ -141,6 +147,12 @@ class ServeMetrics:
         if had_deadline:
             self.slo_total += 1  # shed with a deadline = an SLO miss
 
+    def observe_fault(self, kind: str) -> None:
+        """One worker-path failure (crash, batch exception, injected chaos
+        fault) — the serve loop records the KIND so a chaos run's summary
+        attributes every fault class it survived."""
+        self.faults[kind] = self.faults.get(kind, 0) + 1
+
     def merge(self, other: "ServeMetrics") -> "ServeMetrics":
         """Fold another collector into this one (``Histogram.merge`` keeps
         raw samples, so the merged quantiles are exact, not approximate).
@@ -160,6 +172,9 @@ class ServeMetrics:
         self.dispatches += other.dispatches
         for k, v in other.shed.items():
             self.shed[k] = self.shed.get(k, 0) + v
+        for k, v in other.faults.items():
+            self.faults[k] = self.faults.get(k, 0) + v
+        self.restarts += other.restarts
         for k, v in other.scenario_counts.items():
             self.scenario_counts[k] = self.scenario_counts.get(k, 0) + v
         for k, v in other.scenario_conf_sum.items():
@@ -259,6 +274,8 @@ class ServeMetrics:
                 padding_waste=self.padding_waste(),
                 rows=self.rows(),
                 shed=dict(self.shed),
+                faults=dict(self.faults),
+                restarts=self.restarts,
                 slo=self.slo(),
                 confidence=self._scaled(self.confidence),
                 per_scenario=self.per_scenario(),
@@ -284,6 +301,10 @@ class ServeMetrics:
             "completed": self.completed,
             "batches": self.batches,
             "shed": dict(self.shed),
+            # fault-tolerance accounting (docs/RESILIENCE.md): worker-path
+            # failures by kind + supervised replica restarts in this window
+            "faults": dict(self.faults),
+            "restarts": self.restarts,
             "rps": round(self.completed / elapsed, 2) if elapsed > 0 else None,
             # goodput = USEFUL rows/s: completed within deadline (or with no
             # deadline offered — a request is one row here), so sheds, LATE

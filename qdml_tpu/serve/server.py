@@ -18,21 +18,41 @@ shared queue while ANY peer — same replica or not — can still serve it; the
 LAST worker out pool-wide always drains, so nothing strands either way (the
 PR-3 hazard, generalized from one loop's threads to the whole pool).
 
+Fault tolerance (docs/RESILIENCE.md): the pool SUPERVISES its replicas — a
+supervisor thread detects dead worker threads (and, with
+``serve.stall_timeout_s``, stale heartbeats while work is queued), restarts
+the crashed replica with jittered exponential backoff under a restart
+budget, and QUARANTINES a crash-looping slot (structured
+``replica_quarantined`` event) while the peers keep serving. A
+:class:`~qdml_tpu.serve.breaker.CircuitBreaker` (``serve.breaker``) fronts
+``submit``: past the queue-depth high watermark new requests fast-fail with
+typed ``Overloaded("breaker_open")`` BEFORE they enqueue, and half-open
+probes recover it. Chaos faults inject through the explicit
+:class:`~qdml_tpu.serve.faults.FaultPlan` hooks (``faults=``; inert and free
+when absent — the default).
+
 ``qdml-tpu serve`` runs :func:`run_server`: an asyncio loop accepting
 newline-delimited JSON over a local TCP socket (``{"id", "x", [deadline_ms]}``
 -> ``{"id", "ok", "pred", "h", "latency_ms"}`` or
 ``{"id", "ok": false, "reason"}``), plus the ``{"op": "metrics"}`` live
-observability verb and the ``{"op": "swap"}`` zero-downtime checkpoint
-hot-swap verb (re-restores the newest checkpoints and swaps them under live
-traffic with zero recompiles — docs/SERVING.md). One engine, one batcher:
-concurrent connections coalesce into the same buckets, which is the entire
-point of dynamic micro-batching.
+observability verb, the ``{"op": "health"}`` liveness verb (warmup state,
+live/quarantined replicas, queue depth, last-dispatch age, swap epoch,
+breaker state — cheap enough to poll every second) and the ``{"op": "swap"}``
+zero-downtime checkpoint hot-swap verb. Connections are hardened: a
+per-connection idle/read timeout (``serve.conn_timeout_s``) reaps dead or
+slow-loris peers with a typed reply, an oversized line
+(``serve.max_line_bytes``) gets a typed ``bad_request`` and the connection
+closes, and explicit request ids are DEDUPED for ``serve.dedup_ttl_s``
+seconds — a client retrying an idempotent id re-attaches to the in-flight
+(or just-completed) result instead of double-dispatching, which is what
+makes the client-side retry/backoff discipline (serve/client.py) safe.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import random
 import threading
 import time
 from concurrent.futures import Future
@@ -42,9 +62,26 @@ import numpy as np
 
 from qdml_tpu.config import ExperimentConfig
 from qdml_tpu.serve.batcher import MicroBatcher
+from qdml_tpu.serve.breaker import CircuitBreaker
 from qdml_tpu.serve.engine import ServeEngine
+from qdml_tpu.serve.faults import FaultInjected, FaultPlan, RestartPolicy
 from qdml_tpu.serve.metrics import ServeMetrics
-from qdml_tpu.serve.types import SHUTDOWN, Overloaded, Prediction, Request
+from qdml_tpu.serve.types import (
+    BREAKER_OPEN,
+    SHUTDOWN,
+    Overloaded,
+    Prediction,
+    Request,
+)
+from qdml_tpu.telemetry.spans import get_sink
+
+
+def _emit_event(name: str, **fields) -> None:
+    """Structured fleet event (replica_restarted / replica_quarantined /
+    supervisor_error) into the run's telemetry stream, if one is active."""
+    sink = get_sink()
+    if sink is not None and getattr(sink, "active", False):
+        sink.emit("counters", name=name, **fields)
 
 
 class ExitCoordinator:
@@ -86,7 +123,10 @@ class ServeLoop:
     single-worker default keeps the PR-2 behavior and tests unchanged.
     ``exit_coord`` shares worker-exit accounting across loops (the replica
     pool passes one coordinator to all replicas); ``name`` labels the
-    threads.
+    threads. ``faults`` opts into the chaos hooks (None = inert, free);
+    ``breaker`` fronts submit with the brownout state machine (the pool
+    passes one breaker to all replicas so the front's decisions cover the
+    shared queue).
     """
 
     def __init__(
@@ -97,10 +137,13 @@ class ServeLoop:
         workers: int | None = None,
         exit_coord: ExitCoordinator | None = None,
         name: str = "serve-loop",
+        faults: FaultPlan | None = None,
+        breaker: CircuitBreaker | None = None,
     ):
         serve_cfg = engine.cfg.serve
         self.engine = engine
         self.name = name
+        self.faults = faults
         # remember whether the batcher is loop-owned: start() syncs an owned
         # batcher's admission policy (coalesce vs continuous) from the warmed
         # engine's measured batching mode; an injected batcher is the
@@ -112,6 +155,17 @@ class ServeLoop:
             max_wait_s=serve_cfg.max_wait_ms / 1e3,
             max_queue=serve_cfg.max_queue,
             continuous=engine.continuous_admission,
+        )
+        self._breaker = breaker if breaker is not None else (
+            CircuitBreaker(
+                max_queue=self.batcher.max_queue,
+                high_frac=serve_cfg.breaker_high_frac,
+                low_frac=serve_cfg.breaker_low_frac,
+                open_s=serve_cfg.breaker_open_s,
+                probes=serve_cfg.breaker_probes,
+            )
+            if serve_cfg.breaker
+            else None
         )
         self.metrics = metrics or ServeMetrics()
         self.workers = max(1, int(workers if workers is not None else serve_cfg.workers))
@@ -132,6 +186,10 @@ class ServeLoop:
         self._exit = exit_coord or ExitCoordinator()
         self._started = False  # stays True after stop(): a finished loop rejects
         self._rid = 0
+        # supervision signals (advisory, single-writer-newest-wins floats:
+        # any worker stamps them; the supervisor/health verb only AGE them)
+        self._heartbeat = 0.0          # newest worker pump iteration
+        self._last_dispatch_ts = 0.0   # newest served batch
 
     # -- client side --------------------------------------------------------
 
@@ -162,6 +220,16 @@ class ServeLoop:
             # counts pool-wide, and the peers drain the shared queue.
             fut: Future = Future()
             fut.set_result(Overloaded(rid, SHUTDOWN))
+            return fut
+        had_deadline = deadline_ms is not None or self._default_deadline_s is not None
+        if self._breaker is not None and not self._breaker.allow(self.batcher.depth):
+            # brownout: fast-fail BEFORE the queue — requests already queued
+            # keep their place, and the retrying client gets an immediate
+            # typed signal instead of a doomed queue wait (docs/RESILIENCE.md)
+            res = Overloaded(rid, BREAKER_OPEN)
+            self.metrics.observe_shed(res, had_deadline=had_deadline)
+            fut = Future()
+            fut.set_result(res)
             return fut
         now = self.batcher.clock()
         deadline_s = (
@@ -252,7 +320,32 @@ class ServeLoop:
             swap_epoch=self.engine.swap_epoch,
             dispatch=self.engine.dispatch_summary(),
             batching=self.engine.batching_summary(),
+            breaker=None if self._breaker is None else self._breaker.summary(),
         )
+
+    def health(self) -> dict:
+        """The ``{"op": "health"}`` verb's per-loop view: is anything able to
+        serve, and how stale is it. Cheap (no histogram merges — this is the
+        1 Hz poll a front-door router or the fleet controller makes)."""
+        now = time.monotonic()
+        alive = sum(t.is_alive() for t in self._threads)
+        return {
+            "warm": bool(getattr(self.engine, "_warm", False)),
+            "started": self._started,
+            "workers": self.workers,
+            "workers_alive": alive,
+            "queue_depth": self.batcher.depth,
+            "heartbeat_age_s": (
+                None if not self._heartbeat else round(now - self._heartbeat, 4)
+            ),
+            "last_dispatch_age_s": (
+                None
+                if not self._last_dispatch_ts
+                else round(now - self._last_dispatch_ts, 4)
+            ),
+            "swap_epoch": self.engine.swap_epoch,
+            "breaker": None if self._breaker is None else self._breaker.summary(),
+        }
 
     def _serve_one(self, metrics: ServeMetrics | None = None) -> bool:
         """Single batcher pump: resolve sheds, serve at most one batch.
@@ -274,6 +367,12 @@ class ServeLoop:
             # stack INSIDE the guard: a shape-mismatched request failing the
             # stack must strand nobody, exactly like an engine failure
             x = np.stack([r.x for r in batch])
+            if self.faults is not None:
+                # worker_exception site: the batch is dequeued and its
+                # futures are in hand — an injected raise here must resolve
+                # every one of them with the failure, exactly like a real
+                # engine error (that equivalence is what the chaos proves)
+                self.faults.check_worker_batch(self.name)
             h, pred, conf, info = self.engine.infer(x)
         except BaseException as e:
             # a dying batch must not strand its clients: forward the failure
@@ -283,6 +382,7 @@ class ServeLoop:
                     r.future.set_exception(e)
             raise
         dur = time.perf_counter() - t0
+        self._last_dispatch_ts = time.monotonic()
         now = self.batcher.clock()
         preds = []
         for i, r in enumerate(batch):
@@ -308,10 +408,26 @@ class ServeLoop:
     def _run(self, metrics: ServeMetrics) -> None:
         try:
             while not self._stop.is_set():
+                self._heartbeat = time.monotonic()
+                if self.faults is not None and self.batcher.depth > 0:
+                    # replica_crash site: BEFORE any dequeue and only when
+                    # work is pending (so the schedule's `at` counts
+                    # observed-work occasions) — an injected crash leaves the
+                    # queue untouched, the killed-process shape supervision
+                    # must recover from
+                    self.faults.check_worker_loop(self.name)
                 if not self._serve_one(metrics):
                     # idle: sleep until the oldest request ages out or a submit wakes us
                     self._wake.wait(timeout=max(self.batcher.wait_hint(), 1e-4))
                     self._wake.clear()
+        except FaultInjected as e:
+            # an injected chaos fault kills the worker — that IS the
+            # experiment — quietly: the expected crash must not bury the
+            # run's stderr under tracebacks (real failures re-raise below)
+            metrics.observe_fault(e.kind)
+        except BaseException as e:
+            metrics.observe_fault(type(e).__name__)
+            raise
         finally:
             # shutdown OR crash: resolve EVERYTHING still queued (no silent
             # hangs) — but only once no OTHER worker, in THIS loop or any
@@ -358,6 +474,16 @@ class ReplicaPool:
     is never removed. Removed replicas land in a retired list so their
     histograms stay in :meth:`merged_metrics` (a scale-down must not vanish
     the requests it already served).
+
+    The pool is also SUPERVISED (``serve.supervise``, docs/RESILIENCE.md): a
+    supervisor thread restarts replicas whose workers died (thread liveness;
+    plus heartbeat age under ``serve.stall_timeout_s``) with jittered
+    exponential backoff, and quarantines a slot that exhausts
+    ``serve.restart_budget`` — structured ``replica_restarted`` /
+    ``replica_quarantined`` events, peers serving throughout, the
+    zero-stranded-futures invariant intact across every restart (the crashed
+    worker's own exit path resolves what it held; the restarted workers —
+    or live peers — drain the shared queue).
     """
 
     def __init__(
@@ -368,6 +494,7 @@ class ReplicaPool:
         workers: int | None = None,
         sink=None,
         log_requests: bool = True,
+        faults: FaultPlan | None = None,
     ):
         serve_cfg = engine.cfg.serve
         self.engine = engine
@@ -385,6 +512,21 @@ class ReplicaPool:
         self._sink = sink
         self._log_requests = log_requests
         self._workers_per = workers
+        self._faults = faults
+        # ONE breaker fronts the pool: every replica's submit consults it,
+        # and since submits funnel through replica 0 the state machine sees
+        # every admission decision for the shared queue
+        self.breaker = (
+            CircuitBreaker(
+                max_queue=self.batcher.max_queue,
+                high_frac=serve_cfg.breaker_high_frac,
+                low_frac=serve_cfg.breaker_low_frac,
+                open_s=serve_cfg.breaker_open_s,
+                probes=serve_cfg.breaker_probes,
+            )
+            if serve_cfg.breaker
+            else None
+        )
         self._pool_lock = threading.Lock()
         self._started = False
         self._next_id = n_replicas
@@ -393,18 +535,40 @@ class ReplicaPool:
         ]
         # the permanent submit front: replica 0 validates/enqueues into the
         # shared feed without taking the pool lock per request (it is created
-        # here and never removed, so the hot path needs no synchronization)
+        # here and never removed — though supervision may REPLACE the object,
+        # atomically repointing this reference)
         self._front = self._replicas[0]
         self._retired: list[ServeLoop] = []
+        self._quarantined: list[ServeLoop] = []
+        # supervision state (docs/RESILIENCE.md): per-slot restart counts,
+        # the jittered-backoff policy, and the seeded rng (the FaultPlan's
+        # under chaos, so runs replay; fresh otherwise)
+        self._supervise = bool(serve_cfg.supervise)
+        self._sup_interval_s = float(serve_cfg.supervise_interval_s)
+        self._stall_timeout_s = float(serve_cfg.stall_timeout_s)
+        self._policy = RestartPolicy(
+            base_s=serve_cfg.restart_backoff_s, budget=serve_cfg.restart_budget
+        )
+        self._rng = faults.rng if faults is not None else random.Random(0)
+        self._restart_counts: dict[str, int] = {}
+        self._restart_ts: dict[str, float] = {}
+        self._restart_total = 0
+        self._sup_stop = threading.Event()
+        self._sup_thread: threading.Thread | None = None
 
     def _make_replica(self, i: int) -> ServeLoop:
+        return self._new_loop(f"serve-replica-{i}")
+
+    def _new_loop(self, name: str) -> ServeLoop:
         return ServeLoop(
             self.engine,
             batcher=self.batcher,
             metrics=ServeMetrics(sink=self._sink, log_requests=self._log_requests),
             workers=self._workers_per,
             exit_coord=self._exit,
-            name=f"serve-replica-{i}",
+            name=name,
+            faults=self._faults,
+            breaker=self.breaker,
         )
 
     @property
@@ -434,16 +598,131 @@ class ReplicaPool:
         for r in self.replicas:
             r.start()
         self._started = True
+        if self._supervise and self._sup_thread is None:
+            self._sup_stop.clear()
+            self._sup_thread = threading.Thread(
+                target=self._supervise_loop, daemon=True, name="serve-supervisor"
+            )
+            self._sup_thread.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
+        # supervisor first: it must not resurrect the replicas being stopped
+        if self._sup_thread is not None:
+            self._sup_stop.set()
+            self._sup_thread.join(timeout=10.0)
+            self._sup_thread = None
         if drain:
             while self.batcher.depth > 0 and self._exit.live() > 0:
                 self.batcher.wake.set()
                 time.sleep(0.001)
         self._started = False
-        for r in self.replicas:
+        with self._pool_lock:
+            loops = list(self._replicas) + list(self._quarantined)
+        for r in loops:
             r.stop(drain=False)
+
+    # -- supervision (docs/RESILIENCE.md) -----------------------------------
+
+    def _supervise_loop(self) -> None:
+        while not self._sup_stop.wait(self._sup_interval_s):
+            try:
+                self._check_replicas()
+            except Exception as e:  # lint: disable=broad-except(the supervisor is the last line of defense — a transient restart failure must be reported and survived, not kill supervision and strand the pool unsupervised; typed errors have nowhere better to go from this thread)
+                _emit_event(
+                    "supervisor_error", error=f"{type(e).__name__}: {e}"
+                )
+
+    def _check_replicas(self) -> None:
+        """One supervision sweep: restart (or quarantine) every replica whose
+        workers died — or, with ``serve.stall_timeout_s``, whose newest
+        heartbeat is stale while work is queued (a hung worker pins requests
+        exactly like a crashed one). Deliberately SKIPS replicas that were
+        stopped on purpose (``_stop`` set — scale-downs and shutdowns are not
+        crashes)."""
+        with self._pool_lock:
+            snapshot = list(self._replicas)
+        now = time.monotonic()
+        for loop in snapshot:
+            if not loop._started or loop._stop.is_set() or not loop._threads:
+                continue
+            dead = any(not t.is_alive() for t in loop._threads)
+            # progress = the freshest of loop-top heartbeat and last served
+            # batch: a worker deep in a LONG (but progressing) dispatch has
+            # a stale heartbeat yet a recent dispatch stamp, and must not be
+            # restarted as hung. stall_timeout_s must still exceed the
+            # worst-case batch service time — docs/RESILIENCE.md (default 0
+            # = disabled for exactly this reason).
+            progress = max(loop._heartbeat, loop._last_dispatch_ts)
+            stalled = (
+                self._stall_timeout_s > 0
+                and self.batcher.depth > 0
+                and progress > 0
+                and now - progress > self._stall_timeout_s
+            )
+            if dead or stalled:
+                self._restart_replica(
+                    loop, "worker_death" if dead else "worker_stall"
+                )
+
+    def _restart_replica(self, loop: ServeLoop, reason: str) -> None:
+        slot = loop.name
+        now = time.monotonic()
+        n = self._restart_counts.get(slot, 0)
+        # the budget counts crash LOOPS, not lifetime totals: sustained
+        # healthy serving since the last restart forgets the slot's history
+        # (a transient fault a day apart must never inch toward quarantine)
+        last = self._restart_ts.get(slot)
+        if n and last is not None and self._policy.stale(now - last):
+            n = 0
+            self._restart_counts[slot] = 0
+        if self._policy.exhausted(n):
+            # crash-looping slot: QUARANTINE — peers keep serving, the event
+            # is structured, and the slot stays visible in health() so an
+            # operator (or the fleet controller) can act on it
+            with self._pool_lock:
+                if loop not in self._replicas:
+                    return  # scaled away between the sweep and now
+                self._replicas.remove(loop)
+                self._quarantined.append(loop)
+                survivors = list(self._replicas)
+            loop.stop(drain=False)
+            if self._front is loop and survivors:
+                self._front = survivors[0]
+            _emit_event(
+                "replica_quarantined", replica=slot, reason=reason, restarts=n
+            )
+            return
+        # jittered exponential backoff BEFORE the restart: a crash-looping
+        # replica must not hot-spin warm-start cycles (budget bounds the
+        # total), and the jitter decorrelates a fleet restarting at once.
+        # The wait rides the supervisor's stop event, so pool.stop() can
+        # interrupt a long backoff instead of racing a sleeping sweep that
+        # would restart a replica into an already-stopped pool
+        delay = self._policy.delay(n, self._rng)
+        if self._sup_stop.wait(delay):
+            return  # the pool is stopping: abort the restart
+        loop.stop(drain=False)
+        fresh = self._new_loop(slot)
+        with self._pool_lock:
+            if loop not in self._replicas:
+                return  # scaled away while backing off
+            self._replicas[self._replicas.index(loop)] = fresh
+            self._retired.append(loop)
+        self._restart_counts[slot] = n + 1
+        self._restart_ts[slot] = time.monotonic()
+        self._restart_total += 1
+        fresh.metrics.restarts += 1
+        if self._front is loop:
+            self._front = fresh
+        fresh.start()
+        _emit_event(
+            "replica_restarted",
+            replica=slot,
+            reason=reason,
+            restart=n + 1,
+            backoff_s=round(delay, 4),
+        )
 
     # -- elastic scaling (the autoscaler's levers) --------------------------
 
@@ -504,10 +783,13 @@ class ReplicaPool:
     def merged_metrics(self, sink=None) -> ServeMetrics:
         """Every replica's every worker folded into one collector — exact
         quantiles across the whole pool (``Histogram.merge``), retired
-        (scaled-down) replicas included: the requests they served happened."""
+        (scaled-down) and quarantined replicas included: the requests they
+        served happened."""
         agg = ServeMetrics(sink=sink, log_requests=False)
         with self._pool_lock:
-            loops = list(self._replicas) + list(self._retired)
+            loops = (
+                list(self._replicas) + list(self._retired) + list(self._quarantined)
+            )
         for r in loops:
             for m in r._worker_metrics:
                 agg.merge(m)
@@ -533,7 +815,43 @@ class ReplicaPool:
             swap_epoch=self.engine.swap_epoch,
             dispatch=self.engine.dispatch_summary(),
             batching=self.engine.batching_summary(),
+            breaker=None if self.breaker is None else self.breaker.summary(),
         )
+
+    def health(self) -> dict:
+        """The ``{"op": "health"}`` verb: liveness/readiness without touching
+        a histogram — warmup state, live vs quarantined replicas, queue
+        depth, last-dispatch age, swap epoch, restart count, breaker state.
+        This is what a front-door router's health check (and the fleet
+        controller) polls at 1 Hz; :meth:`live_metrics` is the heavier
+        counters view."""
+        with self._pool_lock:
+            replicas = list(self._replicas)
+            quarantined = [q.name for q in self._quarantined]
+        now = time.monotonic()
+        live = sum(
+            1
+            for r in replicas
+            if r._threads and all(t.is_alive() for t in r._threads)
+        )
+        last_ts = max((r._last_dispatch_ts for r in replicas), default=0.0)
+        return {
+            "warm": bool(getattr(self.engine, "_warm", False)),
+            "replicas": len(replicas),
+            "replicas_live": live,
+            "quarantined": quarantined,
+            "workers": sum(r.workers for r in replicas),
+            "queue_depth": self.batcher.depth,
+            "last_dispatch_age_s": (
+                None if last_ts == 0.0 else round(now - last_ts, 4)
+            ),
+            "swap_epoch": self.engine.swap_epoch,
+            "restarts": self._restart_total,
+            "supervised": (
+                self._sup_thread is not None and self._sup_thread.is_alive()
+            ),
+            "breaker": None if self.breaker is None else self.breaker.summary(),
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -554,106 +872,245 @@ def _encode(res) -> dict:
     return {"id": res.rid, "ok": False, "reason": res.reason}
 
 
-async def _handle(reader, writer, loop_, swap_fn: "Callable[..., dict] | None") -> None:
-    while True:
-        line = await reader.readline()
-        if not line:
-            break
-        try:
-            msg = json.loads(line)
-        except json.JSONDecodeError:
-            writer.write(b'{"ok": false, "reason": "bad_json"}\n')
-            await writer.drain()
-            continue
-        if isinstance(msg, dict) and msg.get("op") == "metrics":
-            # live observability verb: counters/histograms/compile-cache of
-            # the RUNNING server, no restart, no inference submitted. Off the
-            # event loop: the merge copies+sorts every raw histogram sample,
-            # which is O(requests served) on a long-lived server — it must
-            # not stall every connected client's reply path while it runs.
-            metrics_view = await asyncio.get_running_loop().run_in_executor(
-                None, loop_.live_metrics
-            )
-            reply = {"id": msg.get("id"), "ok": True, "metrics": metrics_view}
-            writer.write((json.dumps(reply) + "\n").encode())
-            await writer.drain()
-            continue
-        if isinstance(msg, dict) and msg.get("op") == "swap":
-            # zero-downtime deploy verb: re-restore the newest checkpoints
-            # (or the EXPLICIT per-family "tags" the client pins — the
-            # deployer's path, so a stale *_best can never shadow a freshly
-            # fine-tuned *_last) and hot-swap them under live traffic
-            # (engine.swap_params — zero recompiles, in-flight batches keep
-            # the old params). Off the event loop: the orbax restore +
-            # device_put is host work that must not stall connected clients'
-            # reply paths.
-            if swap_fn is None:
-                reply = {"id": msg.get("id"), "ok": False,
-                         "reason": "swap_unavailable: server has no checkpoint workdir"}
-            else:
-                try:
-                    tags = msg.get("tags")
-                    if tags is not None and not (
-                        isinstance(tags, dict)
-                        and all(
-                            isinstance(k, str) and isinstance(v, str)
-                            for k, v in tags.items()
+class DedupCache:
+    """Server-side idempotent-request dedup: explicit request ids map to
+    their in-flight (or recently completed) futures for ``ttl_s`` seconds,
+    so a client RETRYING an id — after a dropped connection, a timeout, a
+    jittered backoff — re-attaches to the original dispatch instead of
+    running the request twice (docs/RESILIENCE.md, "retry contract"). The id
+    is the idempotency key: reusing one within the TTL intentionally returns
+    the original result. Thread-safe (futures resolve on worker threads
+    while the event loop inserts)."""
+
+    def __init__(self, ttl_s: float, clock: Callable[[], float] = time.monotonic):
+        self.ttl_s = float(ttl_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._entries: dict = {}  # rid -> (future, inserted_at)
+        self.hits = 0
+
+    def get_or_submit(self, rid, submit: Callable[[], Future]) -> tuple[Future, bool]:
+        """The cached future for ``rid`` (hit=True), or ``submit()``'s fresh
+        one, recorded. Validation errors from ``submit`` propagate and cache
+        nothing — a malformed retry must re-report, not pin the error."""
+        now = self.clock()
+        with self._lock:
+            # amortized O(1) eviction: entries insert in time order (always
+            # stamped with the current clock), so expired ones cluster at
+            # the head of the insertion-ordered dict — pop until fresh. A
+            # full-map rebuild here would be O(live entries) per request ON
+            # THE EVENT LOOP (≈ rate · ttl entries), stalling every
+            # connected client's reply path under sustained load.
+            while self._entries:
+                head = next(iter(self._entries))
+                if now - self._entries[head][1] < self.ttl_s:
+                    break
+                del self._entries[head]
+            ent = self._entries.get(rid)
+            if ent is not None:
+                self.hits += 1
+                return ent[0], True
+        fut = submit()
+        with self._lock:
+            self._entries[rid] = (fut, now)
+
+        def _forget_unless_served(f, rid=rid):
+            # only SERVED results stay pinned: a shed (breaker_open,
+            # queue_full, deadline) never dispatched, and a failed dispatch
+            # may succeed on retry — caching either would turn one brownout
+            # rejection into a TTL-long outage for that id. (f is done here;
+            # exception() inspects without re-raising into this callback.)
+            keep = f.exception() is None and isinstance(f.result(), Prediction)
+            if not keep:
+                with self._lock:
+                    cur = self._entries.get(rid)
+                    if cur is not None and cur[0] is f:
+                        del self._entries[rid]
+
+        fut.add_done_callback(_forget_unless_served)
+        return fut, False
+
+
+async def _read_line(reader, timeout_s: float) -> bytes:
+    """One framed line with the idle/read timeout applied (``timeout_s <= 0``
+    waits forever). Always goes through ``wait_for`` — the unbounded-readline
+    lint rule exists because a bare await here is how one dead peer pins a
+    connection slot."""
+    return await asyncio.wait_for(
+        reader.readline(), timeout_s if timeout_s > 0 else None
+    )
+
+
+async def _handle(
+    reader,
+    writer,
+    loop_,
+    swap_fn: "Callable[..., dict] | None",
+    conn_timeout_s: float = 0.0,
+    dedup: DedupCache | None = None,
+) -> None:
+    try:
+        while True:
+            try:
+                line = await _read_line(reader, conn_timeout_s)
+            except asyncio.TimeoutError:
+                # dead/stalled peer (or a slow-loris): reap the connection
+                # with a typed reply — one silent client must never pin a
+                # connection slot forever
+                writer.write(b'{"ok": false, "reason": "idle_timeout"}\n')
+                await writer.drain()
+                break
+            except (asyncio.LimitOverrunError, ValueError):
+                # a line past serve.max_line_bytes: framing is lost mid-line,
+                # so reply typed and CLOSE — resyncing would misparse the
+                # oversized tail as fresh requests
+                writer.write(
+                    b'{"ok": false, "reason": '
+                    b'"bad_request: line exceeds serve.max_line_bytes"}\n'
+                )
+                await writer.drain()
+                break
+            if not line:
+                break
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                # garbage or a partial line (a client that died mid-write):
+                # typed reply, connection survives — the NEXT line is framed
+                writer.write(b'{"ok": false, "reason": "bad_json"}\n')
+                await writer.drain()
+                continue
+            if isinstance(msg, dict) and msg.get("op") == "health":
+                # liveness/readiness verb: cheap by construction (no
+                # histogram merge — see ReplicaPool.health), safe to poll at
+                # 1 Hz from a router health check or the fleet controller
+                reply = {"id": msg.get("id"), "ok": True, "health": loop_.health()}
+                if dedup is not None:
+                    reply["health"]["dedup_hits"] = dedup.hits
+                writer.write((json.dumps(reply) + "\n").encode())
+                await writer.drain()
+                continue
+            if isinstance(msg, dict) and msg.get("op") == "metrics":
+                # live observability verb: counters/histograms/compile-cache of
+                # the RUNNING server, no restart, no inference submitted. Off the
+                # event loop: the merge copies+sorts every raw histogram sample,
+                # which is O(requests served) on a long-lived server — it must
+                # not stall every connected client's reply path while it runs.
+                metrics_view = await asyncio.get_running_loop().run_in_executor(
+                    None, loop_.live_metrics
+                )
+                reply = {"id": msg.get("id"), "ok": True, "metrics": metrics_view}
+                writer.write((json.dumps(reply) + "\n").encode())
+                await writer.drain()
+                continue
+            if isinstance(msg, dict) and msg.get("op") == "swap":
+                # zero-downtime deploy verb: re-restore the newest checkpoints
+                # (or the EXPLICIT per-family "tags" the client pins — the
+                # deployer's path, so a stale *_best can never shadow a freshly
+                # fine-tuned *_last) and hot-swap them under live traffic
+                # (engine.swap_params — zero recompiles, in-flight batches keep
+                # the old params). Off the event loop: the orbax restore +
+                # device_put is host work that must not stall connected clients'
+                # reply paths.
+                if swap_fn is None:
+                    reply = {"id": msg.get("id"), "ok": False,
+                             "reason": "swap_unavailable: server has no checkpoint workdir"}
+                else:
+                    try:
+                        tags = msg.get("tags")
+                        if tags is not None and not (
+                            isinstance(tags, dict)
+                            and all(
+                                isinstance(k, str) and isinstance(v, str)
+                                for k, v in tags.items()
+                            )
+                        ):
+                            raise ValueError(f"swap tags must be a str->str map, got {tags!r}")
+                        rec = await asyncio.get_running_loop().run_in_executor(
+                            None, swap_fn, tags
                         )
-                    ):
-                        raise ValueError(f"swap tags must be a str->str map, got {tags!r}")
-                    rec = await asyncio.get_running_loop().run_in_executor(
-                        None, swap_fn, tags
-                    )
-                    reply = {"id": msg.get("id"), "ok": True, "swap": rec}
-                except (FileNotFoundError, ValueError, RuntimeError) as e:
-                    # a missing/mismatched checkpoint is a client-visible
-                    # deploy failure, not a reason to kill the server — the
-                    # old params keep serving (swap_params validated first)
+                        reply = {"id": msg.get("id"), "ok": True, "swap": rec}
+                    except (FileNotFoundError, ValueError, RuntimeError) as e:
+                        # a missing/mismatched/CORRUPT checkpoint is a
+                        # client-visible deploy failure (CheckpointRestoreError
+                        # lands here too), not a reason to kill the server —
+                        # the old params keep serving (swap validated first)
+                        reply = {"id": msg.get("id"), "ok": False,
+                                 "reason": f"swap_failed: {e}"}
+                writer.write((json.dumps(reply) + "\n").encode())
+                await writer.drain()
+                continue
+            if isinstance(msg, dict) and msg.get("op") == "scale":
+                # replica autoscaling verb: resize the pool under live traffic
+                # (drain-safe — ReplicaPool.remove_replica never sheds a queue
+                # peers still drain). The fleet controller's remote lever.
+                if not hasattr(loop_, "scale_to"):
                     reply = {"id": msg.get("id"), "ok": False,
-                             "reason": f"swap_failed: {e}"}
-            writer.write((json.dumps(reply) + "\n").encode())
-            await writer.drain()
-            continue
-        if isinstance(msg, dict) and msg.get("op") == "scale":
-            # replica autoscaling verb: resize the pool under live traffic
-            # (drain-safe — ReplicaPool.remove_replica never sheds a queue
-            # peers still drain). The fleet controller's remote lever.
-            if not hasattr(loop_, "scale_to"):
-                reply = {"id": msg.get("id"), "ok": False,
-                         "reason": "scale_unavailable: server is not a replica pool"}
-            else:
-                try:
-                    n = int(msg["replicas"])
-                    rec = await asyncio.get_running_loop().run_in_executor(
-                        None, loop_.scale_to, n
+                             "reason": "scale_unavailable: server is not a replica pool"}
+                else:
+                    try:
+                        n = int(msg["replicas"])
+                        rec = await asyncio.get_running_loop().run_in_executor(
+                            None, loop_.scale_to, n
+                        )
+                        reply = {"id": msg.get("id"), "ok": True, "scale": rec}
+                    except (KeyError, TypeError, ValueError) as e:
+                        reply = {"id": msg.get("id"), "ok": False,
+                                 "reason": f"bad_request: {e}"}
+                writer.write((json.dumps(reply) + "\n").encode())
+                await writer.drain()
+                continue
+            try:
+                # every well-formed line gets a typed reply — a missing/ragged
+                # "x", a non-object message, a bad deadline are client errors,
+                # not reasons to drop the connection (or touch the worker).
+                # Explicit ids are IDEMPOTENCY KEYS: a retried id within the
+                # dedup TTL re-attaches to the original dispatch (never
+                # double-dispatches) and gets the identical reply.
+                rid = msg.get("id") if isinstance(msg, dict) else None
+
+                def _submit(m=msg):
+                    return loop_.submit(
+                        np.asarray(m["x"], np.float32),
+                        rid=m.get("id"),
+                        deadline_ms=m.get("deadline_ms"),
                     )
-                    reply = {"id": msg.get("id"), "ok": True, "scale": rec}
-                except (KeyError, TypeError, ValueError) as e:
-                    reply = {"id": msg.get("id"), "ok": False,
-                             "reason": f"bad_request: {e}"}
-            writer.write((json.dumps(reply) + "\n").encode())
+
+                if dedup is not None and rid is not None:
+                    fut, _ = dedup.get_or_submit(rid, _submit)
+                else:
+                    fut = _submit()
+            except (KeyError, TypeError, ValueError) as e:
+                rid = msg.get("id") if isinstance(msg, dict) else None
+                writer.write(
+                    (json.dumps({"id": rid, "ok": False, "reason": f"bad_request: {e}"}) + "\n").encode()
+                )
+                await writer.drain()
+                continue
+            try:
+                res = await asyncio.wrap_future(fut)
+            except Exception as e:  # lint: disable=broad-except(the serve loop forwards ANY dispatch failure — engine errors, injected chaos faults, DivergenceError from serve.checkify — into the future; the client must get a TYPED server_error reply it can retry (the dedup cache already forgot the failed id), not a dropped connection and an unretrieved-task warning)
+                writer.write(
+                    (json.dumps({
+                        "id": rid, "ok": False,
+                        "reason": f"server_error: {type(e).__name__}: {e}",
+                    }) + "\n").encode()
+                )
+                await writer.drain()
+                continue
+            writer.write((json.dumps(_encode(res)) + "\n").encode())
             await writer.drain()
-            continue
+    except (ConnectionResetError, BrokenPipeError):
+        # the peer vanished mid-exchange (socket_drop chaos class, a killed
+        # client): nothing to tell them, nothing stranded — any in-flight
+        # future resolved above (or resolves server-side and is dropped),
+        # and the dedup cache keeps the result for the retry
+        pass
+    finally:
         try:
-            # every well-formed line gets a typed reply — a missing/ragged
-            # "x", a non-object message, a bad deadline are client errors,
-            # not reasons to drop the connection (or touch the worker)
-            fut = loop_.submit(
-                np.asarray(msg["x"], np.float32),
-                rid=msg.get("id"),
-                deadline_ms=msg.get("deadline_ms"),
-            )
-        except (KeyError, TypeError, ValueError) as e:
-            rid = msg.get("id") if isinstance(msg, dict) else None
-            writer.write(
-                (json.dumps({"id": rid, "ok": False, "reason": f"bad_request: {e}"}) + "\n").encode()
-            )
-            await writer.drain()
-            continue
-        res = await asyncio.wrap_future(fut)
-        writer.write((json.dumps(_encode(res)) + "\n").encode())
-        await writer.drain()
-    writer.close()
+            writer.close()
+        except RuntimeError:
+            pass  # event loop already closed: test/server teardown path
 
 
 async def serve_async(
@@ -662,15 +1119,35 @@ async def serve_async(
     port: int,
     ready: "asyncio.Future | None" = None,
     swap_fn: "Callable[..., dict] | None" = None,
+    conn_timeout_s: float | None = None,
+    max_line_bytes: int | None = None,
+    dedup_ttl_s: float | None = None,
 ) -> None:
     """Accept connections until cancelled; resolves ``ready`` with the bound
     port (port=0 binds an ephemeral port — how the tests avoid collisions).
     ``loop_`` is a :class:`ServeLoop` or :class:`ReplicaPool` (both expose
-    ``submit``/``live_metrics``; a pool additionally serves the ``{"op":
-    "scale"}`` autoscaling verb); ``swap_fn(tags=None)`` arms the ``{"op":
-    "swap"}`` verb (``tags`` pins explicit checkpoint tags per family)."""
+    ``submit``/``live_metrics``/``health``; a pool additionally serves the
+    ``{"op": "scale"}`` autoscaling verb); ``swap_fn(tags=None)`` arms the
+    ``{"op": "swap"}`` verb. The hardening knobs (per-connection idle/read
+    timeout, max line bytes, dedup TTL) default to the serving config's
+    values (``serve.conn_timeout_s`` / ``max_line_bytes`` / ``dedup_ttl_s``);
+    pass explicit values to override."""
+    serve_cfg = loop_.engine.cfg.serve
+    conn_timeout_s = (
+        serve_cfg.conn_timeout_s if conn_timeout_s is None else conn_timeout_s
+    )
+    max_line_bytes = (
+        serve_cfg.max_line_bytes if max_line_bytes is None else max_line_bytes
+    )
+    dedup_ttl_s = serve_cfg.dedup_ttl_s if dedup_ttl_s is None else dedup_ttl_s
+    dedup = DedupCache(dedup_ttl_s) if dedup_ttl_s > 0 else None
     server = await asyncio.start_server(
-        lambda r, w: _handle(r, w, loop_, swap_fn), host=host, port=port
+        lambda r, w: _handle(
+            r, w, loop_, swap_fn, conn_timeout_s=conn_timeout_s, dedup=dedup
+        ),
+        host=host,
+        port=port,
+        limit=max_line_bytes,
     )
     bound = server.sockets[0].getsockname()[1]
     if ready is not None and not ready.done():
@@ -697,6 +1174,8 @@ def run_server(
                 "batching": engine.batching_summary(),
                 "replicas": pool.n_replicas,
                 "workers": pool.workers,
+                "supervised": cfg.serve.supervise,
+                "breaker": cfg.serve.breaker,
                 "mesh": engine.mesh_topology(),
                 "sharding": engine.bucket_sharding or None,
                 # post-warmup counters: anything non-zero here (or later)
@@ -725,4 +1204,5 @@ def run_server(
             compile_cache=engine.request_path_compiles(),
             workers=pool.workers,
             replicas=pool.n_replicas,
+            breaker=None if pool.breaker is None else pool.breaker.summary(),
         )

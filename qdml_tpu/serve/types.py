@@ -21,6 +21,7 @@ QUEUE_FULL = "queue_full"          # bounded queue at capacity on submit
 DEADLINE_AT_SUBMIT = "deadline_at_submit"    # deadline already past on admission
 DEADLINE_AT_DEQUEUE = "deadline_at_dequeue"  # expired while queued
 SHUTDOWN = "shutdown"              # server stopping (or its worker died)
+BREAKER_OPEN = "breaker_open"      # circuit breaker browning out new submits
 
 
 @dataclass
@@ -90,7 +91,7 @@ class Overloaded:
     """Typed load-shedding result (bounded queue / deadline admission)."""
 
     rid: int | str
-    reason: str                       # QUEUE_FULL | DEADLINE_AT_SUBMIT | DEADLINE_AT_DEQUEUE
+    reason: str                       # QUEUE_FULL | DEADLINE_* | SHUTDOWN | BREAKER_OPEN
     latency_s: float = 0.0            # time spent queued before shedding
 
     @property

@@ -110,6 +110,8 @@ def _serving_from(obj: dict) -> dict | None:
         "goodput_rps": None,
         "padding_waste": None,
         "batching": None,
+        "stranded_futures": None,
+        "breaker_open_fraction": None,
     }
     lat = obj.get("latency_ms") or {}
     for key in ("p50_ms", "p95_ms", "p99_ms"):
@@ -131,6 +133,17 @@ def _serving_from(obj: dict) -> dict | None:
             "mode": batching.get("mode"),
             "continuous_admission": batching.get("continuous_admission"),
         }
+    # resilience metrics (fault-tolerance PR): stranded futures gate
+    # always-armed at 0 (a client hung forever is a protocol violation on
+    # any hardware); the breaker open fraction gates absolutely like the
+    # overflow rate (healthy runs sit at 0.0 — ratios are meaningless)
+    if isinstance(obj.get("stranded_futures"), int):
+        out["stranded_futures"] = obj["stranded_futures"]
+    brk = obj.get("breaker")
+    if isinstance(brk, dict) and isinstance(
+        brk.get("open_fraction"), (int, float)
+    ):
+        out["breaker_open_fraction"] = float(brk["open_fraction"])
     slo = obj.get("slo")
     if isinstance(slo, dict) and isinstance(slo.get("attainment"), (int, float)):
         out["slo_attainment"] = float(slo["attainment"])
@@ -340,6 +353,13 @@ OVERFLOW_RATE_SLACK = 0.02
 # traffic's fill distribution — FLOPs burned on rows nobody asked for.
 PADDING_WASTE_SLACK = 0.05
 
+# Absolute slack on the circuit-breaker open fraction (fast-failed submits /
+# offered submits), same absolute-comparison rationale: a healthy window
+# sits at 0.0. 5 points of new brownout means the breaker spent a
+# meaningful share of the window open — either the watermarks misfit the
+# traffic or capacity regressed under it.
+BREAKER_OPEN_SLACK = 0.05
+
 
 def _lint_gate(lint_path: str | None) -> dict | None:
     """Row data from a ``qdml-tpu lint --json`` artifact. The lint gate is
@@ -410,6 +430,7 @@ def build_report_data(
     regressions: list[dict] = []
     gate_armed = True
     transfer_failed = False
+    stranded_failed = False
 
     # Lint gate (qdml-tpu lint --json artifact): folded in alongside the perf
     # gates so CI reads ONE exit code. Static analysis is host-side — the
@@ -487,6 +508,10 @@ def build_report_data(
             # (the bench loop is transfer-free by construction), so like lint
             # it forces the regression exit even under platform disarm
             "transfer_failed": transfer_failed,
+            # a stranded future (a client hung forever) violates the serving
+            # protocol's resolution invariant on ANY hardware — always-armed
+            # like lint, forces the regression exit under platform disarm
+            "stranded_failed": stranded_failed,
             "note": note,
             "markdown": "\n".join(lines),
         }
@@ -805,6 +830,41 @@ def build_report_data(
     # looks healthy.
     _absolute_gate("padding_waste", "serve.padding_waste", "batching",
                    PADDING_WASTE_SLACK, "serving padding waste")
+    # Circuit-breaker open fraction: fast-failed submits / offered submits
+    # (serve_summary.breaker.open_fraction); rising = the breaker spent a
+    # meaningful share of the window browning out — capacity regressed under
+    # the traffic, or the watermarks no longer fit it.
+    _absolute_gate("breaker_open_fraction", "serve.breaker_open_fraction",
+                   "breaker", BREAKER_OPEN_SLACK, "breaker open fraction")
+
+    # Stranded-futures gate: ALWAYS-ARMED, baseline pinned at the invariant
+    # (0), like the lint and host-transfer gates — a future that never
+    # resolved is a client hung forever, a protocol violation no platform
+    # mismatch can excuse. Reported only when the current window measured it
+    # (serve_summary.stranded_futures; old baselines without the field never
+    # disarm the check).
+    c_stranded = None
+    for c_src in curs:
+        v = (c_src.get("serving") or {}).get("stranded_futures")
+        if v is not None:
+            c_stranded = v
+    if c_stranded is not None:
+        st_status = "ok" if c_stranded == 0 else "regression"
+        gates.append(
+            {"metric": "serve.stranded_futures", "kind": "resilience",
+             "baseline": 0, "current": c_stranded, "delta_pct": None,
+             "status": st_status}
+        )
+        lines.append(
+            f"- stranded futures (always-armed, invariant 0): {c_stranded} "
+            + ("ok" if st_status == "ok" else "**REGRESSION**")
+        )
+        if st_status == "regression":
+            stranded_failed = True
+            regressions.append(
+                {"metric": "serve.stranded_futures", "baseline": 0,
+                 "current": c_stranded, "delta_pct": None}
+            )
 
     # Roofline section: achieved-vs-roofline fraction per train sub-bench
     # (bench.py details.*.roofline.fraction — telemetry/cost.py). The sign is
@@ -1152,6 +1212,7 @@ def report_main(argv: list[str]) -> int:
             (data["regressions"] and data["gate_armed"])
             or data["lint_failed"]
             or data.get("transfer_failed")
+            or data.get("stranded_failed")
         )
         else EXIT_OK
     )

@@ -135,6 +135,16 @@ def save_checkpoint(workdir: str, tag: str, payload: Any, meta: dict | None = No
     return path
 
 
+class CheckpointRestoreError(RuntimeError):
+    """An EXISTING checkpoint tag failed to restore — corrupt, truncated, or
+    partially written (e.g. a crash mid-save, a bad copy). Distinct from
+    :class:`CheckpointNotFoundError` (never trained) on purpose: "the file
+    is garbage" must never take the never-trained fallback path — the
+    serving engine's qsc -> sc downgrade would silently serve the wrong
+    model family, and a hot-swap must reply typed ``swap_failed`` while the
+    old params keep serving (docs/RESILIENCE.md)."""
+
+
 def restore_checkpoint(workdir: str, tag: str, target: Any | None = None) -> tuple[Any, dict]:
     """Restore ``workdir/tag``; returns (pytree, meta dict).
 
@@ -142,20 +152,32 @@ def restore_checkpoint(workdir: str, tag: str, target: Any | None = None) -> tup
     (a checkpoint written on the TPU stores its device sharding, which would
     otherwise fail to restore in a CPU process — e.g. eval on a host whose
     accelerator tunnel is down). jax ops consume numpy leaves transparently.
+
+    A restore failure on an EXISTING tag raises typed
+    :class:`CheckpointRestoreError` (chaining orbax's own error): callers
+    with a never-trained fallback must be able to tell "missing" from
+    "corrupt" without matching orbax internals.
     """
     path = os.path.abspath(os.path.join(workdir, tag))
     ckptr = _ckptr()
-    if target is not None:
-        restored = ckptr.restore(path, target)
-    else:
-        # orbax >=0.9 wraps the per-array metadata (.item_metadata.tree);
-        # 0.7.x returns the metadata tree directly. Both leaves carry
-        # shape/dtype, which is all the zeros-target needs.
-        md = ckptr.metadata(path)
-        meta_tree = md.item_metadata.tree if hasattr(md, "item_metadata") else md
-        restored = ckptr.restore(
-            path, jax.tree.map(lambda m: np.zeros(m.shape, m.dtype), meta_tree)
-        )
+    try:
+        if target is not None:
+            restored = ckptr.restore(path, target)
+        else:
+            # orbax >=0.9 wraps the per-array metadata (.item_metadata.tree);
+            # 0.7.x returns the metadata tree directly. Both leaves carry
+            # shape/dtype, which is all the zeros-target needs.
+            md = ckptr.metadata(path)
+            meta_tree = md.item_metadata.tree if hasattr(md, "item_metadata") else md
+            restored = ckptr.restore(
+                path, jax.tree.map(lambda m: np.zeros(m.shape, m.dtype), meta_tree)
+            )
+    except Exception as e:  # lint: disable=broad-except(orbax raises a zoo of backend-specific errors for a corrupt/truncated tree — FileNotFoundError for missing leaves, ValueError/KeyError for bad metadata, TypeError for garbage structure; ALL of them mean 'existing tag failed to restore' and must surface as the one typed error, re-raised with provenance)
+        raise CheckpointRestoreError(
+            f"checkpoint {tag!r} under {workdir!r} exists but failed to "
+            f"restore (corrupt/truncated/partially written?): "
+            f"{type(e).__name__}: {e}"
+        ) from e
     meta: dict = {}
     if os.path.exists(path + ".meta.json"):
         with open(path + ".meta.json") as fh:

@@ -1,0 +1,440 @@
+"""Seeded chaos-injection dryrun over the fault-tolerant serving stack (ISSUE 13).
+
+The resilience twin of the committed serving dryruns: force the virtual-CPU
+backend, warm ONE engine, then for EVERY fault class in
+``qdml_tpu.serve.faults.FAULT_CLASSES`` stand up a fresh supervised
+2-replica pool behind the hardened socket front-end, drive a measured
+traffic window WHILE the fault fires (worker faults through the seeded
+:class:`FaultPlan` hooks; socket faults as raw misbehaving clients; file
+faults against the checkpoint workdir / a scratch autotune table), then a
+recovery window — and prove, per class:
+
+- **zero stranded futures** (every offered request reached a typed closure;
+  the always-armed report gate),
+- **zero request-path compiles** (the engine's cumulative post-warmup
+  counter delta, checked after the LAST class — chaos never compiles),
+- **SLO re-attainment after recovery** (the recovery window's attainment
+  against the pre-chaos baseline through the ``qdml-tpu report`` gate,
+  exit 0 required),
+- the class-specific behavior (restart/quarantine events, typed
+  ``swap_failed`` on a corrupt checkpoint, idle reaps, dedup'd retries).
+
+Writes ``results/chaos_dryrun/``:
+
+- ``baseline[_tN].jsonl`` — the no-fault steady windows (manifest-headed;
+  best-of-3 by p99 anchors the headline);
+- ``{class}_fault.jsonl`` — the window the fault fires in;
+- ``{class}_recovery_tN.jsonl`` / ``{class}_base_tN.jsonl`` — interleaved
+  recovery and CONTEMPORANEOUS no-fault baseline trials (host load drifts
+  over the minutes the matrix runs; adjacent windows are the only honest
+  %-threshold comparison — behavior checks hold on EVERY trial);
+- ``report_{class}.md`` — the rendered recovery-vs-local-baseline gate;
+- ``CHAOS_DRYRUN.json`` — the headline: per-class checks + all_pass.
+
+Run: ``python scripts/chaos_dryrun.py [--n=160] [--rate=400]
+[--deadline-ms=50] [--devices=2] [--seed=0]``
+
+Virtual-device timings measure supervision/retry/protocol behavior, not
+ICI; on a real pod the same script re-runs and the same gates arm on TPU
+numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+
+def _arg(argv: list[str], name: str, default: str) -> str:
+    return next((a.split("=", 1)[1] for a in argv if a.startswith(f"--{name}=")), default)
+
+
+def main(argv: list[str]) -> int:
+    devices = int(_arg(argv, "devices", "2"))
+    n = int(_arg(argv, "n", "400"))
+    rate = float(_arg(argv, "rate", "400"))
+    deadline_ms = float(_arg(argv, "deadline-ms", "50"))
+    # Report threshold for the recovery-vs-baseline gates. The chaos gates
+    # that MATTER are absolute/invariant and ignore this entirely: stranded
+    # futures == 0 (always-armed), breaker open fraction (+0.05 absolute),
+    # and SLO re-attainment, which this script checks EXPLICITLY below
+    # (recovery attainment within 0.05 of the contemporaneous baseline's —
+    # never diluted by the threshold). The %-threshold rows (p50/p99/
+    # goodput) compare IDENTICAL code across windows, where a contended
+    # 2-core host's p99 minima swing ±30-50% between adjacent runs — 50%
+    # documents "recovered to the same regime" without a coin-flip CI. On
+    # real hardware re-runs, tighten back toward the default 10%.
+    threshold = _arg(argv, "threshold", "50")
+    seed = int(_arg(argv, "seed", "0"))
+    force_cpu(devices)
+
+    import asyncio
+    from concurrent.futures import Future
+
+    from qdml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        ServeConfig,
+        TrainConfig,
+    )
+    from qdml_tpu.serve import (
+        FAULT_CLASSES,
+        FaultPlan,
+        FaultSpec,
+        ReplicaPool,
+        ServeClient,
+        ServeEngine,
+        make_request_samples,
+        run_loadgen_socket,
+        serve_async,
+    )
+    from qdml_tpu.serve import batching_autotune
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.telemetry.report import report_main
+    from qdml_tpu.train.checkpoint import save_checkpoint
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    out_dir = os.path.join("results", "chaos_dryrun")
+    os.makedirs(out_dir, exist_ok=True)
+    scratch = tempfile.mkdtemp(prefix="chaos_")
+
+    # The raced batching table lives on scratch for this run: the
+    # autotune_corrupt class corrupts it mid-run, and the COMMITTED table
+    # under results/autotune must never be the victim.
+    batching_autotune.set_table_path(os.path.join(scratch, "serve_batching.json"))
+
+    cfg = ExperimentConfig(
+        name="chaos_dryrun",
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        serve=ServeConfig(
+            max_batch=16,
+            buckets=(4, 16),
+            max_wait_ms=2.0,
+            max_queue=64,
+            batching="auto",          # the measured race, on the scratch table
+            breaker=True,             # brownout armed; counters flow to gates
+            breaker_high_frac=0.9,
+            breaker_low_frac=0.3,
+            supervise=True,
+            supervise_interval_s=0.02,
+            restart_backoff_s=0.01,
+            restart_budget=3,
+            conn_timeout_s=1.0,       # fast idle reap for the stalled_client class
+            max_line_bytes=1 << 20,
+            dedup_ttl_s=10.0,
+        ),
+    )
+
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    clf_vars = {"params": sc_state.params}
+    # checkpoint workdir for the corrupt_swap class: healthy tags + one tag
+    # directory that exists but holds garbage instead of a checkpoint
+    workdir = os.path.join(scratch, "ckpt")
+    save_checkpoint(workdir, "hdce_last", hdce_vars)
+    save_checkpoint(workdir, "sc_last", clf_vars)
+    bad_tag = os.path.join(workdir, "hdce_bad")
+    os.makedirs(bad_tag)
+    with open(os.path.join(bad_tag, "_METADATA"), "w") as fh:
+        fh.write("garbage, not an orbax checkpoint")
+
+    engine = ServeEngine(cfg, hdce_vars, clf_vars)
+    samples = make_request_samples(cfg, n)
+    warm = engine.warmup()
+
+    def serve_window(pool, tag: str, during=None):
+        """One served traffic window behind a fresh socket front-end;
+        ``during(port)`` runs on a side thread while traffic flows (the
+        socket/file fault injections)."""
+        aloop = asyncio.new_event_loop()
+        t = threading.Thread(target=aloop.run_forever, daemon=True)
+        t.start()
+        ready: Future = Future()
+        task = asyncio.run_coroutine_threadsafe(
+            serve_async(
+                pool, "127.0.0.1", 0, ready,
+                swap_fn=lambda tags=None: engine.swap_from_workdir(workdir, tags=tags),
+            ),
+            aloop,
+        )
+        port = ready.result(timeout=30.0)
+        side_err: list = []
+        side = None
+        if during is not None:
+            def _side():
+                try:
+                    during(port)
+                except Exception as e:  # lint: disable=broad-except(the injection side thread must report its failure into the headline, not die silently and fake a passing chaos run)
+                    side_err.append(f"{type(e).__name__}: {e}")
+            side = threading.Thread(target=_side, daemon=True)
+            side.start()
+        path = os.path.join(out_dir, f"{tag}.jsonl")
+        logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+        try:
+            summary = run_loadgen_socket(
+                cfg, ("127.0.0.1", port), rate=rate, n=n, seed=seed,
+                deadline_ms=deadline_ms, logger=logger, clients=8, x=samples["x"],
+            )
+        finally:
+            logger.close()
+        if side is not None:
+            side.join(timeout=30.0)
+        task.cancel()
+        try:
+            task.result(timeout=5.0)
+        except Exception:  # lint: disable=broad-except(teardown: the cancelled server task resolves with CancelledError by design; any other shutdown error is uninteresting once the window's summary is in hand)
+            pass
+        time.sleep(0.05)  # let pending handler tasks observe the close
+        aloop.call_soon_threadsafe(aloop.stop)
+        t.join(timeout=10.0)
+        if side_err:
+            summary["injection_error"] = side_err[0]
+        return summary, path
+
+    def fresh_pool(plan=None):
+        return ReplicaPool(engine, replicas=2, faults=plan)
+
+    # ---------------- baseline: the no-fault steady window -----------------
+    # best-of-3 like the recovery windows (and every committed dryrun on
+    # this harness): the gate must compare uncontended capability on both
+    # sides, not whichever window the 2-core host happened to squeeze
+    def _p99(s):
+        return ((s["latency_ms"] or {}).get("p99_ms")) or float("inf")
+
+    # selection is by TAIL latency on both sides (goodput is offered-rate-
+    # bound in these open-loop windows, ~identical across trials; p99 is the
+    # contended-host-noise victim, so each side's best tail approximates its
+    # uncontended capability — symmetric, like the other committed dryruns)
+    pool = fresh_pool().start()
+    base_summary = base_path = None
+    for trial in range(3):
+        s, p = serve_window(pool, f"baseline_t{trial}" if trial else "baseline")
+        if base_summary is None or _p99(s) < _p99(base_summary):
+            base_summary, base_path = s, p
+    pool.stop()
+    print(json.dumps({
+        "baseline": {
+            "completed": base_summary["completed"],
+            "slo": base_summary["slo"],
+            "stranded": base_summary["stranded_futures"],
+        }
+    }), flush=True)
+
+    # ---------------- per-class injections ---------------------------------
+    def inject_socket_garbage(port):
+        with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+            sk.settimeout(10.0)
+            sk.sendall(b"NOT JSON {{{\n")
+            rep = json.loads(sk.makefile("rb").readline())
+            assert rep == {"ok": False, "reason": "bad_json"}, rep
+
+    def inject_partial_line(port):
+        sk = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+        sk.sendall(b'{"id": "frag", "x": [[')  # died mid-write
+        sk.close()
+
+    def inject_socket_drop(port):
+        sk = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+        sk.sendall(
+            (json.dumps({"id": "dropper", "x": samples["x"][0].tolist()}) + "\n").encode()
+        )
+        sk.close()  # vanished before the reply
+
+    def inject_stalled_client(port):
+        # connect, send NOTHING: the server must reap the slot at
+        # conn_timeout_s with the typed idle_timeout reply + close
+        with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+            sk.settimeout(cfg.serve.conn_timeout_s + 10.0)
+            fh = sk.makefile("rb")
+            rep = json.loads(fh.readline())
+            assert rep == {"ok": False, "reason": "idle_timeout"}, rep
+            assert fh.readline() == b""
+
+    def inject_corrupt_swap(port):
+        with ServeClient("127.0.0.1", port, timeout_s=30.0) as client:
+            rep = client.swap(tags={"hdce": "hdce_bad"})
+            assert rep["ok"] is False and "swap_failed" in rep["reason"], rep
+            # the old params kept serving; a GOOD tagged swap then lands with
+            # a zero compile delta (the PR-7 pin, now under chaos)
+            rep = client.swap(tags={"hdce": "hdce_last", "sc": "sc_last"})
+            assert rep["ok"] is True, rep
+            assert all(v == 0 for v in rep["swap"]["compile"].values()), rep
+
+    def inject_autotune_corrupt(port):
+        # mid-run table corruption: the warmed engine never re-reads it (no
+        # effect on live serving) and the dispatcher degrades instead of
+        # raising on the next read
+        scratch_table = batching_autotune.table_path()
+        with open(scratch_table, "w") as fh:
+            fh.write("{ corrupt json")
+        # invalidate() clears the installed path too — re-pin the scratch
+        # table so the degraded read (and any re-tune) never touches the
+        # COMMITTED results/autotune table
+        batching_autotune.invalidate_cache()
+        batching_autotune.set_table_path(scratch_table)
+        assert batching_autotune.load_table() == {}, batching_autotune.table_path()
+        assert batching_autotune.table_status() == "corrupt"
+        assert batching_autotune.lookup(int(cfg.serve.max_batch)) is None
+
+    injections = {
+        "socket_garbage": inject_socket_garbage,
+        "partial_line": inject_partial_line,
+        "socket_drop": inject_socket_drop,
+        "stalled_client": inject_stalled_client,
+        "corrupt_swap": inject_corrupt_swap,
+        "autotune_corrupt": inject_autotune_corrupt,
+    }
+    worker_plans = {
+        "replica_crash": lambda: FaultPlan(
+            [FaultSpec("replica_crash", at=2, replica="serve-replica-1")], seed=seed
+        ),
+        "worker_exception": lambda: FaultPlan(
+            [FaultSpec("worker_exception", at=2)], seed=seed
+        ),
+    }
+
+    headline: dict = {
+        "devices": devices, "n": n, "rate": rate, "deadline_ms": deadline_ms,
+        "report_threshold_pct": float(threshold),
+        "note": (
+            "virtual-2-core wiring proof: behavior gates (stranded futures, "
+            "SLO re-attainment within 0.05 absolute, breaker fraction, "
+            "compile delta) are absolute/invariant; the %-threshold latency "
+            "rows compare identical code across windows where host tail "
+            "noise dominates — interleaved best-of-3 by p99 per side, 50% "
+            "threshold (re-run on real hardware arms the default 10%)"
+        ),
+        "seed": seed, "buckets": list(cfg.serve.buckets),
+        "batching_race": warm["batching"]["mode"],
+        "breaker": {"high_frac": cfg.serve.breaker_high_frac,
+                    "low_frac": cfg.serve.breaker_low_frac},
+        "supervision": {"interval_s": cfg.serve.supervise_interval_s,
+                        "backoff_s": cfg.serve.restart_backoff_s,
+                        "budget": cfg.serve.restart_budget},
+        "baseline": {"path": base_path, "slo": base_summary["slo"],
+                     "completed": base_summary["completed"]},
+        "classes": {},
+    }
+    all_pass = True
+    for kind in FAULT_CLASSES:
+        plan = worker_plans[kind]() if kind in worker_plans else FaultPlan(seed=seed)
+        pool = fresh_pool(plan).start()
+        fault_summary, _fault_path = serve_window(
+            pool, f"{kind}_fault", during=injections.get(kind)
+        )
+        # recovery on the SAME pool: the restarted/survivor replicas must
+        # re-attain the SLO with zero new compiles. INTERLEAVED best-of
+        # trials against a CONTEMPORANEOUS no-fault baseline pool, like
+        # every committed dryrun on this 2-core harness: recovery BEHAVIOR
+        # (stranded/give-ups/SLO) must hold on every trial, but the
+        # %-threshold latency rows compare identical code, where host load
+        # drifts across the minutes this matrix runs — adjacent windows are
+        # the only honest comparison.
+        rec_summary = rec_path = None
+        lb_summary = lb_path = None
+        rec_trials = []
+        for trial in range(3):
+            s, p = serve_window(pool, f"{kind}_recovery_t{trial}")
+            rec_trials.append({
+                "trial": trial, "goodput_rps": s["goodput_rps"],
+                "p99_ms": (s["latency_ms"] or {}).get("p99_ms"),
+                "stranded_futures": s["stranded_futures"],
+                "give_ups": s["give_ups"],
+                "hard_give_ups": s["give_ups"] - s["deadline_give_ups"],
+                "slo": s["slo"],
+            })
+            if rec_summary is None or _p99(s) < _p99(rec_summary):
+                rec_summary, rec_path = s, p
+            bpool = fresh_pool().start()
+            sb, pb = serve_window(bpool, f"{kind}_base_t{trial}")
+            bpool.stop()
+            if lb_summary is None or _p99(sb) < _p99(lb_summary):
+                lb_summary, lb_path = sb, pb
+        health = pool.health()
+        pool.stop()
+        report_md = os.path.join(out_dir, f"report_{kind}.md")
+        rc = report_main(
+            [f"--current={rec_path}", f"--baseline={lb_path}",
+             f"--threshold={threshold}", f"--out={report_md}"]
+        )
+        checks = {
+            "stranded_futures_fault": fault_summary["stranded_futures"],
+            # behavior must hold on EVERY recovery trial (only the latency
+            # gate reads the best-goodput one)
+            "stranded_futures_recovery": max(
+                t["stranded_futures"] for t in rec_trials
+            ),
+            "give_ups_fault": fault_summary["give_ups"],
+            "give_ups_recovery": max(t["give_ups"] for t in rec_trials),
+            # retries exhausted against a live server — the alarming kind
+            # (deadline-exhausted give-ups are typed SLO misses, gated by
+            # the report's attainment row instead)
+            "hard_give_ups_recovery": max(t["hard_give_ups"] for t in rec_trials),
+            "recovery_trials": rec_trials,
+            "reconnects_fault": fault_summary["reconnects"],
+            "retries_fault": fault_summary["retries"],
+            "fired": list(plan.fired),
+            "restarts": health["restarts"],
+            "quarantined": health["quarantined"],
+            "slo_fault": fault_summary["slo"],
+            "slo_recovery": rec_summary["slo"],
+            "slo_local_baseline": lb_summary["slo"],
+            "injection_error": fault_summary.get("injection_error"),
+            "report_exit": rc,
+        }
+        # SLO re-attainment, checked ABSOLUTELY here (never diluted by the
+        # report threshold): the recovered pool must attain within 0.05 of
+        # its contemporaneous no-fault baseline
+        rec_att = (rec_summary["slo"] or {}).get("attainment")
+        lb_att = (lb_summary["slo"] or {}).get("attainment")
+        slo_ok = rec_att is not None and (lb_att is None or rec_att >= lb_att - 0.05)
+        checks["slo_reattained"] = slo_ok
+        expected_fire = kind in worker_plans
+        ok = (
+            checks["stranded_futures_fault"] == 0
+            and checks["stranded_futures_recovery"] == 0
+            and checks["hard_give_ups_recovery"] == 0
+            and checks["injection_error"] is None
+            and slo_ok
+            and rc == 0
+            and (not expected_fire or (plan.fired and health["restarts"] >= 1))
+            and not health["quarantined"]
+        )
+        checks["ok"] = ok
+        all_pass = all_pass and ok
+        headline["classes"][kind] = checks
+        print(json.dumps({kind: {k: checks[k] for k in (
+            "ok", "report_exit", "restarts", "stranded_futures_fault",
+            "stranded_futures_recovery", "reconnects_fault")}}), flush=True)
+
+    # the cumulative request-path compile gate across EVERY chaos window:
+    # eight fault classes, two traffic windows each, restarts, swaps — and
+    # not one compile after warmup
+    compile_delta = engine.request_path_compiles()
+    headline["compile_delta_after_all_classes"] = compile_delta
+    all_pass = all_pass and all(v == 0 for v in compile_delta.values())
+    headline["all_pass"] = all_pass
+    batching_autotune.set_table_path(None)
+    with open(os.path.join(out_dir, "CHAOS_DRYRUN.json"), "w") as fh:
+        json.dump(headline, fh, indent=2)
+    print(json.dumps({"all_pass": all_pass, "compile_delta": compile_delta}))
+    return 0 if all_pass else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

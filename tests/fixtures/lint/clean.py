@@ -151,6 +151,30 @@ def static_size_nonzero(x, ids):
     return idx, jnp.unique(ids, size=4, fill_value=0)
 
 
+def backing_off_retry_loop(sock, payload):
+    # retry-without-backoff's legitimate twin: jittered sleep between
+    # attempts (the ServeClient.call shape) — the loop may retry freely
+    for attempt in range(5):
+        try:
+            sock.sendall(payload)
+            return True
+        except ConnectionResetError:
+            time.sleep(0.05 * (2 ** attempt))
+            sock = reconnect()  # noqa: F821 — AST fixture
+    return False
+
+
+def giving_up_retry_loop(sock, payload):
+    # ...and a handler that EXITS the loop (raise/return/break) is a
+    # give-up, not a retry: nothing to back off from
+    for _ in range(5):
+        try:
+            sock.sendall(payload)
+            return True
+        except ConnectionResetError:
+            raise
+
+
 def reads_bucket_table(n, buckets):
     # pad-to-bucket-in-serve's legitimate twins: picking a bucket WITHOUT
     # padding into it (shape-table readers, metrics labels) is fine...

@@ -1,0 +1,20 @@
+# lint fixture: NEGATIVE cases for the serve-path-scoped resilience rules —
+# the analyzer must report NOTHING for this file. Parsed only, never
+# imported/executed.
+import asyncio
+
+
+async def handle_bounded(reader, writer, timeout_s):
+    # the sanctioned form: the await's direct operand is wait_for, which
+    # bounds the read (serve/server._read_line)
+    line = await asyncio.wait_for(reader.readline(), timeout_s)
+    writer.write(line)
+
+
+async def handle_bounded_exactly(reader, timeout_s):
+    return await asyncio.wait_for(reader.readexactly(4), timeout_s)
+
+
+async def non_read_await(queue):
+    # awaiting anything that is not a stream read is out of scope
+    return await queue.get()

@@ -1,0 +1,16 @@
+# lint fixture: POSITIVE cases for the serve-path-scoped resilience rules.
+# Lives under a `serve/` directory on purpose — unbounded-readline only
+# applies to serve paths. Parsed only, never imported/executed.
+import asyncio
+
+
+async def handle_unbounded(reader, writer):
+    # unbounded-readline: no timeout — one dead peer pins this connection
+    # slot (and its handler task) forever
+    line = await reader.readline()
+    writer.write(line)
+
+
+async def handle_unbounded_exactly(reader):
+    # unbounded-readline: readexactly is the same hazard
+    return await reader.readexactly(4)

@@ -151,6 +151,19 @@ def pads_request_batch_to_bucket(x, buckets):
     return xp
 
 
+def hammering_retry_loop(sock, payload):
+    # retry-without-backoff: transient connection errors swallowed and the
+    # send re-attempted immediately — no sleep anywhere in the loop, so a
+    # struggling peer gets hammered at CPU speed
+    for _ in range(5):
+        try:
+            sock.sendall(payload)
+            return True
+        except ConnectionResetError:
+            sock = reconnect()  # noqa: F821 — AST fixture
+    return False
+
+
 @jax.jit
 def nonzero_in_jit(x):
     # data-dependent-shape-in-jit: output length depends on runtime values

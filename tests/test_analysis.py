@@ -56,6 +56,7 @@ def test_violation_fixture_trips_every_rule():
     # dedup to their own lines: direct and via-local sit on separate lines)
     assert rules["data-dependent-shape-in-jit"] == 5
     assert rules["pad-to-bucket-in-serve"] == 1    # bucket pick + zeros pad
+    assert rules["retry-without-backoff"] == 1     # sleepless IO retry loop
     # every finding carries a usable anchor
     for f in findings:
         assert f.path.endswith("violations.py") and f.line > 0 and f.message
@@ -92,6 +93,39 @@ def test_collective_outside_shardmap_fixtures():
     findings, err = engine.lint_file("qdml_tpu/quantum/sharded.py")
     assert err is None
     assert not [f for f in findings if f.rule == "collective-outside-shardmap"]
+
+
+def test_unbounded_readline_fixtures():
+    """The serve-path resilience rule: bare awaited stream reads in serve/
+    paths are findings; the wait_for-wrapped form is clean; the identical
+    source outside a serve/ path is out of scope; and the real socket server
+    passes its own rule."""
+    from qdml_tpu.analysis.rules import rule_unbounded_readline
+
+    engine = LintEngine(REPO)
+    findings, err = engine.lint_file(f"{FIXDIR}/serve/violations.py")
+    assert err is None
+    assert _rules_found(findings) == {"unbounded-readline": 2}
+    findings, err = engine.lint_file(f"{FIXDIR}/serve/clean.py")
+    assert err is None
+    assert findings == [], _rules_found(findings)
+    # scope: the identical source under a non-serve path never fires
+    with open(f"{FIXDIR}/serve/violations.py") as fh:
+        src = fh.read()
+    assert rule_unbounded_readline(_ctx(src, "qdml_tpu/control/x.py")) == []
+    # the subsystem the rule protects is itself clean
+    findings, err = engine.lint_file("qdml_tpu/serve/server.py")
+    assert err is None
+    assert not [f for f in findings if f.rule == "unbounded-readline"]
+
+
+def test_retry_without_backoff_own_client_is_clean():
+    """The sanctioned retry shape — ServeClient.call's jittered exponential
+    backoff — passes the rule that exists because of it."""
+    engine = LintEngine(REPO)
+    findings, err = engine.lint_file("qdml_tpu/serve/client.py")
+    assert err is None
+    assert not [f for f in findings if f.rule == "retry-without-backoff"]
 
 
 def test_lock_discipline_rule_uses_project_map():

@@ -1,0 +1,744 @@
+"""Fault-tolerant serving: chaos-injection plan, replica supervision
+(restart/backoff/quarantine), circuit-breaker brownout, protocol hardening
+(timeouts, line bounds, dedup), retrying client, corrupt-checkpoint swap
+rejection, and the provably-free-when-disabled pins (docs/RESILIENCE.md).
+
+One fast fault per class runs here (the tier-1 chaos smoke); the full
+fault-class matrix with committed artifacts is scripts/chaos_dryrun.py ->
+results/chaos_dryrun/.
+"""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from qdml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    ServeConfig,
+    TrainConfig,
+)
+from qdml_tpu.serve import (
+    CircuitBreaker,
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    Overloaded,
+    Prediction,
+    ReplicaPool,
+    ServeClient,
+    ServeClientError,
+    ServeEngine,
+    ServeLoop,
+    serve_async,
+)
+from qdml_tpu.serve.faults import RestartPolicy
+from qdml_tpu.serve.types import BREAKER_OPEN, SHUTDOWN
+
+
+def _tiny_cfg(**serve_kw):
+    # identical shapes to tests/test_serve.py's engine so the persistent
+    # compile cache (conftest) shares the bucket executables across files
+    serve = dict(
+        max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=32,
+        batching="bucket",
+    )
+    serve.update(serve_kw)
+    return ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        serve=ServeConfig(**serve),
+    )
+
+
+@pytest.fixture(scope="module")
+def warmed():
+    """One warmed engine + offline reference shared by the fault tests."""
+    from qdml_tpu.serve import make_request_samples
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = _tiny_cfg()
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    clf_vars = {"params": sc_state.params}
+    engine = ServeEngine(cfg, hdce_vars, clf_vars)
+    samples = make_request_samples(cfg, 32)
+    offline_h, offline_pred, _ = engine.offline_forward(samples["x"])
+    engine.warmup()
+    return cfg, engine, samples, offline_h, offline_pred, (hdce_vars, clf_vars)
+
+
+def _fast_supervision(pool, budget=3, base_s=0.002):
+    """Tighten the pool's supervision knobs for test speed (interval/backoff
+    in the ms range; the knobs are config fields in production)."""
+    pool._sup_interval_s = 0.01
+    pool._policy = RestartPolicy(base_s=base_s, budget=budget, max_s=0.05)
+    return pool
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: deterministic schedule, typed injection, audit trail
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_schedule_and_validation():
+    plan = FaultPlan(
+        [FaultSpec("worker_exception", at=1), FaultSpec("socket_drop", at=3, times=2)],
+        seed=7,
+    )
+    assert plan.describe() == {
+        "seed": 7,
+        "faults": [
+            {"kind": "worker_exception", "at": 1, "times": 1},
+            {"kind": "socket_drop", "at": 3, "times": 2},
+        ],
+    }
+    # worker_batch occasions: 0 passes, 1 raises typed, 2 passes
+    plan.check_worker_batch("r0")
+    with pytest.raises(FaultInjected) as ei:
+        plan.check_worker_batch("r0")
+    assert ei.value.kind == "worker_exception" and ei.value.seq == 1
+    plan.check_worker_batch("r0")
+    assert plan.fired == [
+        {"kind": "worker_exception", "site": "worker_batch", "seq": 1, "replica": "r0"}
+    ]
+    # client-side classes read the same schedule
+    assert not plan.client_fault_at("socket_drop", 2)
+    assert plan.client_fault_at("socket_drop", 3)
+    assert plan.client_fault_at("socket_drop", 4)
+    assert not plan.client_fault_at("socket_drop", 5)
+    with pytest.raises(ValueError):
+        FaultSpec("not_a_fault")
+    with pytest.raises(ValueError):
+        FaultSpec("socket_drop", at=-1)
+
+
+def test_fault_plan_replica_targeting_is_per_replica():
+    """A targeted spec fires only on its replica; occasion counters are per
+    (site, replica) so one replica's traffic never advances another's
+    schedule."""
+    plan = FaultPlan([FaultSpec("replica_crash", at=0, replica="serve-replica-1")])
+    plan.check_worker_loop("serve-replica-0")  # untargeted replica: clean
+    with pytest.raises(FaultInjected):
+        plan.check_worker_loop("serve-replica-1")
+    plan.check_worker_loop("serve-replica-0")
+
+
+def test_restart_policy_backoff_is_jittered_exponential():
+    import random
+
+    pol = RestartPolicy(base_s=0.1, budget=3, jitter=0.5, max_s=10.0)
+    rng = random.Random(0)
+    d0, d1, d2 = pol.delay(0, rng), pol.delay(1, rng), pol.delay(2, rng)
+    assert 0.1 <= d0 <= 0.15 and 0.2 <= d1 <= 0.3 and 0.4 <= d2 <= 0.6
+    assert not pol.exhausted(2) and pol.exhausted(3)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker: watermark trip, brownout, half-open recovery
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_state_machine_deterministic_clock():
+    t = {"now": 0.0}
+    br = CircuitBreaker(
+        max_queue=10, high_frac=0.8, low_frac=0.3, open_s=1.0, probes=2,
+        clock=lambda: t["now"],
+    )
+    assert br.allow(depth=3) and br.state == "closed"
+    # depth hits the high watermark (8): OPEN, this submit fast-fails
+    assert not br.allow(depth=8)
+    assert br.state == "open"
+    # while open, everything fast-fails — even at depth 0 (time, not depth,
+    # closes the open window; that is what makes brownout cheap)
+    assert not br.allow(depth=0)
+    # after open_s: half-open; low depth closes immediately
+    t["now"] = 1.5
+    assert br.allow(depth=1) and br.state == "closed"
+    # trip again, recover through probes at MID depth (between watermarks):
+    # probes are finite — still-high backlog re-opens when they run out
+    assert not br.allow(depth=9)
+    t["now"] = 3.0
+    assert br.allow(depth=5) and br.state == "half_open"  # probe 1
+    assert br.allow(depth=5)                              # probe 2
+    assert not br.allow(depth=5)                          # probes spent -> re-open
+    assert br.state == "open"
+    s = br.summary()
+    assert s["opens"] == 3 and s["fast_fails"] == 4 and s["admitted"] == 4
+    assert s["open_fraction"] == pytest.approx(0.5)
+    assert s["high_watermark"] == 8 and s["low_watermark"] == 3
+
+
+def test_breaker_fronts_submit_with_typed_shed(warmed):
+    """serve.breaker=True: once queued depth crosses the watermark, submit
+    fast-fails with typed Overloaded(breaker_open) BEFORE enqueueing — the
+    queue never grows past the brownout point, and the shed is counted."""
+    cfg, engine, samples, *_ = warmed
+    import dataclasses
+
+    bcfg = dataclasses.replace(
+        cfg, serve=dataclasses.replace(
+            cfg.serve, breaker=True, breaker_high_frac=0.25, breaker_low_frac=0.1,
+            max_queue=16,
+        )
+    )
+    # same engine, breaker-enabled loop: NOT started — the queue only fills
+    eng2 = ServeEngine(bcfg, *engine.live_vars())
+    eng2._compiled = engine._compiled  # share executables: no new compiles
+    eng2._warm, eng2._stats0 = engine._warm, engine._stats0
+    eng2.batching_mode, eng2.dispatch_mode = engine.batching_mode, engine.dispatch_mode
+    loop = ServeLoop(eng2)
+    assert loop._breaker is not None
+    futs = [loop.submit(samples["x"][i % 32], rid=i) for i in range(6)]
+    # high watermark = 0.25 * 16 = 4: submits 0..3 enqueue, 4 trips, 5 fails
+    res4, res5 = futs[4].result(0.1), futs[5].result(0.1)
+    assert isinstance(res4, Overloaded) and res4.reason == BREAKER_OPEN
+    assert isinstance(res5, Overloaded) and res5.reason == BREAKER_OPEN
+    assert loop.batcher.depth == 4
+    assert loop.metrics.shed == {BREAKER_OPEN: 2}
+    s = loop._breaker.summary()
+    assert s["state"] == "open" and s["fast_fails"] == 2
+    assert loop.health()["breaker"]["state"] == "open"
+    # drain so the module engine's shared executables see no stale queue
+    loop.start()
+    assert all(
+        isinstance(f.result(timeout=30.0), (Prediction, Overloaded)) for f in futs
+    )
+    loop.stop()
+
+
+# ---------------------------------------------------------------------------
+# Supervision: worker_exception / replica_crash recovery, quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_worker_exception_resolves_batch_and_supervisor_restarts(warmed):
+    """The worker_exception fault class end-to-end on a 1-replica pool: the
+    poisoned batch's futures resolve WITH the failure (typed closure, no
+    hang), the supervisor restarts the replica, later traffic serves, and
+    the request path never compiled."""
+    cfg, engine, samples, offline_h, *_ = warmed
+    plan = FaultPlan([FaultSpec("worker_exception", at=0)])
+    pool = _fast_supervision(ReplicaPool(engine, replicas=1, faults=plan))
+    pool.start()
+    try:
+        f0 = pool.submit(samples["x"][0], rid=0)
+        with pytest.raises(FaultInjected):
+            f0.result(timeout=10.0)
+        # supervision: the crashed replica comes back and serves
+        deadline = time.monotonic() + 10.0
+        while pool._restart_total == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool._restart_total == 1
+        futs = [pool.submit(samples["x"][i], rid=i) for i in range(8)]
+        results = [f.result(timeout=30.0) for f in futs]
+        assert all(isinstance(r, Prediction) for r in results)
+        np.testing.assert_allclose(
+            np.stack([r.h for r in sorted(results, key=lambda r: r.rid)]),
+            offline_h[:8], rtol=1e-5, atol=1e-5,
+        )
+        merged = pool.merged_metrics()
+        assert merged.faults.get("worker_exception") == 1
+        assert merged.restarts == 1
+        h = pool.health()
+        assert h["replicas_live"] == 1 and h["restarts"] == 1
+        assert h["quarantined"] == [] and h["warm"] is True
+    finally:
+        pool.stop()
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+
+
+def test_replica_crash_quarantines_after_budget_peers_keep_serving(warmed):
+    """A crash-looping replica (replica_crash with times past the budget)
+    is restarted budget times, then QUARANTINED — the peer replica keeps
+    serving the shared queue throughout, and nothing strands."""
+    cfg, engine, samples, *_ = warmed
+    plan = FaultPlan(
+        [FaultSpec("replica_crash", at=0, times=50, replica="serve-replica-1")]
+    )
+    pool = _fast_supervision(
+        ReplicaPool(engine, replicas=2, faults=plan), budget=1
+    )
+    pool.start()
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            futs = [pool.submit(samples["x"][i % 32], rid=i) for i in range(8)]
+            results = [f.result(timeout=30.0) for f in futs]
+            # every future resolves — served by the peer, or shed typed in
+            # the crash window — the zero-stranded invariant under chaos
+            assert all(isinstance(r, (Prediction, Overloaded)) for r in results)
+            if pool.health()["quarantined"]:
+                break
+            time.sleep(0.02)
+        h = pool.health()
+        assert h["quarantined"] == ["serve-replica-1"]
+        assert h["replicas"] == 1 and h["replicas_live"] == 1
+        assert pool._restart_total == 1  # budget=1: one restart, then quarantine
+        # the surviving peer serves normally
+        futs = [pool.submit(samples["x"][i], rid=100 + i) for i in range(8)]
+        assert all(
+            isinstance(f.result(timeout=30.0), Prediction) for f in futs
+        )
+    finally:
+        pool.stop()
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+
+
+def test_restart_budget_decays_after_sustained_health(warmed):
+    """The budget measures crash LOOPS, not lifetime totals: a slot whose
+    last restart is older than RestartPolicy.reset_after_s forgets its
+    history — a transient fault long after an earlier one restarts instead
+    of quarantining; back-to-back faults still exhaust the budget."""
+    cfg, engine, samples, *_ = warmed
+    pol = RestartPolicy(base_s=0.001, budget=1, reset_after_s=0.05, max_s=0.01)
+    assert pol.stale(0.06) and not pol.stale(0.01)
+
+    pool = _fast_supervision(ReplicaPool(engine, replicas=1), budget=1)
+    pool._policy = pol
+    pool._supervise = False  # drive the restart path directly, no sweeps
+    pool.start()
+    try:
+        # slot crashed ONCE, long ago (stale): budget must reset -> restart
+        pool._restart_counts["serve-replica-0"] = 1
+        pool._restart_ts["serve-replica-0"] = time.monotonic() - 1.0
+        pool._restart_replica(pool.replicas[0], "worker_death")
+        assert pool.health()["quarantined"] == []
+        assert pool._restart_total == 1
+        assert pool._restart_counts["serve-replica-0"] == 1  # 0 + this one
+        # crash again IMMEDIATELY (fresh ts): budget=1 exhausts -> quarantine
+        pool._restart_replica(pool.replicas[0], "worker_death")
+        assert pool.health()["quarantined"] == ["serve-replica-0"]
+    finally:
+        pool.stop()
+
+
+def test_quarantine_event_is_emitted(warmed, tmp_path):
+    """replica_quarantined / replica_restarted are structured telemetry
+    records (the fleet controller's and operator's signal)."""
+    from qdml_tpu.telemetry import run_manifest, set_sink
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    cfg, engine, samples, *_ = warmed
+    path = str(tmp_path / "quarantine.metrics.jsonl")
+    logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+    set_sink(logger.telemetry)
+    try:
+        plan = FaultPlan([FaultSpec("replica_crash", at=0, times=50)])
+        pool = _fast_supervision(
+            ReplicaPool(engine, replicas=1, faults=plan), budget=1
+        )
+        pool.start()
+        try:
+            # keep offering work: the crash site fires on observed-work
+            # occasions, so the restarted replica must SEE requests to
+            # crash-loop its way to quarantine (every future resolves typed)
+            deadline = time.monotonic() + 15.0
+            i = 0
+            while not pool.health()["quarantined"] and time.monotonic() < deadline:
+                res = pool.submit(samples["x"][i % 32], rid=i).result(timeout=10.0)
+                assert isinstance(res, (Prediction, Overloaded))
+                i += 1
+                time.sleep(0.005)
+            assert pool.health()["quarantined"] == ["serve-replica-0"]
+            # quarantined 1-replica pool: submits shed typed, nothing hangs
+            res = pool.submit(samples["x"][1], rid=1).result(timeout=5.0)
+            assert isinstance(res, Overloaded) and res.reason == SHUTDOWN
+        finally:
+            pool.stop()
+    finally:
+        set_sink(None)
+        logger.close()
+    with open(path) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    names = [r.get("name") for r in recs if r.get("kind") == "counters"]
+    assert "replica_restarted" in names and "replica_quarantined" in names
+    q = next(r for r in recs if r.get("name") == "replica_quarantined")
+    assert q["replica"] == "serve-replica-0" and q["reason"] == "worker_death"
+    assert q["restarts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Inert-plan freedom: HLO identity + zero compiles (the "provably free" pin)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_hooks_disabled_are_provably_free(warmed):
+    """No-fault serving is byte-identical to the pre-resilience build: the
+    fused forward's lowered HLO does not mention any fault machinery (the
+    hooks are host-side only), and serving traffic with an INERT plan
+    installed performs zero request-path compiles and bit-identical
+    results."""
+    import jax
+
+    cfg, engine, samples, offline_h, *_ = warmed
+    spec = jax.ShapeDtypeStruct((4, *cfg.image_hw, 2), np.float32)
+    hdce_live, clf_live = engine.live_vars()
+    var_specs = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), (hdce_live, clf_live)
+    )
+    text_before = jax.jit(engine._forward).lower(*var_specs, spec).as_text()
+    inert = FaultPlan([])  # installed but schedules nothing
+    pool = ReplicaPool(engine, replicas=1, faults=inert).start()
+    try:
+        futs = [pool.submit(samples["x"][i], rid=i) for i in range(8)]
+        results = [f.result(timeout=30.0) for f in futs]
+    finally:
+        pool.stop()
+    assert all(isinstance(r, Prediction) for r in results)
+    np.testing.assert_allclose(
+        np.stack([r.h for r in sorted(results, key=lambda r: r.rid)]),
+        offline_h[:8], rtol=1e-5, atol=1e-5,
+    )
+    assert inert.fired == []
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+    text_after = jax.jit(engine._forward).lower(*var_specs, spec).as_text()
+    assert text_before == text_after  # the traced program never saw the plan
+
+
+# ---------------------------------------------------------------------------
+# Socket hardening: health verb, timeouts, line bounds, garbage, dedup
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def sock_server(warmed):
+    """A ServeLoop behind the asyncio socket front-end with tight hardening
+    knobs (idle timeout 0.5 s, 64 KiB lines, dedup on) and a swap_fn that
+    rejects like a corrupt checkpoint would."""
+    from qdml_tpu.train.checkpoint import CheckpointRestoreError
+
+    cfg, engine, samples, *_ = warmed
+    loop_ = ServeLoop(engine).start()
+
+    def bad_swap(tags=None):
+        raise CheckpointRestoreError("checkpoint 'hdce_bad' exists but failed to restore")
+
+    aloop = asyncio.new_event_loop()
+    t = threading.Thread(target=aloop.run_forever, daemon=True)
+    t.start()
+    ready: Future = Future()
+    task = asyncio.run_coroutine_threadsafe(
+        serve_async(
+            loop_, "127.0.0.1", 0, ready, swap_fn=bad_swap,
+            conn_timeout_s=0.5, max_line_bytes=65536, dedup_ttl_s=5.0,
+        ),
+        aloop,
+    )
+    port = ready.result(timeout=10.0)
+    yield cfg, loop_, samples, port
+    task.cancel()
+    aloop.call_soon_threadsafe(aloop.stop)
+    t.join(timeout=5.0)
+    loop_.stop()
+
+
+def test_health_verb_and_swap_failed_reply(sock_server):
+    cfg, loop_, samples, port = sock_server
+    with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+        fh = sk.makefile("rw")
+        fh.write(json.dumps({"op": "health", "id": "h1"}) + "\n")
+        fh.flush()
+        rep = json.loads(fh.readline())
+        assert rep["ok"] and rep["id"] == "h1"
+        h = rep["health"]
+        assert h["warm"] is True and h["started"] is True
+        assert h["workers_alive"] == 1 and h["queue_depth"] == 0
+        assert h["swap_epoch"] == 0 and "dedup_hits" in h
+        # a swap against a corrupt checkpoint replies typed and the server
+        # keeps serving (the old params stayed live)
+        fh.write(json.dumps({"op": "swap", "id": "s1"}) + "\n")
+        fh.flush()
+        rep = json.loads(fh.readline())
+        assert rep["ok"] is False and rep["reason"].startswith("swap_failed")
+        assert "failed to restore" in rep["reason"]
+        fh.write(json.dumps({"id": 1, "x": samples["x"][0].tolist()}) + "\n")
+        fh.flush()
+        assert json.loads(fh.readline())["ok"] is True
+
+
+def test_idle_connection_reaped_with_typed_reply(sock_server):
+    *_, port = sock_server
+    with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+        # stalled_client fault class: connect, send NOTHING — the server
+        # must reap the slot at conn_timeout_s with a typed reply + close
+        sk.settimeout(5.0)
+        fh = sk.makefile("rb")
+        line = fh.readline()
+        assert json.loads(line) == {"ok": False, "reason": "idle_timeout"}
+        assert fh.readline() == b""  # closed
+
+
+def test_oversized_line_rejected_typed(sock_server):
+    cfg, _, samples, port = sock_server
+    with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+        sk.settimeout(5.0)
+        sk.sendall(b'{"id": 1, "x": "' + b"a" * 70000 + b'"}\n')
+        fh = sk.makefile("rb")
+        rep = json.loads(fh.readline())
+        assert rep["ok"] is False and "max_line_bytes" in rep["reason"]
+        assert fh.readline() == b""  # framing lost -> connection closed
+
+
+def test_partial_line_and_drop_leave_server_healthy(sock_server):
+    cfg, loop_, samples, port = sock_server
+    # partial_line fault class: a fragment with no newline, then vanish
+    sk = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    sk.sendall(b'{"id": 1, "x": [[')
+    sk.close()
+    # socket_drop fault class: a full request, then vanish before the reply
+    sk = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    sk.sendall((json.dumps({"id": "drop", "x": samples["x"][0].tolist()}) + "\n").encode())
+    sk.close()
+    time.sleep(0.2)
+    # the server is healthy and still serves new connections
+    with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk2:
+        fh = sk2.makefile("rw")
+        fh.write(json.dumps({"id": 2, "x": samples["x"][1].tolist()}) + "\n")
+        fh.flush()
+        assert json.loads(fh.readline())["ok"] is True
+
+
+def test_dedup_retried_id_never_double_dispatches(sock_server):
+    """The retry contract's server half: re-sending an id within the dedup
+    TTL returns the SAME result without re-dispatching (completed count
+    advances once)."""
+    cfg, loop_, samples, port = sock_server
+    before = loop_.merged_metrics().completed
+    with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+        fh = sk.makefile("rw")
+        fh.write(json.dumps({"id": "dup-1", "x": samples["x"][0].tolist()}) + "\n")
+        fh.flush()
+        rep1 = json.loads(fh.readline())
+        # the retry (same id, fresh line — as after a reconnect)
+        fh.write(json.dumps({"id": "dup-1", "x": samples["x"][0].tolist()}) + "\n")
+        fh.flush()
+        rep2 = json.loads(fh.readline())
+    assert rep1["ok"] and rep2["ok"] and rep1["h"] == rep2["h"]
+    assert loop_.merged_metrics().completed == before + 1  # ONE dispatch
+    # the hit is visible in the health verb
+    with socket.create_connection(("127.0.0.1", port), timeout=10.0) as sk:
+        fh = sk.makefile("rw")
+        fh.write(json.dumps({"op": "health"}) + "\n")
+        fh.flush()
+        assert json.loads(fh.readline())["health"]["dedup_hits"] >= 1
+
+
+def test_client_retries_reconnect_and_give_up_typed(sock_server):
+    cfg, loop_, samples, port = sock_server
+    with ServeClient("127.0.0.1", port, timeout_s=10.0, retries=2,
+                     backoff_s=0.01, seed=0) as client:
+        rep = client.request(samples["x"][0], rid="c-1")
+        assert rep["ok"] is True
+        # server closes the connection under the client (idle reap at 0.5s):
+        # the next request reconnects with backoff and still succeeds
+        time.sleep(0.9)
+        rep = client.request(samples["x"][1], rid="c-2")
+        assert rep["ok"] is True
+        counters = client.counters()
+        assert counters["reconnects"] >= 1 and counters["give_ups"] == 0
+        assert client.health()["ok"] is True
+        assert client.metrics()["ok"] is True
+    # a dead endpoint exhausts retries into the typed client error
+    dead = ServeClient("127.0.0.1", 1, timeout_s=0.2, retries=1, backoff_s=0.01)
+    with pytest.raises(ServeClientError):
+        dead.request(samples["x"][0], rid="c-3")
+    assert dead.counters()["give_ups"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Corrupt checkpoints: typed restore error + swap leaves old params serving
+# ---------------------------------------------------------------------------
+
+
+def test_restore_latest_params_corrupt_tag_raises_typed(tmp_path):
+    from qdml_tpu.train.checkpoint import (
+        CheckpointNotFoundError,
+        CheckpointRestoreError,
+        restore_latest_params,
+        save_checkpoint,
+    )
+
+    wd = str(tmp_path)
+    # never trained: the typed miss
+    with pytest.raises(CheckpointNotFoundError):
+        restore_latest_params(wd, "hdce")
+    # a valid save, then TRUNCATE its array data: the tag resolves but the
+    # restore must raise the typed restore error, never the miss
+    save_checkpoint(wd, "hdce_last", {"params": {"w": np.ones(8, np.float32)}})
+    import os
+    import shutil
+
+    # truncate the checkpoint down to one garbage file: the tag directory
+    # still RESOLVES (latest_tag finds it), but every byte of tree/array
+    # data is gone — the shape a crash mid-save or a bad copy leaves behind
+    tag_dir = os.path.join(wd, "hdce_last")
+    shutil.rmtree(tag_dir)
+    os.makedirs(tag_dir)
+    with open(os.path.join(tag_dir, "_METADATA"), "w") as fh:
+        fh.write("garbage, not orbax metadata")
+    with pytest.raises(CheckpointRestoreError) as ei:
+        restore_latest_params(wd, "hdce")
+    assert not isinstance(ei.value, CheckpointNotFoundError)
+    assert "hdce_last" in str(ei.value)
+
+
+def test_corrupt_swap_rejected_old_params_keep_serving(warmed, tmp_path):
+    """The corrupt_swap chaos class at the engine level: a swap pinned to a
+    tag that exists but cannot restore raises typed, swap_epoch stays 0, and
+    the live engine serves bit-identical results after the rejection."""
+    import os
+
+    from qdml_tpu.train.checkpoint import CheckpointRestoreError, save_checkpoint
+
+    cfg, engine, samples, offline_h, _, (hdce_vars, clf_vars) = warmed
+    wd = str(tmp_path)
+    save_checkpoint(wd, "hdce_last", hdce_vars)
+    save_checkpoint(wd, "sc_last", clf_vars)
+    os.makedirs(os.path.join(wd, "hdce_bad"))  # exists, not a checkpoint
+    h_before, *_ = engine.infer(samples["x"][:4])
+    with pytest.raises(CheckpointRestoreError):
+        engine.swap_from_workdir(wd, tags={"hdce": "hdce_bad"})
+    assert engine.swap_epoch == 0
+    h_after, *_ = engine.infer(samples["x"][:4])
+    np.testing.assert_array_equal(h_before, h_after)
+    # and a GOOD swap to the same workdir's healthy tags still works
+    rec = engine.swap_from_workdir(wd, tags={"hdce": "hdce_last"})
+    assert rec["epoch"] == 1 and all(v == 0 for v in rec["compile"].values())
+
+
+# ---------------------------------------------------------------------------
+# Ragged + hot-swap pins under an injected crash (PR-7/PR-12 invariants)
+# ---------------------------------------------------------------------------
+
+
+def test_ragged_hotswap_pins_hold_under_injected_crash(warmed):
+    """The PR-12 ragged program and the PR-7 zero-recompile hot-swap survive
+    chaos: traffic on a ragged-mode 1-replica pool, a worker_exception crash
+    mid-run, supervised restart, a live hot-swap to rescaled params — every
+    future resolves, post-swap results match the rescaled reference, and the
+    request path never compiles."""
+    import dataclasses
+
+    import jax
+
+    cfg, engine, samples, _, _, (hdce_vars, clf_vars) = warmed
+    rcfg = dataclasses.replace(
+        cfg, serve=dataclasses.replace(cfg.serve, batching="ragged")
+    )
+    ragged = ServeEngine(rcfg, hdce_vars, clf_vars)
+    # rescaled checkpoint for the swap (same tree/shapes/dtypes, different
+    # numbers) + BOTH references compiled BEFORE warmup so the request-path
+    # compile gate measures serving alone
+    hdce2 = jax.tree.map(lambda a: np.asarray(a) * 1.5, hdce_vars)
+    ref_old, _, _ = ragged.offline_forward(samples["x"])
+    ref_new, _, _ = ServeEngine(rcfg, hdce2, clf_vars).offline_forward(samples["x"])
+    assert np.abs(ref_old - ref_new).max() > 0  # the swap is observable
+    ragged.warmup()
+    assert ragged.continuous_admission  # forced-ragged engine admits continuously
+    plan = FaultPlan([FaultSpec("worker_exception", at=1)])
+    pool = _fast_supervision(ReplicaPool(ragged, replicas=1, faults=plan))
+    pool.start()
+    try:
+        futs = [pool.submit(samples["x"][i], rid=i) for i in range(12)]
+        results = []
+        for f in futs:
+            try:
+                results.append(f.result(timeout=30.0))
+            except FaultInjected:
+                results.append(None)  # the poisoned batch: typed closure
+        assert any(r is None for r in results)  # the crash actually fired
+        ok = [r for r in results if isinstance(r, Prediction)]
+        for r in ok:
+            np.testing.assert_allclose(r.h, ref_old[r.rid], rtol=1e-5, atol=1e-5)
+        # wait out the restart, then hot-swap under the recovered pool
+        deadline = time.monotonic() + 10.0
+        while pool._restart_total == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool._restart_total == 1
+        rec = ragged.swap_params(hdce2, clf_vars)
+        assert rec["epoch"] == 1 and all(v == 0 for v in rec["compile"].values())
+        futs = [pool.submit(samples["x"][i], rid=100 + i) for i in range(12)]
+        post = [f.result(timeout=30.0) for f in futs]
+        assert all(isinstance(r, Prediction) for r in post)
+        for r in post:
+            np.testing.assert_allclose(
+                r.h, ref_new[r.rid - 100], rtol=1e-5, atol=1e-5
+            )
+    finally:
+        pool.stop()
+    assert ragged.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+
+
+# ---------------------------------------------------------------------------
+# Report gates: stranded-futures (always-armed) + breaker open fraction
+# ---------------------------------------------------------------------------
+
+
+def _summary_jsonl(tmp_path, name, **over):
+    rec = {
+        "kind": "serve_summary", "platform": "cpu", "rps": 100.0,
+        "completed": 100, "batches": 10, "shed": {},
+        "latency_ms": {"n": 100, "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+                       "mean_ms": 1.0, "max_ms": 3.0},
+        "stranded_futures": 0,
+        "breaker": {"state": "closed", "opens": 0, "fast_fails": 0,
+                    "admitted": 100, "open_fraction": 0.0},
+    }
+    rec.update(over)
+    p = tmp_path / name
+    p.write_text(json.dumps(rec) + "\n")
+    return str(p)
+
+
+def test_report_stranded_futures_gate_always_armed(tmp_path):
+    from qdml_tpu.telemetry.report import EXIT_REGRESSION, build_report_data, report_main
+
+    base = _summary_jsonl(tmp_path, "base.jsonl")
+    good = _summary_jsonl(tmp_path, "good.jsonl")
+    data = build_report_data([good], base)
+    row = next(g for g in data["gates"] if g["metric"] == "serve.stranded_futures")
+    assert row["status"] == "ok" and data["stranded_failed"] is False
+    # one stranded future fails — even under a platform-mismatch disarm
+    bad = _summary_jsonl(tmp_path, "bad.jsonl", stranded_futures=2, platform="tpu")
+    data = build_report_data([bad], base)
+    assert data["gate_armed"] is False  # platform mismatch disarms perf...
+    assert data["stranded_failed"] is True  # ...but never this invariant
+    row = next(g for g in data["gates"] if g["metric"] == "serve.stranded_futures")
+    assert row["status"] == "regression" and row["baseline"] == 0
+    assert report_main([f"--current={bad}", f"--baseline={base}"]) == EXIT_REGRESSION
+    assert report_main([f"--current={good}", f"--baseline={base}"]) == 0
+
+
+def test_report_breaker_open_fraction_absolute_gate(tmp_path):
+    from qdml_tpu.telemetry.report import build_report_data
+
+    base = _summary_jsonl(tmp_path, "base.jsonl")
+    # within slack (0.05): ok; beyond: regression — ABSOLUTE comparison
+    ok = _summary_jsonl(
+        tmp_path, "ok.jsonl",
+        breaker={"open_fraction": 0.03, "state": "closed", "opens": 1,
+                 "fast_fails": 3, "admitted": 97},
+    )
+    data = build_report_data([ok], base)
+    row = next(g for g in data["gates"] if g["metric"] == "serve.breaker_open_fraction")
+    assert row["status"] == "ok"
+    bad = _summary_jsonl(
+        tmp_path, "brk.jsonl",
+        breaker={"open_fraction": 0.2, "state": "open", "opens": 4,
+                 "fast_fails": 20, "admitted": 80},
+    )
+    data = build_report_data([bad], base)
+    row = next(g for g in data["gates"] if g["metric"] == "serve.breaker_open_fraction")
+    assert row["status"] == "regression"
+    assert any(r["metric"] == "serve.breaker_open_fraction" for r in data["regressions"])

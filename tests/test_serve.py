@@ -629,21 +629,29 @@ def test_latest_tag_preference_and_eval_only_restore(tmp_path):
 
 def test_from_workdir_corrupt_qsc_fails_loud_never_downgrades(tmp_path):
     """A qsc tag that EXISTS but fails to restore (partial/corrupt write)
-    must propagate, not silently fall back to the classical classifier — a
-    quantum deployment quietly serving SCP128 is the worst failure mode.
-    Only the typed never-trained miss (CheckpointNotFoundError) downgrades."""
+    must propagate as the TYPED restore error, not silently fall back to the
+    classical classifier — a quantum deployment quietly serving SCP128 is
+    the worst failure mode. Only the typed never-trained miss
+    (CheckpointNotFoundError) downgrades. Since the resilience PR the
+    failure is typed CheckpointRestoreError (a RuntimeError, NOT a
+    FileNotFoundError), so no fallback keyed on the never-trained miss can
+    ever confuse the two."""
     import os
 
-    from qdml_tpu.train.checkpoint import CheckpointNotFoundError, save_checkpoint
+    from qdml_tpu.train.checkpoint import (
+        CheckpointNotFoundError,
+        CheckpointRestoreError,
+        save_checkpoint,
+    )
 
     wd = str(tmp_path)
     save_checkpoint(wd, "hdce_last", {"params": {"w": np.ones(4, np.float32)}})
     save_checkpoint(wd, "sc_last", {"params": {"w": np.ones(4, np.float32)}})
     # corrupt qsc: the tag directory resolves (latest_tag finds it) but
-    # orbax's restore raises — a FileNotFoundError, the exact shape a broad
-    # except would confuse with "never trained"
+    # orbax's restore raises — underneath it is a FileNotFoundError, the
+    # exact shape a broad except would confuse with "never trained"
     os.makedirs(os.path.join(wd, "qsc_last"))
-    with pytest.raises(FileNotFoundError) as ei:
+    with pytest.raises(CheckpointRestoreError) as ei:
         ServeEngine.from_workdir(_tiny_cfg(), wd)
     assert not isinstance(ei.value, CheckpointNotFoundError)  # the restore failure, not the miss
 
