@@ -85,6 +85,31 @@ LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
             "_fast_fails": "_lock",
         }
     },
+    # fleet-router cross-thread state (docs/FLEET.md): the per-backend
+    # ejection state machine is driven by request executor threads AND the
+    # health poll thread at once (an unlocked transition could re-admit a
+    # host mid-ejection); the fleet-wide dedup table is shared by every
+    # front-door request thread (the server-side DedupCache race, one tier
+    # up); the wire-metrics ledger and the connection pool are touched by
+    # every concurrent forward.
+    "qdml_tpu/fleet/router.py": {
+        "BackendState": {
+            "_state": "_lock",
+            "_fails": "_lock",
+            "_oks": "_lock",
+            "_opened_at": "_lock",
+            "_ejections": "_lock",
+            "_readmissions": "_lock",
+        },
+        "Backend": {
+            "_latency": "_mlock",
+            "_forwarded": "_mlock",
+            "_failed": "_mlock",
+            "_clients": "_clients_lock",
+            "_made": "_clients_lock",
+        },
+        "RouterDedup": {"_entries": "_lock"},
+    },
     # fleet-control shared state (docs/CONTROL.md): the controller tick
     # thread writes these while status/report paths read them
     "qdml_tpu/control/drift.py": {

@@ -52,6 +52,15 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
                                   # detect per-scenario drift, fine-tune the
                                   # drifted trunk, canary-gate + hot-swap,
                                   # watch/rollback, autoscale replicas
+    python -m qdml_tpu.cli route  [--fleet.backends=h:p,h:p ...]
+                                  # fleet router tier (docs/FLEET.md): front
+                                  # door speaking the serve protocol, fanning
+                                  # requests over backend serve processes
+                                  # (--fleet.balance=hash|least_queue),
+                                  # breaker-style ejection/re-admission,
+                                  # swap fan-out + metrics/health aggregation
+                                  # (point `control` at fleet.host:fleet.port
+                                  # to supervise the whole fleet)
 
 Every command's metrics JSONL starts with a run-manifest header (config hash,
 git SHA, device topology, perf knobs, seeds) and carries span/counter records
@@ -87,6 +96,7 @@ _COMMANDS = (
     "serve",
     "loadgen",
     "control",
+    "route",
 )  # "report" and "lint" dispatch before config parsing (no jax, no workdir)
 
 _PASSTHROUGH = (  # command args, not config overrides
@@ -398,6 +408,12 @@ def main(argv: list[str] | None = None) -> int:
             # over the metrics/swap/scale verbs; fine-tune + canary run in
             # this process against the shared workdir (docs/CONTROL.md)
             return control_main(cfg, logger=logger, workdir=workdir, ticks=ticks)
+        elif cmd == "route":
+            from qdml_tpu.fleet import run_router
+
+            # pure protocol tier: no checkpoints, no device compute — the
+            # backends named by fleet.backends own the models (docs/FLEET.md)
+            run_router(cfg, logger=logger)
         # reference prints total minutes (Runner...py:437-440)
         print(f"total time: {(time.time() - t0) / 60.0:.2f} min")
         return 0

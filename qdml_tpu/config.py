@@ -369,6 +369,56 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Fleet router tier (:mod:`qdml_tpu.fleet`, docs/FLEET.md): a front-door
+    process (``qdml-tpu route``) that speaks the newline-JSON serve protocol
+    on its own socket and fans requests out over N backend ``qdml-tpu
+    serve`` processes ("hosts") through the :class:`~qdml_tpu.serve.client.
+    ServeClient` retry/dedup/deadline contract — the tier between "one hot
+    process" and "a fleet". Balancing is pluggable; per-backend health
+    tracking ejects failing hosts with breaker-style state-machine semantics
+    and re-admits them through half-open probes driven by the health poll;
+    ``swap``/``scale``/``metrics``/``health`` verbs fan out / aggregate."""
+
+    # Comma-separated backend endpoints ("127.0.0.1:8377,127.0.0.1:8380").
+    # Empty = the single local serve endpoint at serve.host:serve.port.
+    backends: str = ""
+    # Balancing policy: "hash" routes each request id onto a consistent-hash
+    # ring over the live backends (retries of one id land on one host, where
+    # the server-side dedup window holds); "least_queue" routes to the live
+    # backend with the shallowest queue as of the last health poll.
+    balance: str = "hash"
+    # Breaker-style ejection (serve/breaker.py semantics, per backend):
+    # eject_failures CONSECUTIVE transport failures open the backend (no
+    # traffic); after eject_s it goes half-open and the health poll (or a
+    # routed probe request) spends readmit_probes successful probes to close
+    # it again — one failure in half-open re-opens.
+    eject_failures: int = 3
+    eject_s: float = 1.0
+    readmit_probes: int = 2
+    # Health-poll cadence: drives least_queue balancing freshness, ejection
+    # of silently dead hosts, and half-open re-admission probing.
+    poll_interval_s: float = 0.5
+    # Failover breadth: how many ALTERNATE backends a request may try after
+    # its primary fails (bounded — a fleet-wide brownout must fail fast with
+    # a typed reply, not sweep every host per request).
+    failover: int = 2
+    # Per-forward ServeClient discipline: socket timeout and SAME-BACKEND
+    # retries before the router fails over to the next host.
+    timeout_s: float = 10.0
+    retries: int = 1
+    # Router-side idempotent-id dedup window: a retried id re-attaches to
+    # the in-flight (or just-served) forward instead of re-dispatching —
+    # fleet-WIDE, so dedup holds across router failover, not just within one
+    # backend's server-side window. 0 disables.
+    dedup_ttl_s: float = 30.0
+    # Front-door socket endpoint for `qdml-tpu route` (connection hardening
+    # reuses serve.conn_timeout_s / serve.max_line_bytes).
+    host: str = "127.0.0.1"
+    port: int = 8378
+
+
+@dataclass(frozen=True)
 class ControlConfig:
     """Fleet control plane (:mod:`qdml_tpu.control`, docs/CONTROL.md): the
     closed serve -> detect -> adapt -> deploy loop. One supervised controller
@@ -449,6 +499,7 @@ class ExperimentConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
 
     # Geometry-derived model dimensions. Single-sourced from DataConfig so a

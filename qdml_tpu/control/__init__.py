@@ -27,7 +27,10 @@ actually face, not the clean ones they were born in):
   :meth:`~qdml_tpu.serve.server.ReplicaPool.remove_replica` levers;
 - :mod:`~qdml_tpu.control.loop` — :class:`FleetController` wiring it all
   into one supervised loop (``qdml-tpu control``), with a dry-run mode that
-  reports every decision and takes none.
+  reports every decision and takes none. Attached through
+  :class:`~qdml_tpu.fleet.poller.FleetPoller` (or ``SocketPoller`` at the
+  router's front address) the SAME loop supervises a multi-process fleet
+  behind ``qdml-tpu route`` — docs/FLEET.md.
 
 Knobs: :class:`qdml_tpu.config.ControlConfig`. Record schemas + operational
 guidance: ``docs/CONTROL.md``. The committed closed-loop proof:

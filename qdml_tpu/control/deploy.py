@@ -169,6 +169,14 @@ class Deployer:
         # NOT from latest_tag — best > last would re-introduce the exact
         # stale-best-shadows-fresh-last bug the explicit-tag swap fixes
         self._live_tags: dict | None = None
+        # the last canaried candidate: (tag, hdce_vars, clf_vars). An
+        # engine-less deploy of that SAME tag binds these as the live
+        # baseline (zero extra restores) — the fine-tune tag is REUSED
+        # (hdce_last) every episode, so re-resolving the tracked tag at the
+        # next episode's canary, after fine-tune overwrote it, would restore
+        # the next candidate and compare it to itself (gain exactly 0,
+        # adaptation permanently aborted)
+        self._pending_cand: tuple | None = None
 
     def _emit(self, action: str, **payload) -> dict:
         return emit_record(
@@ -215,6 +223,14 @@ class Deployer:
         if quantum is not None:
             self._quantum = quantum
 
+    def live_hdce_tag(self) -> str | None:
+        """The hdce tag this deployer last deployed (None before any
+        deploy) — the continual fine-tune's warm-start base: each episode
+        must build on the tree that is actually SERVING, or a second
+        episode's reassembly would silently revert the first episode's
+        adapted trunk to the original checkpoint."""
+        return (self._live_tags or {}).get("hdce")
+
     # -- canary -------------------------------------------------------------
 
     def canary(
@@ -225,6 +241,7 @@ class Deployer:
         this passed."""
         cand_vars, _ = restore_params(self.workdir, candidate_tag)
         live_hdce, clf = self._live_vars()
+        self._pending_cand = (candidate_tag, cand_vars, clf)
         with span("control_canary", scenario=scenario, tag=candidate_tag):
             # one compiled forward per SIDE for the whole canary (every
             # probe set shares probe_n, so the program never re-traces)
@@ -287,6 +304,17 @@ class Deployer:
             return self._emit("deploy", tags=tags, skipped="dry_run")
         rec = self._swap_fn(tags)
         self._live_tags = {**(self._live_tags or {}), **tags}
+        pend = self._pending_cand
+        if pend is not None and pend[0] == tags.get("hdce"):
+            # bind the canary's already-restored candidate as the live
+            # baseline (see _pending_cand above — zero extra restores, and
+            # the next episode compares against what is actually serving).
+            # UNCONDITIONAL on purpose: gating on `_live_hdce is None` would
+            # fire only on the FIRST deploy and leave every later episode's
+            # canary comparing against episode 1's tree; the in-process
+            # controller overwrites this with the engine's live view right
+            # after deploy anyway (loop.py), so both modes stay correct.
+            self.set_live(pend[1], pend[2])
         with self._lock:
             self._watch = {
                 "ticks_left": self.watch_ticks,

@@ -14,7 +14,7 @@ adaptation pipeline:
       -> watch window             # served stats; auto-rollback on regress
       -> DriftMonitor.reset       # re-arm against the adapted distribution
 
-Two attachment modes share all of that logic:
+Three attachment modes share all of that logic:
 
 - **in-process** (:class:`PoolPoller`) — the controller holds the
   :class:`~qdml_tpu.serve.server.ReplicaPool` and
@@ -24,7 +24,14 @@ Two attachment modes share all of that logic:
   attaches to a running ``qdml-tpu serve`` endpoint over the
   ``metrics``/``swap``/``scale`` verbs and shares only the checkpoint
   workdir; fine-tune and canary run in the controller's process, so the
-  serving process's request path never compiles.
+  serving process's request path never compiles;
+- **fleet** (:class:`~qdml_tpu.fleet.poller.FleetPoller`, docs/FLEET.md) —
+  the same verbs against a ``qdml-tpu route`` front door: drift detection
+  windows the AGGREGATED per-scenario counters (raw sums difference
+  exactly), tagged swaps fan out to every live backend, and scale targets
+  the fleet total while the router chooses WHICH host to resize. Because
+  the router speaks the serve protocol verbatim, ``SocketPoller`` pointed
+  at ``fleet.host:fleet.port`` is the remote form — nothing here changes.
 
 Drift-step hint: in this reproduction the drifted channel family is
 SYNTHESIZED (``family_table`` drift trajectories) — the controller cannot
@@ -274,7 +281,12 @@ class FleetController:
             )
         self._attempts[scenario] = attempts + 1
         ft = finetune_trunk(
-            self.cfg, self.workdir, scenario, drift_step=self.drift_step_hint
+            self.cfg, self.workdir, scenario, drift_step=self.drift_step_hint,
+            # continual: warm-start from the tree that is SERVING (the
+            # deployer's tracked tag once anything deployed) — latest_tag's
+            # best > last preference would base a second episode on the
+            # ORIGINAL checkpoint and revert the first episode's trunk
+            base_tag=self.deployer.live_hdce_tag(),
         )
         self._emit("finetune", **ft)
         rep = self.deployer.canary(ft["tag"], scenario, self.drift_step_hint)

@@ -1,0 +1,36 @@
+"""Fleet router tier: the front door that spans hosts (docs/FLEET.md).
+
+Everything below the socket has been fleet-ready for several PRs —
+mesh-sharded AOT buckets, drain-safe replica pools, supervision, breaker,
+the client retry/dedup contract — but one controller process on one host
+still terminated every connection. This package is the missing tier:
+
+- :class:`~qdml_tpu.fleet.router.FleetRouter` — per-backend tables,
+  breaker-semantics ejection/re-admission, consistent-hash or
+  least-queue-depth balancing, fleet-wide request dedup, ``swap`` fan-out
+  and ``metrics``/``health`` aggregation (exact counter sums +
+  ``Histogram.merge`` wire latency);
+- :func:`~qdml_tpu.fleet.frontend.run_router` / ``qdml-tpu route`` — the
+  asyncio front socket speaking the serve protocol verbatim (clients,
+  loadgen and the control plane cannot tell a router from a single host);
+- :class:`~qdml_tpu.fleet.poller.FleetPoller` — the control plane's
+  attachment, so drift adaptation, canary-gated tagged hot-swap and
+  queue-depth autoscaling (now choosing WHICH host) span the fleet;
+- :mod:`~qdml_tpu.fleet.spawn` — real ``qdml-tpu serve`` subprocess
+  harness for the committed dryrun (scripts/fleet_router_dryrun.py).
+"""
+
+from qdml_tpu.fleet.frontend import (  # noqa: F401
+    route_async,
+    router_from_config,
+    run_router,
+)
+from qdml_tpu.fleet.poller import FleetPoller  # noqa: F401
+from qdml_tpu.fleet.router import (  # noqa: F401
+    Backend,
+    BackendState,
+    FleetRouter,
+    RouterDedup,
+    parse_backends,
+)
+from qdml_tpu.fleet.spawn import BackendProc, spawn_backend  # noqa: F401

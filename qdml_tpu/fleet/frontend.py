@@ -1,0 +1,214 @@
+"""Front-door socket for the fleet router: the serve protocol, one tier up.
+
+``qdml-tpu route`` runs :func:`run_router`: an asyncio loop accepting the
+SAME newline-JSON protocol ``qdml-tpu serve`` speaks — inference lines,
+``{"op": "metrics"}``, ``{"op": "health"}``, ``{"op": "swap"}``,
+``{"op": "scale"}`` — and hands every message to the
+:class:`~qdml_tpu.fleet.router.FleetRouter` on an executor thread (all
+backend exchanges are blocking ``ServeClient`` calls). Clients cannot tell
+a router from a single host, which is the point: ``run_loadgen_socket``,
+``ServeClient``, the fleet controller's ``SocketPoller`` and a human with
+``nc`` all work unchanged.
+
+Connection hardening is the serve front-end's, reused verbatim: bounded
+reads through :func:`qdml_tpu.serve.server._read_line` (idle/slow-loris
+reap with a typed ``idle_timeout`` reply), ``bad_json`` on garbage with the
+connection surviving, typed ``bad_request`` + close on an oversized line
+(``serve.conn_timeout_s`` / ``serve.max_line_bytes`` govern both tiers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import uuid
+
+from qdml_tpu.config import ExperimentConfig
+from qdml_tpu.fleet.router import FleetRouter, parse_backends
+from qdml_tpu.serve.server import _read_line
+
+
+def router_from_config(cfg: ExperimentConfig, seed: int = 0) -> FleetRouter:
+    """Build (but do not start) the router from ``cfg.fleet``; an empty
+    ``fleet.backends`` fronts the single local serve endpoint."""
+    fl = cfg.fleet
+    return FleetRouter(
+        parse_backends(fl.backends, default=(cfg.serve.host, cfg.serve.port)),
+        balance=fl.balance,
+        timeout_s=fl.timeout_s,
+        retries=fl.retries,
+        eject_failures=fl.eject_failures,
+        eject_s=fl.eject_s,
+        readmit_probes=fl.readmit_probes,
+        poll_interval_s=fl.poll_interval_s,
+        failover=fl.failover,
+        dedup_ttl_s=fl.dedup_ttl_s,
+        seed=seed,
+    )
+
+
+async def _handle_front(
+    reader, writer, router: FleetRouter, conn_timeout_s: float
+) -> None:
+    aloop = asyncio.get_running_loop()
+
+    async def _reply(obj: dict) -> None:
+        writer.write((json.dumps(obj) + "\n").encode())
+        await writer.drain()
+
+    try:
+        while True:
+            try:
+                line = await _read_line(reader, conn_timeout_s)
+            except asyncio.TimeoutError:
+                await _reply({"ok": False, "reason": "idle_timeout"})
+                break
+            except (asyncio.LimitOverrunError, ValueError):
+                # framing lost mid-line: typed reply and close, exactly like
+                # the serve tier (resyncing would misparse the tail)
+                await _reply({
+                    "ok": False,
+                    "reason": "bad_request: line exceeds serve.max_line_bytes",
+                })
+                break
+            if not line:
+                break
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                await _reply({"ok": False, "reason": "bad_json"})
+                continue
+            if not isinstance(msg, dict):
+                await _reply({"id": None, "ok": False,
+                              "reason": "bad_request: message must be a JSON object"})
+                continue
+            op = msg.get("op")
+            try:
+                if op == "health":
+                    rep = {"id": msg.get("id"), "ok": True,
+                           "health": router.health()}
+                elif op == "metrics":
+                    # aggregation polls every live backend: off the event
+                    # loop, like the serve tier's histogram merge
+                    view = await aloop.run_in_executor(None, router.live_metrics)
+                    rep = {"id": msg.get("id"), "ok": True, "metrics": view}
+                elif op == "swap":
+                    tags = msg.get("tags")
+                    if tags is not None and not (
+                        isinstance(tags, dict)
+                        and all(isinstance(k, str) and isinstance(v, str)
+                                for k, v in tags.items())
+                    ):
+                        raise ValueError(
+                            f"swap tags must be a str->str map, got {tags!r}"
+                        )
+                    rec = await aloop.run_in_executor(
+                        None, router.swap_fanout, tags
+                    )
+                    rep = {"id": msg.get("id"), "ok": bool(rec["ok"]), "swap": rec}
+                    if not rec["ok"]:
+                        rep["reason"] = "swap_failed: partial fan-out (see swap.backends)"
+                elif op == "scale":
+                    n = int(msg["replicas"])
+                    rec = await aloop.run_in_executor(None, router.scale_fleet, n)
+                    rep = {"id": msg.get("id"), "ok": True, "scale": rec}
+                else:
+                    # inference: the router needs an id for dedup + hash
+                    # affinity; an anonymous request gets a fresh one for
+                    # routing and its reply id restored to what was sent
+                    anon = "id" not in msg
+                    if anon:
+                        msg = {**msg, "id": f"anon-{uuid.uuid4().hex[:12]}"}
+                    rep = await aloop.run_in_executor(None, router.request, msg)
+                    if anon:
+                        rep = {**rep, "id": None}
+            except (KeyError, TypeError, ValueError) as e:
+                rep = {"id": msg.get("id"), "ok": False,
+                       "reason": f"bad_request: {e}"}
+            except (ConnectionError, RuntimeError, OSError) as e:
+                # a fan-out verb that could reach nobody (or a backend scale
+                # rejection): typed, retryable, connection survives
+                rep = {"id": msg.get("id"), "ok": False,
+                       "reason": f"router_error: {type(e).__name__}: {e}"}
+            await _reply(rep)
+    except (ConnectionResetError, BrokenPipeError):
+        pass  # the peer vanished: nothing stranded, forwards resolve router-side
+    finally:
+        try:
+            writer.close()
+        except RuntimeError:
+            pass
+
+
+async def route_async(
+    router: FleetRouter,
+    host: str,
+    port: int,
+    ready: "asyncio.Future | None" = None,
+    conn_timeout_s: float = 30.0,
+    max_line_bytes: int = 8_388_608,
+) -> None:
+    """Accept front-door connections until cancelled; resolves ``ready``
+    with the bound port (port=0 = ephemeral, the test/dryrun pattern)."""
+    server = await asyncio.start_server(
+        lambda r, w: _handle_front(r, w, router, conn_timeout_s),
+        host=host,
+        port=port,
+        limit=max_line_bytes,
+    )
+    bound = server.sockets[0].getsockname()[1]
+    if ready is not None and not ready.done():
+        ready.set_result(bound)
+    async with server:
+        await server.serve_forever()
+
+
+def run_router(cfg: ExperimentConfig, logger=None) -> None:
+    """Blocking entry for ``qdml-tpu route``: prime the backend table,
+    announce (actual bound port + router identity + backend table), route
+    until interrupted. No checkpoints, no jax compute — the router is pure
+    protocol; backends own the models."""
+    router = router_from_config(cfg).start()
+
+    async def _route_announced() -> None:
+        aloop = asyncio.get_running_loop()
+        ready: asyncio.Future = aloop.create_future()
+        task = aloop.create_task(
+            route_async(
+                router, cfg.fleet.host, cfg.fleet.port, ready,
+                conn_timeout_s=cfg.serve.conn_timeout_s,
+                max_line_bytes=cfg.serve.max_line_bytes,
+            )
+        )
+        await asyncio.wait({task, ready}, return_when=asyncio.FIRST_COMPLETED)
+        if task.done():
+            return task.result()  # bind failure propagates
+        print(
+            json.dumps(
+                {
+                    "routing": f"{cfg.fleet.host}:{ready.result()}",
+                    "router_id": f"{socket.gethostname()}-{os.getpid()}",
+                    "balance": router.balance,
+                    "backends": {
+                        b.host_id: {"addr": b.addr, "state": b.state.state}
+                        for b in router.backends
+                    },
+                    "backends_live": len(router.live_backends()),
+                }
+            ),
+            flush=True,
+        )
+        await task
+
+    try:
+        asyncio.run(_route_announced())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+        if logger is not None:
+            logger.telemetry.write_raw(
+                {"kind": "router_summary", **router.router_summary()}
+            )

@@ -1,0 +1,68 @@
+"""FleetPoller: the control plane's attachment to the router tier.
+
+:class:`~qdml_tpu.control.loop.SocketPoller` generalized over the router's
+AGGREGATED verbs: the :class:`~qdml_tpu.control.loop.FleetController`'s
+drift detection windows the summed per-scenario counters exactly as it
+windows one host's (raw sums difference exactly), the queue-depth
+autoscaler sees the fleet-total depth and the router chooses WHICH host to
+resize (:meth:`FleetRouter.scale_fleet`), and a tagged deploy fans the swap
+to every live backend at once.
+
+Two forms, one contract:
+
+- **in-process** — :class:`FleetPoller` wraps a live
+  :class:`~qdml_tpu.fleet.router.FleetRouter` object (the dryrun/test
+  harness, scripts/fleet_router_dryrun.py);
+- **remote** — the router's front socket speaks the serve protocol
+  verbatim, so the existing ``SocketPoller`` pointed at the ROUTER address
+  is already the remote fleet poller (``qdml-tpu control`` against
+  ``fleet.host:fleet.port`` — nothing new on the wire);
+  :meth:`FleetPoller.remote` spells that out.
+
+Partial-fan-out discipline: a swap that lands on every LIVE backend is a
+success even when ejected hosts were skipped (they re-resolve checkpoints
+at re-admission/restart) — a single backend's ejection must never suspend
+adaptation for the surviving hosts (docs/FLEET.md). A swap that failed on
+a LIVE backend raises, which the controller's ``tick_failed`` path reports
+and survives.
+"""
+
+from __future__ import annotations
+
+from qdml_tpu.fleet.router import FleetRouter
+
+
+class FleetPoller:
+    """In-process controller attachment to a running :class:`FleetRouter`."""
+
+    def __init__(self, router: FleetRouter):
+        self.router = router
+
+    def metrics(self) -> dict:
+        """The aggregated fleet view (summed counters + per-backend rows) —
+        the same payload the router's ``{"op": "metrics"}`` verb serves."""
+        return self.router.live_metrics()
+
+    def swap(self, tags: dict) -> dict:
+        rec = self.router.swap_fanout(tags)
+        if not rec["ok"]:
+            # a LIVE backend failed to swap: the deploy did not land fleet-
+            # wide — typed failure for the controller's tick_failed path
+            # (skipped ejected hosts alone never get here: ok stays true)
+            raise RuntimeError(
+                f"fleet swap partial: {rec['ok_count']}/{rec['fanned_to']} "
+                f"live backends swapped ({rec['backends']})"
+            )
+        return rec
+
+    def scale(self, n: int) -> dict:
+        return self.router.scale_fleet(n)
+
+    @staticmethod
+    def remote(host: str, port: int, timeout_s: float = 30.0):
+        """The remote twin: the router speaks the serve protocol, so the
+        control plane's existing socket attachment IS the remote fleet
+        poller when pointed at the router's front address."""
+        from qdml_tpu.control.loop import SocketPoller
+
+        return SocketPoller(host, port, timeout_s=timeout_s)

@@ -1,0 +1,183 @@
+"""Spawn and supervise REAL backend serve processes (dryrun/test harness).
+
+The fleet dryrun's backends are genuine ``qdml-tpu serve`` processes — own
+interpreter, own JAX runtime, own warmup, own compile-cache counters — not
+in-process stand-ins: the router tier's whole claim is that the socket
+layer spans PROCESSES, so the proof must too. :func:`spawn_backend` launches
+one with ``--serve.port=0`` (or a fixed port the chaos respawn path reuses),
+reads the post-bind startup banner (serve/server.run_server prints it AFTER
+the socket is bound, with the ACTUAL port and the stable ``host_id``), and
+returns a handle that can kill (SIGKILL — the backend-loss chaos class),
+stall (SIGSTOP/SIGCONT — the hung-host class) and reap the process.
+
+Real multi-host deployments run one ``qdml-tpu serve`` per host under their
+own supervisor and hand the router ``fleet.backends``; this module exists so
+the committed dryrun and the tests exercise the identical process topology
+on one machine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BackendProc:
+    """One spawned ``qdml-tpu serve`` process + its learned identity."""
+
+    proc: subprocess.Popen
+    host: str
+    port: int
+    host_id: str
+    banner: dict
+    log_path: str | None = None
+    _stopped: bool = field(default=False, repr=False)
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL — the chaos backend-loss class (no drain, no goodbye)."""
+        self._stopped = True
+        if self.alive():
+            self.proc.kill()
+        self.proc.wait(timeout=30.0)
+
+    def stall(self) -> None:
+        """SIGSTOP — the hung-host class: the process holds its sockets but
+        answers nothing; the router must eject it on timeouts."""
+        os.kill(self.proc.pid, signal.SIGSTOP)
+
+    def resume(self) -> None:
+        os.kill(self.proc.pid, signal.SIGCONT)
+
+    def terminate(self, timeout_s: float = 30.0) -> None:
+        """Polite stop (SIGINT first — run_server's KeyboardInterrupt path
+        flushes counters — then SIGKILL)."""
+        self._stopped = True
+        if not self.alive():
+            self.proc.wait(timeout=timeout_s)
+            return
+        self.proc.send_signal(signal.SIGINT)
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=timeout_s)
+
+
+def spawn_backend(
+    overrides: list[str],
+    port: int = 0,
+    host: str = "127.0.0.1",
+    env: dict | None = None,
+    log_path: str | None = None,
+    timeout_s: float = 600.0,
+    python: str | None = None,
+) -> BackendProc:
+    """Launch ``python -m qdml_tpu.cli serve`` with ``overrides`` (dotted
+    config flags, ``--train.workdir=...`` included so the backend restores
+    the harness's checkpoints) and block until its post-bind banner names
+    the actual port. Stdout goes to ``log_path`` after the banner (the
+    banner line itself is parsed here); stderr follows stdout."""
+    cmd = [
+        python or sys.executable, "-m", "qdml_tpu.cli", "serve",
+        f"--serve.host={host}", f"--serve.port={port}", *overrides,
+    ]
+    child_env = dict(os.environ)
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    # the child resolves `qdml_tpu` from THIS package's root, not from the
+    # caller's cwd (a harness running from a scratch directory would
+    # otherwise spawn backends that die on import)
+    import qdml_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(qdml_tpu.__file__)))
+    child_env["PYTHONPATH"] = pkg_root + (
+        os.pathsep + child_env["PYTHONPATH"] if child_env.get("PYTHONPATH") else ""
+    )
+    if env:
+        child_env.update(env)
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=child_env, text=True, bufsize=1,
+    )
+    # the banner wait must enforce timeout_s against a child that hangs
+    # SILENTLY (a wedged warmup prints nothing): a blocking readline would
+    # only re-check the deadline between lines, so a reader thread feeds a
+    # queue and the deadline governs the queue waits
+    import queue as _queue
+    import threading as _threading
+
+    out_q: _queue.Queue = _queue.Queue()
+
+    def _pump():
+        try:
+            for pumped in proc.stdout:
+                out_q.put(pumped)
+        except ValueError:
+            pass  # stdout closed at reap
+        out_q.put(None)  # EOF sentinel
+
+    _threading.Thread(target=_pump, daemon=True, name="backend-banner-pump").start()
+    deadline = time.monotonic() + timeout_s
+    lines: list[str] = []
+    banner = None
+    while banner is None:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            proc.kill()
+            raise TimeoutError(
+                f"backend produced no startup banner within {timeout_s}s:\n"
+                + "".join(lines[-30:])
+            )
+        try:
+            line = out_q.get(timeout=min(remaining, 1.0))
+        except _queue.Empty:
+            continue
+        if line is None:
+            proc.wait(timeout=30.0)
+            raise RuntimeError(
+                "backend exited before announcing "
+                f"(rc={proc.returncode}):\n" + "".join(lines[-30:])
+            )
+        lines.append(line)
+        if '"serving"' in line:
+            try:
+                banner = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a log line that merely mentions the key
+    bound = int(banner["serving"].rsplit(":", 1)[1])
+    handle = BackendProc(
+        proc=proc, host=host, port=bound,
+        host_id=str(banner.get("host_id") or f"{host}:{bound}"),
+        banner=banner, log_path=log_path,
+    )
+    # keep draining the pump's queue on a side thread so the child never
+    # blocks on a full pipe (warmup cost tables and telemetry echoes are
+    # chatty) — the pump thread owns proc.stdout, this one owns the queue
+    def _drain():
+        sink = open(log_path, "a") if log_path else None
+        try:
+            while True:
+                out_line = out_q.get()
+                if out_line is None:
+                    break  # EOF: the pump saw stdout close
+                if sink is not None:
+                    sink.write(out_line)
+                    sink.flush()
+        finally:
+            if sink is not None:
+                sink.close()
+
+    _threading.Thread(target=_drain, daemon=True, name=f"backend-log-{bound}").start()
+    return handle

@@ -58,12 +58,23 @@ def hdce_state_shardings(
     return jax.tree_util.tree_map_with_path(spec_for, state)
 
 
-def _place(tree: Any, shardings: Any) -> Any:
+def place_tree(tree: Any, shardings: Any) -> Any:
+    """Place any pytree against a matching NamedSharding tree — the ONE
+    placement choke point for params/opt-state/eval vars AND the serving
+    engine's committed checkpoints (warmup placement and every hot-swap
+    re-placement route here, so a multihost serve frontend places exactly
+    like multihost training does). Single-controller: plain ``device_put``
+    per leaf. Multi-controller (``jax.process_count() > 1``): ``device_put``
+    rejects non-addressable shardings, so a jitted identity with
+    ``out_shardings`` places the globally-sharded state — one compile per
+    tree structure, OFF the request path (warmup/swap time)."""
     if jax.process_count() > 1:
-        # device_put rejects non-addressable shardings; a jitted identity
-        # with out_shardings is the multi-controller way to place state.
         return jax.jit(lambda s: s, out_shardings=shardings)(tree)
     return jax.tree.map(jax.device_put, tree, shardings)
+
+
+# internal alias kept for existing callers/tests
+_place = place_tree
 
 
 def shard_hdce_state(

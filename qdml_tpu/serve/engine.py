@@ -235,10 +235,14 @@ class ServeEngine:
     def _place(self, tree: Any, shardings: Any) -> Any:
         if shardings is None:
             return jax.tree.map(jnp.asarray, tree)
-        # single-controller placement (the serve path is a local server; a
-        # multi-controller frontend would route through the jitted-identity
-        # placer like parallel/federated._place)
-        return jax.tree.map(jax.device_put, tree, shardings)
+        # one placement choke point for the whole repo: the federated
+        # placer device_puts single-controller and routes multi-controller
+        # placement through its jitted identity — so a fleet of multihost
+        # backends places warmup params and every fan-out hot-swap exactly
+        # like multihost training placement (docs/FLEET.md)
+        from qdml_tpu.parallel.federated import place_tree
+
+        return place_tree(tree, shardings)
 
     def _x_sharding(self, b: int) -> NamedSharding | None:
         """Batch-axis sharding for bucket ``b``: data-parallel when the data

@@ -525,7 +525,14 @@ def run_loadgen_socket(
     ``server_metrics`` from an end-of-run ``{"op": "metrics"}`` poll, which
     also carries the server's compile gate, faults/restarts and breaker
     state). ``x`` overrides the request samples (the chaos harness reuses
-    one set across phases so per-phase NMSE windows are comparable)."""
+    one set across phases so per-phase NMSE windows are comparable).
+
+    Pointed at a fleet ROUTER (docs/FLEET.md) the endpoint's metrics verb
+    returns the aggregated fleet view, and the summary reports per-backend
+    AND merged rows instead of one blended blob: the merged counters are the
+    router's exact sums (the per-replica merge discipline, one tier up), and
+    ``server_metrics.per_backend`` / the top-level ``router`` block keep
+    every host's own completed/latency/compile-gate row attributable."""
     process = process or cfg.serve.arrival
     if process not in ARRIVAL_PROCESSES:
         raise ValueError(
@@ -664,7 +671,22 @@ def run_loadgen_socket(
                     "buckets", "completed", "swap_epoch", "faults", "restarts",
                     "breaker",
                 )
-            }
+                # fleet-router poll: the per-host rows and the router's own
+                # ledger ride along with the merged counters — never a
+                # blended blob (docs/FLEET.md)
+            } | (
+                {
+                    k: server_metrics.get(k)
+                    for k in ("fleet", "backends_polled", "per_backend")
+                }
+                if server_metrics.get("fleet")
+                else {}
+            )
+        ),
+        **(
+            {"router": (server_metrics or {}).get("router")}
+            if (server_metrics or {}).get("router")
+            else {}
         ),
     )
     if logger is not None:

@@ -52,7 +52,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import random
+import socket
 import threading
 import time
 from concurrent.futures import Future
@@ -948,6 +950,7 @@ async def _handle(
     swap_fn: "Callable[..., dict] | None",
     conn_timeout_s: float = 0.0,
     dedup: DedupCache | None = None,
+    ident: dict | None = None,
 ) -> None:
     try:
         while True:
@@ -987,6 +990,12 @@ async def _handle(
                 reply = {"id": msg.get("id"), "ok": True, "health": loop_.health()}
                 if dedup is not None:
                     reply["health"]["dedup_hits"] = dedup.hits
+                if ident is not None:
+                    # backend identity block (docs/FLEET.md): a front-door
+                    # router keys its ejection bookkeeping and per-backend
+                    # fleet rows on a STABLE host_id + listen address —
+                    # anonymous replies cannot be attributed after a failover
+                    reply["health"].update(ident)
                 writer.write((json.dumps(reply) + "\n").encode())
                 await writer.drain()
                 continue
@@ -999,6 +1008,8 @@ async def _handle(
                 metrics_view = await asyncio.get_running_loop().run_in_executor(
                     None, loop_.live_metrics
                 )
+                if ident is not None:
+                    metrics_view.update(ident)  # same identity block as health
                 reply = {"id": msg.get("id"), "ok": True, "metrics": metrics_view}
                 writer.write((json.dumps(reply) + "\n").encode())
                 await writer.drain()
@@ -1122,6 +1133,7 @@ async def serve_async(
     conn_timeout_s: float | None = None,
     max_line_bytes: int | None = None,
     dedup_ttl_s: float | None = None,
+    host_id: str | None = None,
 ) -> None:
     """Accept connections until cancelled; resolves ``ready`` with the bound
     port (port=0 binds an ephemeral port — how the tests avoid collisions).
@@ -1131,7 +1143,11 @@ async def serve_async(
     ``{"op": "swap"}`` verb. The hardening knobs (per-connection idle/read
     timeout, max line bytes, dedup TTL) default to the serving config's
     values (``serve.conn_timeout_s`` / ``max_line_bytes`` / ``dedup_ttl_s``);
-    pass explicit values to override."""
+    pass explicit values to override. ``host_id`` is the stable backend
+    identity stamped (with the listen address) into every ``health`` and
+    ``metrics`` reply — the fleet router's ejection bookkeeping and
+    per-backend rows key on it; the default is unique per process AND per
+    listening endpoint, so in-process multi-server tests never collide."""
     serve_cfg = loop_.engine.cfg.serve
     conn_timeout_s = (
         serve_cfg.conn_timeout_s if conn_timeout_s is None else conn_timeout_s
@@ -1141,15 +1157,20 @@ async def serve_async(
     )
     dedup_ttl_s = serve_cfg.dedup_ttl_s if dedup_ttl_s is None else dedup_ttl_s
     dedup = DedupCache(dedup_ttl_s) if dedup_ttl_s > 0 else None
+    ident_box: dict = {}
     server = await asyncio.start_server(
         lambda r, w: _handle(
-            r, w, loop_, swap_fn, conn_timeout_s=conn_timeout_s, dedup=dedup
+            r, w, loop_, swap_fn, conn_timeout_s=conn_timeout_s, dedup=dedup,
+            ident=ident_box,
         ),
         host=host,
         port=port,
         limit=max_line_bytes,
     )
     bound = server.sockets[0].getsockname()[1]
+    if host_id is None:
+        host_id = f"{socket.gethostname()}-{os.getpid()}-p{bound}"
+    ident_box.update({"host_id": host_id, "listen": f"{host}:{bound}"})
     if ready is not None and not ready.done():
         ready.set_result(bound)
     async with server:
@@ -1162,38 +1183,60 @@ def run_server(
     logger=None,
     workdir: str | None = None,
 ) -> None:
-    """Blocking entry for ``qdml-tpu serve``: warm, announce, serve until
-    interrupted; flush serving counters on the way out. ``workdir`` arms the
-    ``{"op": "swap"}`` hot-swap verb (re-restore newest checkpoints live)."""
+    """Blocking entry for ``qdml-tpu serve``: warm, bind, announce, serve
+    until interrupted; flush serving counters on the way out. ``workdir``
+    arms the ``{"op": "swap"}`` hot-swap verb (re-restore newest checkpoints
+    live). The startup banner prints AFTER the socket is bound with the
+    ACTUAL port (``--serve.port=0`` binds an ephemeral one) plus the stable
+    ``host_id`` — how a fleet-router spawner (fleet/spawn.py) learns where a
+    backend it launched actually listens."""
     pool = ReplicaPool(engine, workers=cfg.serve.workers).start()
-    print(
-        json.dumps(
-            {
-                "serving": f"{cfg.serve.host}:{cfg.serve.port}",
-                "buckets": list(engine.buckets),
-                "batching": engine.batching_summary(),
-                "replicas": pool.n_replicas,
-                "workers": pool.workers,
-                "supervised": cfg.serve.supervise,
-                "breaker": cfg.serve.breaker,
-                "mesh": engine.mesh_topology(),
-                "sharding": engine.bucket_sharding or None,
-                # post-warmup counters: anything non-zero here (or later)
-                # is a compile the warmup failed to cover
-                "compile_cache_after_warmup": engine.request_path_compiles(),
-                # per-bucket XLA cost accounting from the AOT warmup
-                "cost": engine.bucket_cost,
-            }
-        ),
-        flush=True,
-    )
+    host_id = f"{socket.gethostname()}-{os.getpid()}"
     swap_fn = (
         None
         if workdir is None
         else (lambda tags=None: engine.swap_from_workdir(workdir, tags=tags))
     )
+
+    async def _serve_announced() -> None:
+        aloop = asyncio.get_running_loop()
+        ready: asyncio.Future = aloop.create_future()
+        task = aloop.create_task(
+            serve_async(
+                pool, cfg.serve.host, cfg.serve.port, ready,
+                swap_fn=swap_fn, host_id=host_id,
+            )
+        )
+        # wait on BOTH: a bind failure must propagate, not hang on `ready`
+        await asyncio.wait({task, ready}, return_when=asyncio.FIRST_COMPLETED)
+        if task.done():
+            return task.result()
+        print(
+            json.dumps(
+                {
+                    "serving": f"{cfg.serve.host}:{ready.result()}",
+                    "host_id": host_id,
+                    "buckets": list(engine.buckets),
+                    "batching": engine.batching_summary(),
+                    "replicas": pool.n_replicas,
+                    "workers": pool.workers,
+                    "supervised": cfg.serve.supervise,
+                    "breaker": cfg.serve.breaker,
+                    "mesh": engine.mesh_topology(),
+                    "sharding": engine.bucket_sharding or None,
+                    # post-warmup counters: anything non-zero here (or later)
+                    # is a compile the warmup failed to cover
+                    "compile_cache_after_warmup": engine.request_path_compiles(),
+                    # per-bucket XLA cost accounting from the AOT warmup
+                    "cost": engine.bucket_cost,
+                }
+            ),
+            flush=True,
+        )
+        await task
+
     try:
-        asyncio.run(serve_async(pool, cfg.serve.host, cfg.serve.port, swap_fn=swap_fn))
+        asyncio.run(_serve_announced())
     except KeyboardInterrupt:
         pass
     finally:

@@ -112,6 +112,7 @@ def _serving_from(obj: dict) -> dict | None:
         "batching": None,
         "stranded_futures": None,
         "breaker_open_fraction": None,
+        "router": None,
     }
     lat = obj.get("latency_ms") or {}
     for key in ("p50_ms", "p95_ms", "p99_ms"):
@@ -172,6 +173,20 @@ def _serving_from(obj: dict) -> dict | None:
         }
         if isinstance(disp.get("overflow_rate"), (int, float)):
             out["overflow_rate"] = float(disp["overflow_rate"])
+    # fleet-router facts (docs/FLEET.md): a loadgen window measured THROUGH
+    # the router tier carries the router's own ledger — backend count,
+    # balancing policy, failovers/ejections — so the fleet line names the
+    # topology the latency/goodput deltas were measured across
+    rt = obj.get("router")
+    if isinstance(rt, dict):
+        out["router"] = {
+            "backends": rt.get("backends"),
+            "backends_live": rt.get("backends_live"),
+            "balance": rt.get("balance"),
+            "failovers": rt.get("failovers"),
+            "ejections": rt.get("ejections"),
+            "dedup_hits": rt.get("dedup_hits"),
+        }
     return out
 
 
@@ -639,14 +654,20 @@ def build_report_data(
         def _fleet_str(src):
             serving = src.get("serving") or {}
             f = serving.get("fleet")
-            if not f:
+            if not f and not serving.get("router"):
                 return None
-            topo = [f"{f.get('replicas', '?')} replica(s)"]
-            if f.get("devices"):
-                topo.append(f"{f['devices']} device(s)")
-            s = " x ".join(topo)
-            if f.get("rps_per_replica") is not None:
-                s += f" ({f['rps_per_replica']:g} rps/replica)"
+            # a socket window measured THROUGH the router tier has no
+            # in-process fleet block — the router facts alone still make a
+            # fleet line (the topology the numbers were measured across)
+            if not f:
+                s = "router front"
+            else:
+                topo = [f"{f.get('replicas', '?')} replica(s)"]
+                if f.get("devices"):
+                    topo.append(f"{f['devices']} device(s)")
+                s = " x ".join(topo)
+                if f.get("rps_per_replica") is not None:
+                    s += f" ({f['rps_per_replica']:g} rps/replica)"
             # scenario scale-out facts ride the fleet line: expert-family
             # count, which routing dispatch the race baked in, and the
             # sparse overflow-fallback rate when one was measured
@@ -666,6 +687,21 @@ def build_report_data(
                 s += f" {bat['mode']}-batching"
                 if serving.get("padding_waste") is not None:
                     s += f" (pad waste {serving['padding_waste']:.2%})"
+            # the fleet-router line: a window measured through the router
+            # tier names how many hosts it spanned and the balancing policy
+            # — a p99 delta across different fan-outs is topology, not code
+            rt = serving.get("router")
+            if rt and rt.get("backends"):
+                s += (
+                    f", via router over {rt['backends']} backend(s)"
+                    f" [{rt.get('balance', '?')}]"
+                )
+                if rt.get("backends_live") is not None and (
+                    rt["backends_live"] != rt["backends"]
+                ):
+                    s += f" ({rt['backends_live']} live)"
+                if rt.get("failovers"):
+                    s += f", {rt['failovers']} failover(s)"
             return s
 
         base_fleet = _fleet_str(base)

@@ -5,8 +5,8 @@
 # Stage 1: graftlint (qdml-tpu lint --baseline; docs/ANALYSIS.md). New static-
 # analysis findings fail fast (exit 5) before any test runs — the lint is
 # pure AST, no jax, sub-second.
-# Stage 2: resilience report gate over the committed chaos artifacts
-# (docs/RESILIENCE.md): every fault class's committed recovery window is
+# Stage 2: resilience report gate over the committed chaos + fleet-router
+# artifacts (docs/RESILIENCE.md, docs/FLEET.md): every committed recovery window is
 # fed through `qdml-tpu report --json` and the INVARIANT/absolute rows are
 # checked — the ALWAYS-ARMED stranded-futures row plus the
 # breaker/overflow/padding absolute-slack gates. The %-threshold latency
@@ -20,23 +20,31 @@
 # accounting; edit ROADMAP.md first if that line ever needs to change).
 cd "$(dirname "$0")/.." || exit 2
 python -m qdml_tpu.cli lint --baseline || exit 5
-if [ -d results/chaos_dryrun ]; then
-  for f in results/chaos_dryrun/*_recovery_t0.jsonl; do
+# One parameterized pass over both committed chaos-style artifact sets
+# (results/chaos_dryrun, results/fleet_router — docs/RESILIENCE.md,
+# docs/FLEET.md): every recovery window re-arms the invariant rows.
+for spec in "chaos_dryrun:CHAOS_DRYRUN.json" "fleet_router:FLEET_ROUTER.json"; do
+  dir="results/${spec%%:*}"; headline="$dir/${spec#*:}"
+  [ -d "$dir" ] || continue
+  for f in "$dir"/*_recovery_t0.jsonl; do
+    # fresh JSON per window: a report crash must FAIL this window, not be
+    # silently judged on the previous window's stale gate file
+    rm -f /tmp/_t1_invariant.json
     python -m qdml_tpu.cli report --current="$f" \
-      --baseline=results/chaos_dryrun/baseline.jsonl \
-      --json=/tmp/_t1_chaos.json > /dev/null || true  # rc judged on the JSON rows below
+      --baseline="$dir/baseline.jsonl" \
+      --json=/tmp/_t1_invariant.json > /dev/null || true  # rc judged on the JSON rows below
     python -c "
 import json, sys
-d = json.load(open('/tmp/_t1_chaos.json'))
+d = json.load(open('/tmp/_t1_invariant.json'))
 invariant_kinds = ('resilience', 'breaker', 'dispatch', 'batching')
 bad = d.get('stranded_failed') or any(
     g.get('status') == 'regression' and g.get('kind') in invariant_kinds
     for g in d.get('gates', [])
 )
 sys.exit(1 if bad else 0)
-" || { echo "chaos invariant gate failed: $f"; exit 6; }
+" || { echo "invariant gate failed: $f"; exit 6; }
   done
-  python -c "import json, sys; d = json.load(open('results/chaos_dryrun/CHAOS_DRYRUN.json')); sys.exit(0 if d.get('all_pass') else 1)" \
-    || { echo "committed chaos dryrun is not all_pass"; exit 6; }
-fi
+  python -c "import json, sys; d = json.load(open('$headline')); sys.exit(0 if d.get('all_pass') else 1)" \
+    || { echo "committed dryrun is not all_pass: $headline"; exit 6; }
+done
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

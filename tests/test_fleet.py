@@ -1,0 +1,511 @@
+"""Fleet router tier (qdml_tpu/fleet, docs/FLEET.md): balancing, ejection/
+re-admission, fleet-wide dedup across failover, verb fan-out/aggregation,
+the FleetPoller + controller attachment, and the backend identity block.
+
+The backend "hosts" here are two ServeLoops over ONE warmed engine behind
+two real serve_async socket front-ends — two endpoints from the router's
+point of view, one warmup/compile budget from the test suite's (same tiny
+shapes as tests/test_faults.py, so the persistent compile cache shares the
+bucket executables). The REAL separate-process topology is the committed
+dryrun's job (scripts/fleet_router_dryrun.py -> results/fleet_router/).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from qdml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FleetConfig,
+    ModelConfig,
+    ServeConfig,
+    TrainConfig,
+)
+from qdml_tpu.fleet import (
+    BackendState,
+    FleetPoller,
+    FleetRouter,
+    parse_backends,
+    route_async,
+)
+from qdml_tpu.serve import ServeClient, ServeEngine, ServeLoop, serve_async
+
+
+def _tiny_cfg(**serve_kw):
+    # identical shapes to tests/test_faults.py so the persistent compile
+    # cache shares the bucket executables across files
+    serve = dict(
+        max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=32,
+        batching="bucket",
+    )
+    serve.update(serve_kw)
+    return ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        serve=ServeConfig(**serve),
+    )
+
+
+@pytest.fixture(scope="module")
+def warmed():
+    from qdml_tpu.serve import make_request_samples
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = _tiny_cfg()
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    clf_vars = {"params": sc_state.params}
+    engine = ServeEngine(cfg, hdce_vars, clf_vars)
+    samples = make_request_samples(cfg, 32)
+    engine.warmup()
+    return cfg, engine, samples
+
+
+class _SwapCounter:
+    """Per-backend fake swap_fn: counts calls, optionally fails typed (the
+    corrupt-checkpoint shape) — fan-out SEMANTICS under test; real checkpoint
+    swaps through the router are the committed dryrun's job."""
+
+    def __init__(self, name: str, fail: bool = False):
+        self.name = name
+        self.fail = fail
+        self.calls = 0
+
+    def __call__(self, tags=None):
+        self.calls += 1
+        if self.fail:
+            raise ValueError(f"checkpoint on {self.name} failed to restore")
+        return {"epoch": self.calls, "tags": tags,
+                "compile": {"hits": 0, "misses": 0, "requests": 0}}
+
+
+@pytest.fixture()
+def fleet(warmed):
+    """Two socket backends (own ServeLoop each, shared warmed engine) + a
+    started FleetRouter over both."""
+    cfg, engine, samples = warmed
+    aloop = asyncio.new_event_loop()
+    t = threading.Thread(target=aloop.run_forever, daemon=True)
+    t.start()
+    loops, ports, swaps, tasks = [], [], [], []
+    for i in range(2):
+        loop_ = ServeLoop(engine, name=f"backend-{i}-loop").start()
+        swap = _SwapCounter(f"backend-{i}")
+        ready: Future = Future()
+        task = asyncio.run_coroutine_threadsafe(
+            serve_async(
+                loop_, "127.0.0.1", 0, ready, swap_fn=swap,
+                conn_timeout_s=30.0, dedup_ttl_s=5.0, host_id=f"backend-{i}",
+            ),
+            aloop,
+        )
+        ports.append(ready.result(timeout=30.0))
+        loops.append(loop_)
+        swaps.append(swap)
+        tasks.append(task)
+    router = FleetRouter(
+        [("127.0.0.1", p) for p in ports],
+        timeout_s=5.0, retries=0, eject_failures=2, eject_s=0.2,
+        readmit_probes=1, poll_interval_s=30.0,  # poll driven manually
+        failover=2, dedup_ttl_s=5.0,
+    ).start()
+    yield cfg, engine, samples, router, loops, ports, swaps, aloop
+    router.stop()
+    for task in tasks:
+        task.cancel()
+    aloop.call_soon_threadsafe(aloop.stop)
+    t.join(timeout=5.0)
+    for loop_ in loops:
+        loop_.stop()
+
+
+def _fleet_completed(loops) -> int:
+    return sum(lp.merged_metrics().completed for lp in loops)
+
+
+# ---------------------------------------------------------------------------
+# Pure units: endpoint parsing, ejection state machine, ring affinity
+# ---------------------------------------------------------------------------
+
+
+def test_parse_backends():
+    assert parse_backends("127.0.0.1:1, h2:8377") == [("127.0.0.1", 1), ("h2", 8377)]
+    assert parse_backends("", default=("local", 9)) == [("local", 9)]
+    with pytest.raises(ValueError):
+        parse_backends("missing-port")
+    with pytest.raises(ValueError):
+        parse_backends("", default=None)
+
+
+def test_backend_state_machine_breaker_semantics():
+    """closed -> open on consecutive failures, open -> half-open after
+    eject_s, half-open closes after readmit_probes successes and re-opens on
+    one failure — the serve/breaker.py shape keyed on transport failures."""
+    t = {"now": 0.0}
+    s = BackendState(eject_failures=2, eject_s=1.0, readmit_probes=2,
+                     clock=lambda: t["now"])
+    assert s.allow() and s.state == "closed" and s.live()
+    assert not s.record_failure()        # 1 of 2
+    assert s.record_success() is False   # success RESETS the streak
+    assert not s.record_failure()
+    assert s.record_failure()            # 2 consecutive -> ejected
+    assert s.state == "open" and not s.live() and not s.allow()
+    t["now"] = 1.5
+    assert s.allow() and s.state == "half_open"  # eject_s elapsed: probing
+    assert not s.record_success()        # 1 of 2 probes
+    assert s.record_failure()            # half-open failure re-opens
+    assert s.state == "open"
+    t["now"] = 3.0
+    assert s.allow()
+    assert not s.record_success() and s.record_success()  # 2 probes -> closed
+    assert s.state == "closed"
+    assert s.summary()["ejections"] == 2 and s.summary()["readmissions"] == 1
+
+
+def test_hash_affinity_stable_and_spreading(fleet):
+    """One id always resolves to the same backend order (retries land where
+    the server dedup window holds); many ids spread over both backends."""
+    *_, router, _loops, _ports, _swaps, _ = fleet
+    first = [router._candidates(f"rid-{i}")[0].addr for i in range(64)]
+    assert first == [router._candidates(f"rid-{i}")[0].addr for i in range(64)]
+    assert len(set(first)) == 2  # both backends own part of the id space
+
+
+def test_least_queue_prefers_shallow_backend(fleet):
+    *_, router, _loops, _ports, _swaps, _ = fleet
+    router.balance = "least_queue"
+    try:
+        router.backends[0].queue_depth = 7
+        router.backends[1].queue_depth = 1
+        assert router._candidates("any")[0] is router.backends[1]
+        router.backends[1].queue_depth = 9
+        assert router._candidates("any")[0] is router.backends[0]
+    finally:
+        router.balance = "hash"
+        for b in router.backends:
+            b.queue_depth = 0
+
+
+# ---------------------------------------------------------------------------
+# Request path + aggregation over two live socket backends
+# ---------------------------------------------------------------------------
+
+
+def test_router_serves_and_aggregates(fleet):
+    cfg, engine, samples, router, loops, ports, _swaps, _ = fleet
+    before = _fleet_completed(loops)
+    x0 = samples["x"][0].tolist()
+    reps = [router.request({"id": f"agg-{i}", "x": x0}) for i in range(12)]
+    assert all(r["ok"] for r in reps)
+    assert _fleet_completed(loops) == before + 12
+    # the health poll learned each backend's stamped identity
+    router.poll_once()
+    assert {b.host_id for b in router.backends} == {"backend-0", "backend-1"}
+    m = router.live_metrics()
+    assert m["fleet"] is True and m["backends_polled"] == 2
+    assert m["completed"] == _fleet_completed(loops)
+    # per-backend AND merged rows: the blended blob is exactly what the
+    # aggregation must never collapse to
+    assert set(m["per_backend"]) == {"backend-0", "backend-1"}
+    per_total = sum(v["completed"] for v in m["per_backend"].values())
+    assert per_total == m["completed"]
+    # per-scenario counts sum exactly (raw sums -> windowable by the
+    # controller exactly like one host's)
+    scen_total = sum(v["n"] for v in (m["per_scenario"] or {}).values())
+    assert scen_total == m["completed"]
+    # compile gate: per-key sum across hosts, all-zero (one warmup, zero
+    # request-path compiles through the router)
+    assert m["compile_cache_after_warmup"]["requests"] == 0
+    rt = m["router"]
+    assert rt["backends"] == 2 and rt["backends_live"] == 2
+    assert rt["forwarded"] >= 12 and rt["wire_latency_ms"]["n"] >= 12
+
+
+def test_router_health_is_cheap_and_identified(fleet):
+    *_, router, _loops, _ports, _swaps, _ = fleet
+    router.poll_once()
+    h = router.health()
+    assert h["fleet"] is True and h["backends"] == 2
+    assert set(h["per_backend"]) == {"backend-0", "backend-1"}
+    row = h["per_backend"]["backend-0"]
+    assert row["state"] == "closed" and row["listen"] is not None
+
+
+def test_backend_identity_block_on_the_wire(fleet):
+    """Satellite: {"op":"health"} and {"op":"metrics"} replies carry the
+    stable host_id + listen address (anonymous replies cannot be attributed
+    after a failover)."""
+    *_, ports, _swaps, _ = fleet
+    with socket.create_connection(("127.0.0.1", ports[0]), timeout=10.0) as sk:
+        fh = sk.makefile("rw")
+        fh.write(json.dumps({"op": "health"}) + "\n")
+        fh.flush()
+        h = json.loads(fh.readline())["health"]
+        assert h["host_id"] == "backend-0"
+        assert h["listen"] == f"127.0.0.1:{ports[0]}"
+        fh.write(json.dumps({"op": "metrics"}) + "\n")
+        fh.flush()
+        m = json.loads(fh.readline())["metrics"]
+        assert m["host_id"] == "backend-0" and m["listen"].endswith(str(ports[0]))
+
+
+# ---------------------------------------------------------------------------
+# Ejection, failover, fleet-wide dedup (the satellite pin)
+# ---------------------------------------------------------------------------
+
+
+def _eject(backend) -> None:
+    while backend.state.live():
+        backend.state.record_failure()
+
+
+def test_dedup_holds_across_ejection_and_failover(fleet):
+    """Satellite pin: a ServeClient same-id retry against a backend that is
+    healthy-then-ejected-then-readmitted lands EXACTLY ONE dispatch
+    fleet-wide — the router's dedup re-attaches the retry even though the
+    original backend is out of rotation, where per-backend server dedup
+    alone would re-dispatch on the failover host."""
+    cfg, engine, samples, router, loops, ports, _swaps, aloop = fleet
+    ready: Future = Future()
+    task = asyncio.run_coroutine_threadsafe(
+        route_async(router, "127.0.0.1", 0, ready), aloop
+    )
+    front_port = ready.result(timeout=30.0)
+    try:
+        with ServeClient("127.0.0.1", front_port, timeout_s=10.0,
+                         retries=1, backoff_s=0.01, seed=0) as client:
+            rid = "fleet-dup-1"
+            before = _fleet_completed(loops)
+            rep1 = client.request(samples["x"][0], rid=rid)
+            assert rep1["ok"] is True
+            served_by = router._candidates(rid)[0]
+            # the serving backend leaves rotation (healthy -> ejected)
+            _eject(served_by)
+            assert not served_by.state.live()
+            # the same-id retry (reconnect shape: fresh connection, same id)
+            rep2 = client.request(samples["x"][0], rid=rid)
+            assert rep2["ok"] is True and rep2["h"] == rep1["h"]
+            assert rep2["pred"] == rep1["pred"]
+            assert _fleet_completed(loops) == before + 1  # ONE dispatch fleet-wide
+            assert router.dedup.hits >= 1
+            # a FRESH id routes around the ejected host (failover order)
+            rep3 = client.request(samples["x"][1], rid="fleet-dup-2")
+            assert rep3["ok"] is True
+            # re-admission: the backend is actually healthy, so the next
+            # poll probes it back in (eject_s=0.2)
+            time.sleep(0.25)
+            router.poll_once()
+            assert served_by.state.live()
+            assert router.router_summary()["readmissions"] >= 1
+    finally:
+        task.cancel()
+
+
+def test_ejected_fleet_gives_up_typed(fleet):
+    *_, router, loops, _ports, _swaps, _ = fleet
+    for b in router.backends:
+        _eject(b)
+    try:
+        rep = router.request({"id": "nobody-home", "x": [[0.0]]})
+        assert rep["ok"] is False and rep["reason"].startswith("no_backend")
+    finally:
+        for b in router.backends:
+            b.state._lock.acquire()
+            b.state._state = "closed"
+            b.state._fails = 0
+            b.state._lock.release()
+
+
+def test_front_socket_hardening(fleet):
+    """Router-side socket garbage (the chaos class): bad JSON gets a typed
+    reply with the connection surviving; the next line still serves."""
+    cfg, engine, samples, router, loops, ports, _swaps, aloop = fleet
+    ready: Future = Future()
+    task = asyncio.run_coroutine_threadsafe(
+        route_async(router, "127.0.0.1", 0, ready, conn_timeout_s=30.0), aloop
+    )
+    front_port = ready.result(timeout=30.0)
+    try:
+        with socket.create_connection(("127.0.0.1", front_port), timeout=10.0) as sk:
+            fh = sk.makefile("rw")
+            sk.sendall(b"NOT JSON {{{\n")
+            assert json.loads(fh.readline()) == {"ok": False, "reason": "bad_json"}
+            fh.write(json.dumps(
+                {"id": "after-garbage", "x": samples["x"][0].tolist()}
+            ) + "\n")
+            fh.flush()
+            assert json.loads(fh.readline())["ok"] is True
+            # a non-object line is a typed bad_request, not a dropped conn
+            fh.write(json.dumps([1, 2, 3]) + "\n")
+            fh.flush()
+            rep = json.loads(fh.readline())
+            assert rep["ok"] is False and rep["reason"].startswith("bad_request")
+    finally:
+        task.cancel()
+
+
+# ---------------------------------------------------------------------------
+# Verb fan-out: swap all-or-report-partial, fleet metrics through FleetPoller
+# ---------------------------------------------------------------------------
+
+
+def test_swap_fanout_all_and_partial(fleet):
+    cfg, engine, samples, router, loops, ports, swaps, _ = fleet
+    router.poll_once()
+    rec = router.swap_fanout({"hdce": "hdce_last"})
+    assert rec["ok"] is True and rec["partial"] is False
+    assert rec["ok_count"] == 2 and rec["fanned_to"] == 2 and rec["skipped"] == []
+    assert swaps[0].calls == 1 and swaps[1].calls == 1
+    assert set(rec["backends"]) == {"backend-0", "backend-1"}
+    assert all(r["ok"] for r in rec["backends"].values())
+    # one backend's swap now fails typed (corrupt-checkpoint shape):
+    # all-or-report-partial — ok flips false, the per-host report names it
+    swaps[1].fail = True
+    rec = router.swap_fanout(None)
+    assert rec["ok"] is False and rec["partial"] is True and rec["ok_count"] == 1
+    assert "swap_failed" in rec["backends"]["backend-1"]["reason"]
+    swaps[1].fail = False
+    # an EJECTED backend is skipped, not failed: the survivors' swap still
+    # counts as a fleet success (ejection never suspends adaptation)
+    _eject(router.backends[1])
+    try:
+        rec = router.swap_fanout(None)
+        assert rec["ok"] is True and rec["partial"] is True
+        assert rec["skipped"] == ["backend-1"] and rec["fanned_to"] == 1
+    finally:
+        time.sleep(0.25)
+        router.poll_once()  # readmit (eject_s=0.2, healthy backend)
+        assert router.backends[1].state.live()
+
+
+def test_fleet_poller_swap_raises_on_live_failure(fleet):
+    *_, router, _loops, _ports, swaps, _ = fleet
+    poller = FleetPoller(router)
+    swaps[0].fail = True
+    try:
+        with pytest.raises(RuntimeError, match="fleet swap partial"):
+            poller.swap({"hdce": "hdce_last"})
+    finally:
+        swaps[0].fail = False
+    rec = poller.swap({"hdce": "hdce_last"})
+    assert rec["ok"] is True
+
+
+def test_controller_ticks_over_aggregated_fleet(fleet, tmp_path):
+    """The FleetController consumes the router's AGGREGATED metrics exactly
+    like one host's: per-scenario windows difference the summed counters,
+    drift fires on the harness parity feed, and (dry_run) the adapt decision
+    is reported — detection spans hosts without any controller change."""
+    from qdml_tpu.config import override
+    from qdml_tpu.control.loop import FleetController
+
+    cfg, engine, samples, router, loops, ports, _swaps, _ = fleet
+    ctl_cfg = override(cfg, "control.dry_run", True)
+    ctl_cfg = override(ctl_cfg, "control.min_window", 4)
+    ctrl = FleetController(
+        ctl_cfg, str(tmp_path), FleetPoller(router), drift_step_hint=1
+    )
+    x0 = samples["x"][0].tolist()
+    for i in range(10):
+        assert router.request({"id": f"tick-a-{i}", "x": x0})["ok"]
+    out = ctrl.tick()  # first poll: baseline window
+    assert out["tick"] == 1
+    for i in range(10):
+        assert router.request({"id": f"tick-b-{i}", "x": x0})["ok"]
+    out = ctrl.tick()
+    assert out["tick"] == 2  # windowed the summed per-scenario counters
+    # drift on the ground-truth parity feed -> a dry-run adapt decision
+    for v in [-12.0] * 6 + [-6.0] * 8:
+        ctrl.observe_parity(0, v)
+    out = ctrl.tick()
+    assert any(e.get("action") == "adapt" for e in out["events"])
+
+
+def test_scale_fleet_targets_deepest_queue_host(fleet, monkeypatch):
+    """scale_fleet differences the fleet total and grows the deepest-queue
+    host (the autoscaler's WHICH-host decision). ServeLoop backends have no
+    scale verb, so the backend exchange is faked at Backend.call — the
+    decision logic, not the serve verb, is under test here (the real verb
+    is pinned in test_control/test_serve; the dryrun drives it end to end)."""
+    *_, router, _loops, _ports, _swaps, _ = fleet
+    monkeypatch.setattr(router, "poll_once", lambda: None)
+    b0, b1 = router.backends
+    b0.replicas, b0.queue_depth = 1, 9
+    b1.replicas, b1.queue_depth = 1, 0
+    calls = []
+
+    def fake_call(self, msg, **kw):
+        calls.append((self.host_id, msg["replicas"]))
+        return {"ok": True, "scale": {"replicas": msg["replicas"]}}
+
+    monkeypatch.setattr(type(b0), "call", fake_call)
+    rec = router.scale_fleet(4)
+    assert rec["replicas_before"] == 2 and rec["replicas"] == 4
+    # both grows land on the deep-queue host, absolute targets in order
+    assert calls == [(b0.host_id, 2), (b0.host_id, 3)]
+    assert rec["actions"][-1] == {"backend": b0.host_id, "replicas": 3}
+    # the poll thread stays the SINGLE writer of Backend.replicas: the
+    # scale arithmetic runs on a local snapshot and never mutates it (a
+    # stale health reply landing mid-loop must not desync the targets)
+    assert b0.replicas == 1 and b1.replicas == 1
+    # scale-down (counts as the next poll would report them): only hosts
+    # above 1 replica shrink — never below 1 per host
+    calls.clear()
+    b0.replicas = 3
+    rec = router.scale_fleet(2)
+    assert rec["replicas"] == 2
+    assert calls == [(b0.host_id, 2), (b0.host_id, 1)]
+    b0.replicas = 1
+    b0.queue_depth = b1.queue_depth = 0
+
+
+# ---------------------------------------------------------------------------
+# Lint: the router's lock discipline rows are armed
+# ---------------------------------------------------------------------------
+
+
+def test_lock_map_covers_router_state():
+    """Unlocked touches of the ejection state machine / dedup table in a
+    file at the router's path are findings; the locked twins are clean (the
+    LOCK_MAP fixture idiom of tests/test_analysis.py)."""
+    import ast
+
+    from qdml_tpu.analysis.engine import ModuleContext
+    from qdml_tpu.analysis.rules import rule_serve_lock_discipline
+
+    src = (
+        "import threading\n"
+        "class BackendState:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._state = 'closed'\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            return self._state\n"
+        "    def unlocked(self):\n"
+        "        return self._state\n"
+        "class RouterDedup:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._entries = {}\n"
+        "    def racy(self, rid):\n"
+        "        return self._entries.get(rid)\n"
+    )
+    path = "qdml_tpu/fleet/router.py"
+    ctx = ModuleContext(path, path, src, ast.parse(src))
+    findings = rule_serve_lock_discipline(ctx)
+    assert {f.line for f in findings} == {10, 16}
+    # the real module is clean (also covered by the repo-wide lint gate)
+    ctx_other = ModuleContext("other/file.py", "other/file.py", src, ast.parse(src))
+    assert rule_serve_lock_discipline(ctx_other) == []
