@@ -954,6 +954,20 @@ def _bench_serve_infer(
         engine.infer(x)
         hist.add(time.perf_counter() - t1)
     wall = time.perf_counter() - t0
+    # Phase decomposition columns (request tracing, docs/TELEMETRY.md):
+    # measured AFTER the timed loop on a handful of traced dispatches — the
+    # timed loop itself stays untraced, exactly the production trace_sample=0
+    # fast path the rate above measures. compute = executable + device fence,
+    # fetch = device->host reply copy, both host-clock durations off the
+    # DispatchInfo, so a future serve_infer regression is attributable to the
+    # phase that moved instead of one opaque batch_ms.
+    ph_compute, ph_fetch = Histogram(), Histogram()
+    for _ in range(min(n, 20)):
+        *_rest, tinfo = engine.infer(x, traced=True)
+        if tinfo.compute_s is not None:
+            ph_compute.add(tinfo.compute_s)
+        if tinfo.fetch_s is not None:
+            ph_fetch.add(tinfo.fetch_s)
     rec = {
         # valid rows/s == goodput: padded rows never count, in either mode
         "samples_per_sec": round(n * n_valid / wall, 1),
@@ -964,6 +978,10 @@ def _bench_serve_infer(
         "n_valid": n_valid,
         "warmup_s": round(warmup_s, 3),
         "batch_ms": hist.summary(),
+        "phases": {
+            "compute": ph_compute.summary(),
+            "fetch": ph_fetch.summary(),
+        },
         "compile_cache_after_warmup": engine.request_path_compiles(),
         # the single bucket's COMPILED cost record (warmup holds the AOT
         # executable, so peak temp memory is available here)

@@ -109,6 +109,9 @@ LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
             "_made": "_clients_lock",
         },
         "RouterDedup": {"_entries": "_lock"},
+        # traced-request net-wire histogram: fed by every request executor
+        # thread that traced a forward, read by the metrics aggregation
+        "FleetRouter": {"_trace_wire": "_trace_lock"},
     },
     # fleet-control shared state (docs/CONTROL.md): the controller tick
     # thread writes these while status/report paths read them
@@ -287,6 +290,16 @@ TRANSIENT_IO_EXCEPTIONS: frozenset[str] = frozenset(
 # asyncio.wait_for (serve/server._read_line).
 UNBOUNDED_READ_CALLS: frozenset[str] = frozenset(
     {"readline", "readexactly", "readuntil"}
+)
+
+# Request-tracing construction/stamping API (telemetry/tracing.py). Tracing
+# is HOST-SIDE ONLY by contract: a TraceContext built — or a phase stamped —
+# inside jit-compiled or pallas code would freeze its wall-clock value at
+# trace time (the wall-clock-in-jit hazard wearing a tracing hat) and break
+# the serve.trace_sample=0 HLO-identity pin (rule trace-in-jit-path).
+# Matched on the callee's last name/attribute segment.
+TRACE_STAMP_CALLS: frozenset[str] = frozenset(
+    {"TraceContext", "trace_sampled", "add_phase"}
 )
 
 # Per-gate matrix constructors (quantum/circuits.py, quantum/statevector.py):

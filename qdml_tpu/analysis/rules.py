@@ -1036,6 +1036,86 @@ def rule_unbounded_readline(ctx: ModuleContext) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 19. trace-in-jit-path — request-tracing stamps reachable from compiled code
+# ---------------------------------------------------------------------------
+
+
+def rule_trace_in_jit_path(ctx: ModuleContext) -> list[Finding]:
+    """A request-tracing call (``project.TRACE_STAMP_CALLS``: TraceContext
+    construction, ``trace_sampled``, ``add_phase`` stamping) inside a
+    jit-reachable function OR a pallas kernel body. Tracing is host-side
+    ONLY by contract (docs/TELEMETRY.md): inside a traced program the stamp
+    would evaluate once at trace time and compile to a constant — the
+    ``wall-clock-in-jit`` hazard — and any real data flow from it would
+    change the program, breaking the ``serve.trace_sample=0`` HLO-identity
+    pin. Pallas reachability is computed here (``pallas_call`` is not a
+    generic tracing entry point): functions passed by name into a
+    ``pallas_call`` — directly or through ``functools.partial`` — seed a
+    same-module call closure, mirroring ``collective-outside-shardmap``.
+    Deliberately NOT caught: stamping in host-side serve/router/loadgen code
+    (the entire sanctioned surface), and cross-module call chains (the
+    tracing API is never passed across modules into jitted code here — a
+    helper that wants to trace belongs on the host side of the dispatch)."""
+    defs: dict[str, ast.AST] = {
+        node.name: node for node in ast.walk(ctx.tree) if isinstance(node, _FuncNode)
+    }
+    pallas_seeds: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = (ctx.canonical(node.func) or dotted_name(node.func) or "")
+        if callee.rsplit(".", 1)[-1] != "pallas_call":
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name) and sub.id in defs:
+                    pallas_seeds.add(sub.id)
+    # same-module closure from the kernel bodies (a kernel helper that
+    # stamps is just as compiled as the kernel itself)
+    region: set[str] = set()
+    frontier = list(pallas_seeds)
+    while frontier:
+        name = frontier.pop()
+        if name in region:
+            continue
+        region.add(name)
+        for sub in ast.walk(defs[name]):
+            if isinstance(sub, ast.Call):
+                callee = dotted_name(sub.func) or ""
+                tail = callee.rsplit(".", 1)[-1]
+                if tail in defs and tail not in region:
+                    frontier.append(tail)
+    compiled: list[tuple[ast.AST, str]] = [
+        (fn, "jit-reachable") for fn in ctx.traced
+    ] + [
+        (defs[name], "pallas-kernel") for name in sorted(region)
+        if defs[name] not in ctx.traced
+    ]
+    out: list[Finding] = []
+    for fn, kind in compiled:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = ctx.canonical(sub.func) or dotted_name(sub.func) or ""
+            if callee.rsplit(".", 1)[-1] not in project.TRACE_STAMP_CALLS:
+                continue
+            out.append(
+                ctx.finding(
+                    "trace-in-jit-path",
+                    sub,
+                    f"request-tracing call {callee!r} in {kind} "
+                    f"{ctx.qualname(fn) or fn.name!r}: tracing is host-side "
+                    "only — inside compiled code the stamp freezes at trace "
+                    "time (wall-clock-in-jit's shape) and breaks the "
+                    "trace_sample=0 HLO-identity pin; stamp around the "
+                    "dispatch, never inside it (serve/server._serve_one is "
+                    "the sanctioned site)",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -1111,6 +1191,10 @@ RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
     "unbounded-readline": (
         rule_unbounded_readline,
         "await reader.readline() with no timeout in serve paths",
+    ),
+    "trace-in-jit-path": (
+        rule_trace_in_jit_path,
+        "TraceContext construction / phase stamping reachable from jit or pallas code",
     ),
     # "slow-marker" is data-driven (needs a --durations report) and lives in
     # qdml_tpu.analysis.slowmarkers; the CLI folds it in when given the data.

@@ -38,7 +38,11 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
                                   # --serve.batching=auto|bucket|ragged picks
                                   # pad-to-bucket coalescing vs traced
                                   # valid-count continuous batching (auto =
-                                  # per-capacity race table, docs/SERVING.md)
+                                  # per-capacity race table, docs/SERVING.md);
+                                  # --serve.trace_sample=F samples phase-
+                                  # decomposed request traces (batch_wait/
+                                  # queue_wait/compute/fetch [+router wire],
+                                  # docs/TELEMETRY.md; 0 = off, overhead-free)
     python -m qdml_tpu.cli loadgen [--rate=RPS] [--n=N] [--drift-at=K]
                                   # open-loop traffic
                                   # (--serve.arrival=poisson|bursty|diurnal)

@@ -363,6 +363,17 @@ class ServeConfig:
     # double-dispatching (the client retry contract, docs/RESILIENCE.md).
     # Entries expire after dedup_ttl_s; 0 disables dedup.
     dedup_ttl_s: float = 30.0
+    # Per-request phase tracing sample rate (telemetry/tracing.py,
+    # docs/TELEMETRY.md "request tracing"): the fraction of requests that
+    # carry a TraceContext decomposing enqueue->result latency into
+    # batch_wait / queue_wait / compute / fetch (+ router wire) phase spans,
+    # sampled deterministically on the request id so client, router and
+    # backends agree without a wire bit. 0 (default) is pinned overhead-free:
+    # no context objects, no clock stamps, HLO-identical executables, zero
+    # extra compiles/host transfers. Tracing is host-side ONLY — it never
+    # touches jitted code (graftlint rule trace-in-jit-path). The fleet
+    # router reads this same knob for its wire-span sampling.
+    trace_sample: float = 0.0
     # Local socket endpoint for `qdml-tpu serve`.
     host: str = "127.0.0.1"
     port: int = 8377

@@ -46,6 +46,9 @@ def router_from_config(cfg: ExperimentConfig, seed: int = 0) -> FleetRouter:
         failover=fl.failover,
         dedup_ttl_s=fl.dedup_ttl_s,
         seed=seed,
+        # the SAME knob the serve tier samples on (deterministic id hash):
+        # router and backends agree per request without a config handshake
+        trace_sample=cfg.serve.trace_sample,
     )
 
 

@@ -58,6 +58,7 @@ from qdml_tpu.serve.breaker import CLOSED, HALF_OPEN, OPEN
 from qdml_tpu.serve.client import ServeClient, ServeClientError
 from qdml_tpu.telemetry import Histogram
 from qdml_tpu.telemetry.spans import get_sink
+from qdml_tpu.telemetry.tracing import trace_sampled
 
 # transport-level failures that count against a backend's ejection state;
 # a typed ok=false REPLY (bad_request, shed) is a healthy backend answering
@@ -362,6 +363,66 @@ class RouterDedup:
                     del self._entries[rid]
 
 
+def _trace_prepend_router(rep: dict, rid, pick_s: float | None,
+                          attempts: list[dict]) -> dict:
+    """Compose the reply's wire-format trace: router spans (balancing pick,
+    one ``wire`` span per attempt — failed attempts included, so failover
+    retries read as separate spans) PREPENDED to the backend's own phases.
+    All router durations are router-clock measurements of router-owned
+    intervals; the backend's phase durations pass through untouched. The
+    successful attempt's wire span is NET — its exchange duration minus the
+    backend's own reported serve total — so the phase list PARTITIONS the
+    request's time instead of counting the backend twice; that subtraction
+    is duration-minus-duration (clock-skew-free — what is never done is
+    differencing the two hosts' timestamps). Failed attempts have no server
+    total: their wire span is the full measured attempt."""
+    if not isinstance(rep, dict):
+        return rep
+    rep = dict(rep)
+    backend_tr = rep.get("trace") if isinstance(rep.get("trace"), dict) else {}
+    phases: list = []
+    if pick_s is not None:
+        phases.append(["pick", round(pick_s * 1e3, 3)])
+    phases += [["wire", a["wire_ms"]] for a in attempts]
+    phases += list(backend_tr.get("phases") or [])
+    detail = dict(backend_tr.get("detail") or {})
+    detail["router"] = {
+        "attempts": attempts,
+        "failover_retries": sum(1 for a in attempts if not a.get("ok")),
+    }
+    tr: dict = {"id": rid, "phases": phases, "detail": detail}
+    if isinstance(backend_tr.get("total_ms"), (int, float)):
+        # the backend's enqueue->resolve total (ITS clock): kept verbatim —
+        # the client-side reconciliation compares its OWN wall clock against
+        # the phase-duration sum, never against this foreign timestamp base
+        tr["total_ms"] = backend_tr["total_ms"]
+    rep["trace"] = tr
+    return rep
+
+
+def _trace_dedup_reattach(rep: dict, rid, wait_s: float) -> dict:
+    """Trace for a retry that re-attached to the original in-flight forward:
+    one ``dedup_wait`` span (this retry dispatched NOTHING) prepended to the
+    original reply's trace, plus the detail flag the dryrun's kill-spanning
+    dedup pin reads."""
+    if not isinstance(rep, dict):
+        return rep
+    rep = dict(rep)
+    orig = rep.get("trace") if isinstance(rep.get("trace"), dict) else {}
+    detail = dict(orig.get("detail") or {})
+    detail["dedup_reattached"] = True
+    tr = {
+        "id": rid,
+        "phases": [["dedup_wait", round(wait_s * 1e3, 3)]]
+        + list(orig.get("phases") or []),
+        "detail": detail,
+    }
+    if isinstance(orig.get("total_ms"), (int, float)):
+        tr["total_ms"] = orig["total_ms"]
+    rep["trace"] = tr
+    return rep
+
+
 class FleetRouter:
     """The front-door fan-out over per-host replica pools (docs/FLEET.md)."""
 
@@ -379,6 +440,7 @@ class FleetRouter:
         dedup_ttl_s: float = 30.0,
         seed: int = 0,
         clock: Callable[[], float] = time.monotonic,
+        trace_sample: float = 0.0,
     ):
         if balance not in ("hash", "least_queue"):
             raise ValueError(f"fleet.balance must be hash|least_queue, got {balance!r}")
@@ -387,6 +449,15 @@ class FleetRouter:
         self.balance = balance
         self.failover = max(0, int(failover))
         self.poll_interval_s = float(poll_interval_s)
+        # Request-tracing sample rate (telemetry/tracing.py, same knob the
+        # serve tier reads — serve.trace_sample): a sampled (or client-forced
+        # "trace": true) request is forwarded with the trace bit set so the
+        # backend decomposes its own latency, and the router PREPENDS its
+        # tier's spans — balancing pick, one wire span PER ATTEMPT (failover
+        # retries stay visible as separate spans), dedup re-attachment wait.
+        # Every router span is measured on the router's own clock around its
+        # own send->reply exchange; backend clocks are never read.
+        self.trace_sample = float(trace_sample)
         self.backends = [
             Backend(
                 h, p, timeout_s=timeout_s, retries=retries,
@@ -414,6 +485,14 @@ class FleetRouter:
         self._failovers = 0
         self._no_backend = 0
         self._counter_lock = threading.Lock()
+        # traced requests' NET wire spans (exchange minus backend-reported
+        # serve total; failed attempts at full duration) — raw samples live
+        # HERE, so the fleet phase table's wire row has exact quantiles while
+        # backend phases aggregate by exact (n, sum). Request executor
+        # threads add concurrently: every touch holds _trace_lock
+        # (graftlint LOCK_MAP, analysis/project.py).
+        self._trace_lock = threading.Lock()
+        self._trace_wire = Histogram()
         self._poll_stop = threading.Event()
         self._poll_thread: threading.Thread | None = None
 
@@ -511,40 +590,76 @@ class FleetRouter:
     def request(self, msg: dict) -> dict:
         """Forward one inference request: fleet-wide dedup, balanced backend
         choice, bounded failover, typed give-up. Blocking (the asyncio
-        front-end calls this on executor threads)."""
+        front-end calls this on executor threads). Traced requests (client
+        ``"trace": true`` or the router's own id-hash sample) get the trace
+        bit forwarded downstream and the router's spans prepended to the
+        backend's reply trace."""
         rid = msg.get("id")
+        trace = bool(msg.get("trace")) or (
+            rid is not None and trace_sampled(rid, self.trace_sample)
+        )
+        if trace and not msg.get("trace"):
+            msg = {**msg, "trace": True}
         if self.dedup is not None and rid is not None:
             entry, fresh = self.dedup.begin(rid)
             if not fresh:
                 # retry re-attachment: the original forward (possibly to a
                 # backend that has SINCE been ejected) answers this retry —
                 # exactly one dispatch fleet-wide per id
+                t_wait = time.perf_counter() if trace else None
                 if not entry["ev"].wait(self._dedup_wait_s):
                     return {"id": rid, "ok": False,
                             "reason": "router_timeout: original forward still in flight"}
-                return dict(entry["rep"] or {"id": rid, "ok": False,
-                                             "reason": "router_error: empty dedup entry"})
+                rep = dict(entry["rep"] or {"id": rid, "ok": False,
+                                            "reason": "router_error: empty dedup entry"})
+                if trace:
+                    # the retry's own story: it waited on the ORIGINAL
+                    # dispatch (zero new wire exchanges) — the span that
+                    # makes "identical reply, one dispatch" attributable
+                    rep = _trace_dedup_reattach(
+                        rep, rid, time.perf_counter() - t_wait
+                    )
+                return rep
             try:
-                rep = self._forward(msg, rid)
+                rep = self._forward(msg, rid, trace=trace)
             except BaseException:
                 self.dedup.finish(rid, entry, None)
                 raise
             self.dedup.finish(rid, entry, rep)
             return rep
-        return self._forward(msg, rid)
+        return self._forward(msg, rid, trace=trace)
 
-    def _forward(self, msg: dict, rid) -> dict:
+    def _forward(self, msg: dict, rid, trace: bool = False) -> dict:
         tried = 0
         last_err: Exception | None = None
-        for b in self._candidates(rid):
+        attempts: list[dict] = []
+        t_pick = time.perf_counter() if trace else None
+        candidates = self._candidates(rid)
+        pick_s = (time.perf_counter() - t_pick) if trace else None
+        for b in candidates:
             if tried > self.failover:
                 break
             if not b.state.allow():
                 continue
             tried += 1
+            t_wire = time.perf_counter() if trace else None
             try:
                 rep = b.call(msg)
             except _FORWARD_ERRORS as e:
+                if trace:
+                    # the failed attempt's wire span stays in the trace: a
+                    # failover retry is exactly the tail event the
+                    # decomposition exists to attribute
+                    failed_ms = round((time.perf_counter() - t_wire) * 1e3, 3)
+                    attempts.append({
+                        "backend": b.host_id,
+                        "wire_ms": failed_ms,
+                        "exchange_ms": failed_ms,
+                        "ok": False,
+                        "error": type(e).__name__,
+                    })
+                    with self._trace_lock:
+                        self._trace_wire.add(failed_ms / 1e3)
                 last_err = e
                 if b.state.record_failure():
                     _emit_event(
@@ -555,10 +670,40 @@ class FleetRouter:
                     self._failovers += 1
                 continue
             b.state.record_success()
+            if trace:
+                exchange_ms = round((time.perf_counter() - t_wire) * 1e3, 3)
+                backend_tr = rep.get("trace") if isinstance(rep, dict) else None
+                server_ms = (
+                    backend_tr.get("total_ms")
+                    if isinstance(backend_tr, dict)
+                    and isinstance(backend_tr.get("total_ms"), (int, float))
+                    else None
+                )
+                # NET wire: exchange minus the backend's own serve total —
+                # duration-minus-duration (never a cross-host timestamp
+                # difference), so the trace's phases partition the request's
+                # time instead of counting the backend twice
+                wire_ms = (
+                    round(max(0.0, exchange_ms - server_ms), 3)
+                    if server_ms is not None
+                    else exchange_ms
+                )
+                attempt = {
+                    "backend": b.host_id,
+                    "wire_ms": wire_ms,
+                    "exchange_ms": exchange_ms,
+                    "ok": True,
+                }
+                if server_ms is not None:
+                    attempt["server_ms"] = server_ms
+                attempts.append(attempt)
+                with self._trace_lock:
+                    self._trace_wire.add(wire_ms / 1e3)
+                rep = _trace_prepend_router(rep, rid, pick_s, attempts)
             return rep
         with self._counter_lock:
             self._no_backend += 1
-        return {
+        rep = {
             "id": rid, "ok": False,
             "reason": (
                 "no_backend: "
@@ -567,6 +712,11 @@ class FleetRouter:
                    else "all backends ejected")
             ),
         }
+        if trace and attempts:
+            # a traced give-up still reports where its time went: every
+            # failed attempt's wire span, no backend phases to append
+            rep = _trace_prepend_router(rep, rid, pick_s, attempts)
+        return rep
 
     # -- fan-out / aggregated verbs -----------------------------------------
 
@@ -678,6 +828,12 @@ class FleetRouter:
                                    "latency_ms": h.summary()}
         with self._counter_lock:
             failovers, no_backend = self._failovers, self._no_backend
+        wire_summary = merged.summary()
+        if wire_summary is not None:
+            # (n, sum_ms) ride along so the wire phase row aggregates by the
+            # same exact-sum rule as the backend phase blocks — here the raw
+            # samples DO live router-side, so the quantiles are exact too
+            wire_summary["sum_ms"] = round(merged.sum() * 1e3, 3)
         return {
             "balance": self.balance,
             "backends": len(self.backends),
@@ -691,7 +847,7 @@ class FleetRouter:
             "readmissions": sum(
                 b.state.summary()["readmissions"] for b in self.backends
             ),
-            "wire_latency_ms": merged.summary(),
+            "wire_latency_ms": wire_summary,
             "per_backend_wire": per_wire,
         }
 
@@ -735,6 +891,15 @@ class FleetRouter:
         }
         slo_n = slo_met = 0
         slo_seen = False
+        # per-phase (n, sum_ms) EXACT sums across backends: quantiles cannot
+        # cross a process boundary exactly (the raw samples live in each
+        # backend), but counts and sums add — so the fleet mean per phase is
+        # exact, and the per-backend rows keep their own exact quantiles.
+        # The router's own wire phase is appended below from ITS raw
+        # histogram (router-side samples: exact quantiles AND sums).
+        phase_sum: dict[str, dict] = {}
+        trace_sampled_n = 0
+        trace_seen = False
         per_scen: dict[str, dict] = {}
         disp_over = disp_routed = 0
         disp_mode: set[str] = set()
@@ -763,6 +928,8 @@ class FleetRouter:
                 "rps": m.get("rps"),
                 "goodput_rps": m.get("goodput_rps"),
                 "latency_ms": m.get("latency_ms"),
+                "phases": m.get("phases"),
+                "trace": m.get("trace"),
                 "queue_depth_now": m.get("queue_depth_now"),
                 "replicas": m.get("replicas", m.get("workers")),
                 "workers": m.get("workers"),
@@ -792,6 +959,16 @@ class FleetRouter:
                 row = per_scen.setdefault(k, {"n": 0, "conf_sum": 0.0})
                 row["n"] += int(v.get("n") or 0)
                 row["conf_sum"] += float(v.get("conf_sum") or 0.0)
+            for name, blk in (m.get("phases") or {}).items():
+                if not isinstance(blk, dict):
+                    continue
+                row = phase_sum.setdefault(name, {"n": 0, "sum_ms": 0.0})
+                row["n"] += int(blk.get("n") or 0)
+                row["sum_ms"] += float(blk.get("sum_ms") or 0.0)
+            tcov = m.get("trace")
+            if isinstance(tcov, dict):
+                trace_seen = True
+                trace_sampled_n += int(tcov.get("sampled") or 0)
             disp = m.get("dispatch")
             if isinstance(disp, dict):
                 disp_seen = True
@@ -845,6 +1022,33 @@ class FleetRouter:
         rsum = self.router_summary()  # once: it copies+merges every
         # backend's latency histogram under its lock
         agg["latency_ms"] = rsum["wire_latency_ms"]
+        # fleet phase decomposition: backend phases as exact (n, sum_ms,
+        # mean_ms) sums; the router's OWN wire phase (net spans from traced
+        # requests) appended with full exact quantiles — its raw samples
+        # live here
+        phases: dict[str, dict] = {}
+        for name, row in phase_sum.items():
+            entry = {"n": row["n"], "sum_ms": round(row["sum_ms"], 3)}
+            if row["n"]:
+                entry["mean_ms"] = round(row["sum_ms"] / row["n"], 3)
+            phases[name] = entry
+        with self._trace_lock:
+            wire_summary = self._trace_wire.summary()
+            if wire_summary is not None:
+                wire_summary["sum_ms"] = round(self._trace_wire.sum() * 1e3, 3)
+        if wire_summary is not None:
+            phases["wire"] = wire_summary
+        agg["phases"] = phases or None
+        if trace_seen:
+            agg["trace"] = {
+                "sampled": trace_sampled_n,
+                "completed": agg["completed"],
+                "fraction": (
+                    round(trace_sampled_n / agg["completed"], 4)
+                    if agg["completed"]
+                    else None
+                ),
+            }
         agg["router"] = rsum
         agg["per_backend"] = per_backend
         return agg

@@ -114,7 +114,10 @@ class MicroBatcher:
     def submit(self, req: Request, now: float | None = None) -> Overloaded | None:
         """Admit ``req``; returns an :class:`Overloaded` (and does NOT enqueue)
         when the bounded queue is full or the deadline has already passed,
-        else ``None``."""
+        else ``None``. ``enqueue_ts`` (stamped here, from this batcher's
+        clock) is also the request trace's batcher-enqueue boundary — the
+        batch_wait/queue_wait phase split (docs/TELEMETRY.md) is computed
+        from it at dequeue, so tracing adds NO extra clock read on submit."""
         now = self.clock() if now is None else now
         req.enqueue_ts = now
         if req.deadline is not None and req.deadline <= now:

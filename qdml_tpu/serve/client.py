@@ -220,14 +220,28 @@ class ServeClient:
         rid: int | str | None = None,
         deadline_ms: float | None = None,
         timeout_s: float | None = None,
+        trace: bool = False,
     ) -> dict:
         """One inference request. ``rid`` defaults to a fresh uuid — the
         idempotency key the server dedups retries on; pass your own only if
         it is unique per LOGICAL request (reuse within ``serve.dedup_ttl_s``
-        intentionally returns the original result)."""
+        intentionally returns the original result).
+
+        ``trace=True`` sets the optional ``trace`` wire field, forcing a
+        phase trace for this request (docs/TELEMETRY.md): the reply then
+        carries ``trace.phases`` — server-side batch_wait/queue_wait/
+        compute/fetch spans, prepended with router pick/wire spans when the
+        endpoint is a fleet router. The client-observed wall time is the
+        caller's to measure ON ITS OWN CLOCK; it must never be differenced
+        against server timestamps (clock skew), only against the reply's
+        phase DURATIONS — the loadgen reconciliation does exactly that. A
+        retried id keeps its trace: the send is byte-stable per attempt and
+        the dedup tiers re-attach to the original traced dispatch."""
         if rid is None:
             rid = uuid.uuid4().hex
         msg = {"id": rid, "x": x if isinstance(x, list) else x.tolist()}
+        if trace:
+            msg["trace"] = True
         return self.call(msg, timeout_s=timeout_s, deadline_ms=deadline_ms)
 
     def health(self, timeout_s: float | None = None) -> dict:

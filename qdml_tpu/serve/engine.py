@@ -67,6 +67,7 @@ treats the pad tail is the third measured-dispatch choice
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 import jax
@@ -814,7 +815,7 @@ class ServeEngine:
     # -- request path -------------------------------------------------------
 
     def infer(
-        self, x: np.ndarray
+        self, x: np.ndarray, traced: bool = False
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, DispatchInfo]:
         """Serve one coalesced batch: pad to its bucket/capacity tier, run
         the pre-compiled executable (ragged tiers additionally thread the
@@ -824,6 +825,15 @@ class ServeEngine:
         confidence stat the serve metrics histogram and the drift detectors
         consume; ``info`` is the :class:`~qdml_tpu.serve.types.DispatchInfo`
         the goodput/padding-waste accounting consumes.
+
+        ``traced`` stamps the host-side compute/fetch phase boundaries onto
+        the DispatchInfo for the request-tracing decomposition
+        (docs/TELEMETRY.md): the executable call plus a device fence is the
+        ``compute`` phase, the device->host reply copy the ``fetch`` phase.
+        The fence adds nothing material — the very next statements fetch the
+        same buffers — and the untraced path (default) stamps NO clock: the
+        ``serve.trace_sample=0`` overhead-free pin. The executables are
+        identical either way; tracing never touches jitted code.
 
         Oversized batches (n > largest bucket — only reachable by direct
         callers; the micro-batcher caps at ``max_batch``) fall back to
@@ -841,7 +851,7 @@ class ServeEngine:
         if n > largest:
             hs, preds, confs, infos = [], [], [], []
             for lo in range(0, n, largest):
-                h, p, c, sub = self.infer(x[lo : lo + largest])
+                h, p, c, sub = self.infer(x[lo : lo + largest], traced=traced)
                 hs.append(h)
                 preds.append(p)
                 confs.append(c)
@@ -861,6 +871,14 @@ class ServeEngine:
                     rows=sum(i.rows for i in infos),
                     chunks=sum(i.chunks for i in infos),
                     mode=modes.pop() if len(modes) == 1 else "mixed",
+                    # traced chunked dispatch: phase durations SUM across
+                    # chunks (the request paid every launch sequentially)
+                    compute_s=(
+                        sum(i.compute_s or 0.0 for i in infos) if traced else None
+                    ),
+                    fetch_s=(
+                        sum(i.fetch_s or 0.0 for i in infos) if traced else None
+                    ),
                 ),
             )
         b = pick_bucket(n, self.buckets)  # lint: disable=pad-to-bucket-in-serve(THE sanctioned pad site: every request batch reaches XLA through this one tier pick + pad, where DispatchInfo accounts the waste)
@@ -871,10 +889,20 @@ class ServeEngine:
         hdce_live, clf_live = self.live_vars()
         mode = self.dispatch_mode.get(str(b), "dense")
         bmode = self.batching_mode.get(str(b), "bucket")
+        t_dispatch = time.perf_counter() if traced else None
         if mode == "sparse" or bmode == "ragged":
             out = self._compiled[b](hdce_live, clf_live, xp, np.int32(n))
         else:
             out = self._compiled[b](hdce_live, clf_live, xp)
+        t_fetch = None
+        if traced:
+            # compute/fetch boundary for the trace: fence the dispatch so the
+            # fetch segment below is the pure device->host copy, not "device
+            # still executing". Traced batches only — the next statements
+            # fetch these same buffers anyway, so the fence adds no stall,
+            # and the untraced path never syncs here.
+            jax.block_until_ready(out)  # lint: disable=host-sync-hot-path(traced-batch-only phase fence: the reply fetch on the next lines waits on the same buffers — same dispatch, no extra stall; serve.trace_sample=0 never reaches this branch)
+            t_fetch = time.perf_counter()
         overflow = None
         if self._checkify:
             err, res = out
@@ -906,9 +934,12 @@ class ServeEngine:
             with self._dispatch_lock:
                 self._overflow_rows += ovf
                 self._routed_rows += n
-        return (
-            np.asarray(jax.device_get(h))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
-            np.asarray(jax.device_get(pred))[:n],  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
-            np.asarray(jax.device_get(conf))[:n],  # lint: disable=host-sync-hot-path(per-request confidence fetched with the reply it annotates — same dispatch, no extra stall)
-            DispatchInfo(bucket=b, n=n, rows=b, chunks=1, mode=bmode),
-        )
+        out_h = np.asarray(jax.device_get(h))[:n]  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
+        out_pred = np.asarray(jax.device_get(pred))[:n]  # lint: disable=host-sync-hot-path(the one result fetch per served batch — this transfer IS the reply)
+        out_conf = np.asarray(jax.device_get(conf))[:n]  # lint: disable=host-sync-hot-path(per-request confidence fetched with the reply it annotates — same dispatch, no extra stall)
+        info = DispatchInfo(bucket=b, n=n, rows=b, chunks=1, mode=bmode)
+        if traced:
+            t_end = time.perf_counter()
+            info.compute_s = t_fetch - t_dispatch
+            info.fetch_s = t_end - t_fetch
+        return (out_h, out_pred, out_conf, info)

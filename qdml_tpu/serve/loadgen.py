@@ -56,6 +56,7 @@ from qdml_tpu.serve.metrics import ServeMetrics
 from qdml_tpu.serve.server import ReplicaPool
 from qdml_tpu.serve.types import Prediction
 from qdml_tpu.telemetry import span
+from qdml_tpu.telemetry.tracing import TraceContext
 from qdml_tpu.utils.metrics import nmse_db
 
 ARRIVAL_PROCESSES = ("poisson", "bursty", "diurnal")
@@ -192,6 +193,28 @@ def make_request_samples(
     parts.append(_gen(geom_d, scen_d, user[k:], start + j))
     x, h_perf, ind = (np.concatenate(cols) for cols in zip(*parts))
     return {"x": x, "h_perf": h_perf, "indicator": ind}
+
+
+def _trace_reconciliation(pairs: list[tuple[float, float]]) -> dict | None:
+    """Phase-sum vs end-to-end reconciliation over traced requests: ``pairs``
+    of (observed total, sum of reported phase durations), each element
+    measured on ONE clock (the total on the observer's clock, the phases as
+    durations on their own producers' clocks — durations compare across
+    hosts; timestamps never do, docs/TELEMETRY.md clock-skew rule). The
+    ``unattributed`` residual is stack/scheduling time no phase claims —
+    honest, never re-labeled as wire."""
+    if not pairs:
+        return None
+    n = len(pairs)
+    tot = sum(t for t, _ in pairs)
+    ph = sum(p for _, p in pairs)
+    return {
+        "n": n,
+        "mean_latency_ms": round(tot / n * 1e3, 3),
+        "mean_phase_sum_ms": round(ph / n * 1e3, 3),
+        "mean_unattributed_ms": round((tot - ph) / n * 1e3, 3),
+        "attributed_fraction": round(ph / tot, 4) if tot > 0 else None,
+    }
 
 
 def _window_stats(
@@ -359,13 +382,16 @@ def run_loadgen(
     # End-of-run poll of the live `{"op": "metrics"}` view, folded SLIM: the
     # summary below is already built from the same (merged) collectors, so
     # only the fields the verb adds ride along — replica/queue/bucket state
-    # plus `completed` as a cross-check that the verb saw the same window.
+    # plus `completed` as a cross-check that the verb saw the same window,
+    # plus the verb's trace/phase decomposition so every committed window
+    # carries it without a second round-trip (docs/TELEMETRY.md).
     live = pool.live_metrics()
     live_slim = {
-        k: live[k]
+        k: live.get(k)
         for k in (
             "workers", "replicas", "replica_completed",
             "queue_depth_now", "buckets", "completed", "swap_epoch",
+            "phases", "trace",
         )
     }
 
@@ -486,6 +512,17 @@ def run_loadgen(
         }
     if summary.get("rps") is not None and pool.n_replicas:
         summary["rps_per_replica"] = round(summary["rps"] / pool.n_replicas, 2)
+    if summary.get("trace"):
+        # phase sums vs the same requests' end-to-end latencies (both on the
+        # batcher clock here — the in-process path is single-clock by
+        # construction): the dryrun's reconciliation gate reads this
+        summary["trace"]["reconciliation"] = _trace_reconciliation(
+            [
+                (r.latency_s, r.trace.phase_sum_s())
+                for r in results
+                if isinstance(r, Prediction) and r.trace is not None
+            ]
+        )
     metrics_all.flush(
         compile_cache=cache_after, workers=pool.workers, replicas=pool.n_replicas
     )
@@ -567,6 +604,10 @@ def run_loadgen_socket(
     shed_counts: dict[str, int] = {}
     give_ups = 0
     replies: list[dict | None] = [None] * n
+    # (client wall, reported phase-duration sum) per traced reply — the
+    # reconciliation input; wall is THIS clock, phases are durations, no
+    # cross-host timestamp ever differenced
+    trace_pairs: list[tuple[float, float]] = []
 
     def _one(i: int) -> None:
         client = pool[i % len(pool)]
@@ -586,6 +627,10 @@ def run_loadgen_socket(
         replies[i] = rep
         wall = time.perf_counter() - t_req
         if rep.get("ok"):
+            # a traced reply's phase spans fold into the client-side phase
+            # histograms RAW (exact quantiles live harness-side), and its
+            # wall/phase-sum pair feeds the reconciliation fact
+            tr = TraceContext.from_wire(rep.get("trace"))
             p = Prediction(
                 rid=rep.get("id"),
                 h=np.asarray(rep.get("h", ()), np.float32),
@@ -597,9 +642,12 @@ def run_loadgen_socket(
                     None if deadline_ms is None else wall * 1e3 <= deadline_ms
                 ),
                 confidence=None,
+                trace=tr,
             )
             with mlock:
                 metrics.observe_prediction(p)
+                if tr is not None:
+                    trace_pairs.append((wall, tr.phase_sum_s()))
         else:
             reason = str(rep.get("reason", "error"))
             with mlock:
@@ -670,6 +718,10 @@ def run_loadgen_socket(
                     "workers", "replicas", "replica_completed", "queue_depth_now",
                     "buckets", "completed", "swap_epoch", "faults", "restarts",
                     "breaker",
+                    # the server/fleet-side trace decomposition rides the
+                    # SAME end-of-run poll — no second verb round-trip per
+                    # committed window (docs/TELEMETRY.md)
+                    "phases", "trace",
                 )
                 # fleet-router poll: the per-host rows and the router's own
                 # ledger ride along with the merged counters — never a
@@ -689,6 +741,8 @@ def run_loadgen_socket(
             else {}
         ),
     )
+    if summary.get("trace"):
+        summary["trace"]["reconciliation"] = _trace_reconciliation(trace_pairs)
     if logger is not None:
         logger.telemetry.write_raw(summary)
     return summary

@@ -23,6 +23,7 @@ import time
 from qdml_tpu.serve.types import DispatchInfo, Overloaded, Prediction
 from qdml_tpu.telemetry import Histogram
 from qdml_tpu.telemetry.spans import get_sink
+from qdml_tpu.telemetry.tracing import PHASES
 
 
 class ServeMetrics:
@@ -33,7 +34,17 @@ class ServeMetrics:
         self.log_requests = log_requests
         self.latency = Histogram()       # per-request enqueue -> result
         self.batch_fill = Histogram()    # valid/static rows per dispatch (0..1)
-        self.queue_depth = Histogram()   # depth at dequeue (stored as "seconds")
+        self.queue_depth = Histogram()   # depth at dequeue (unitless count)
+        # Per-phase latency decomposition from SAMPLED request traces
+        # (telemetry/tracing.py, docs/TELEMETRY.md): one histogram per phase
+        # name, raw seconds, so Histogram.merge aggregates replicas/workers
+        # exactly like the end-to-end latency. The five gated phases are
+        # pre-seeded; router-side auxiliary spans (pick, dedup_wait) land in
+        # histograms created on first sight. ``traced`` counts predictions
+        # that CARRIED a trace — the coverage fact the report states next to
+        # any phase claim (a p99 over 1% of requests is not the fleet's p99).
+        self.phase: dict[str, Histogram] = {p: Histogram() for p in PHASES}
+        self.traced = 0
         # Goodput-first row accounting. Three row ledgers, three meanings:
         # - rows_useful: rows the client could USE — completed within their
         #   deadline, or completed with no deadline offered (the serving
@@ -126,8 +137,16 @@ class ServeMetrics:
     def observe_prediction(self, p: Prediction) -> None:
         """Per-request accounting shared by :meth:`observe_batch` and the
         windowed loadgen summaries (which replay results into a fresh
-        collector): latency, SLO, per-scenario counts and confidence."""
+        collector): latency, SLO, per-scenario counts, confidence, and — for
+        the sampled traced fraction — the per-phase latency decomposition."""
         self.latency.add(p.latency_s)
+        if p.trace is not None:
+            self.traced += 1
+            for name, dur_s in p.trace.phases:
+                hist = self.phase.get(name)
+                if hist is None:
+                    hist = self.phase[name] = Histogram()
+                hist.add(dur_s)
         # goodput numerator: a late completion is throughput, not goodput
         if p.deadline_met is not False:
             self.rows_useful += 1
@@ -164,6 +183,12 @@ class ServeMetrics:
         self.batch_fill.merge(other.batch_fill)
         self.queue_depth.merge(other.queue_depth)
         self.confidence.merge(other.confidence)
+        for name, hist in other.phase.items():
+            mine = self.phase.get(name)
+            if mine is None:
+                mine = self.phase[name] = Histogram()
+            mine.merge(hist)
+        self.traced += other.traced
         self.batches += other.batches
         self.completed += other.completed
         self.rows_useful += other.rows_useful
@@ -240,18 +265,34 @@ class ServeMetrics:
             out[k] = rec
         return out
 
-    def _scaled(self, hist: Histogram) -> dict | None:
-        """Histogram.summary() without the ms scaling (fill/depth are not
-        durations; undo the *1e3 and rename)."""
-        s = hist.summary()
-        if s is None:
+    def phases(self) -> dict | None:
+        """Per-phase latency summaries from the traced sample (``None``
+        before any traced request): per phase, the exact quantile summary
+        PLUS ``(n, sum_ms)`` — the pair the fleet router sums EXACTLY across
+        backends (quantiles cannot cross a process boundary exactly; the raw
+        samples live here)."""
+        out: dict = {}
+        for name, hist in self.phase.items():
+            s = hist.summary()
+            if s is None:
+                continue
+            s["sum_ms"] = round(hist.sum() * 1e3, 3)
+            out[name] = s
+        return out or None
+
+    def trace_coverage(self) -> dict | None:
+        """The sampling fact that must sit next to any phase claim: how many
+        of the window's completed requests actually carried a trace. ``None``
+        when nothing was traced (a phase table with no stated coverage reads
+        as the whole fleet's decomposition when it may be 1% of it)."""
+        if not self.traced:
             return None
         return {
-            "n": s["n"],
-            "mean": round(s["mean_ms"] / 1e3, 4),
-            "p50": round(s["p50_ms"] / 1e3, 4),
-            "p95": round(s["p95_ms"] / 1e3, 4),
-            "max": round(s["max_ms"] / 1e3, 4),
+            "sampled": self.traced,
+            "completed": self.completed,
+            "fraction": (
+                round(self.traced / self.completed, 4) if self.completed else None
+            ),
         }
 
     def flush(self, compile_cache: dict | None = None, **tags) -> None:
@@ -264,8 +305,10 @@ class ServeMetrics:
                 "counters",
                 name="serve",
                 latency=self.latency.summary(),
-                batch_fill=self._scaled(self.batch_fill),
-                queue_depth=self._scaled(self.queue_depth),
+                phases=self.phases(),
+                trace=self.trace_coverage(),
+                batch_fill=self.batch_fill.summary(unit=None),
+                queue_depth=self.queue_depth.summary(unit=None),
                 batches=self.batches,
                 completed=self.completed,
                 goodput_rps=(
@@ -277,7 +320,7 @@ class ServeMetrics:
                 faults=dict(self.faults),
                 restarts=self.restarts,
                 slo=self.slo(),
-                confidence=self._scaled(self.confidence),
+                confidence=self.confidence.summary(unit=None),
                 per_scenario=self.per_scenario(),
                 compile_cache=compile_cache,
                 **tags,
@@ -319,12 +362,17 @@ class ServeMetrics:
             "rows": self.rows(),
             "slo": self.slo(),
             "latency_ms": self.latency.summary(),
-            "batch_fill": self._scaled(self.batch_fill),
-            "queue_depth": self._scaled(self.queue_depth),
+            # the phase decomposition of that latency (traced sample only)
+            # plus its coverage fact — where the time went, and how much of
+            # the window actually said so (docs/TELEMETRY.md)
+            "phases": self.phases(),
+            "trace": self.trace_coverage(),
+            "batch_fill": self.batch_fill.summary(unit=None),
+            "queue_depth": self.queue_depth.summary(unit=None),
             # classifier-confidence histogram + per-scenario counts/means:
             # the drift detectors' raw input, independently useful fleet
             # observability (docs/CONTROL.md)
-            "confidence": self._scaled(self.confidence),
+            "confidence": self.confidence.summary(unit=None),
             "per_scenario": self.per_scenario(),
             "compile_cache_after_warmup": compile_cache,
             **extra,

@@ -76,6 +76,7 @@ from qdml_tpu.serve.types import (
     Request,
 )
 from qdml_tpu.telemetry.spans import get_sink
+from qdml_tpu.telemetry.tracing import TraceContext, trace_sampled
 
 
 def _emit_event(name: str, **fields) -> None:
@@ -141,6 +142,7 @@ class ServeLoop:
         name: str = "serve-loop",
         faults: FaultPlan | None = None,
         breaker: CircuitBreaker | None = None,
+        trace_sample: float | None = None,
     ):
         serve_cfg = engine.cfg.serve
         self.engine = engine
@@ -180,6 +182,14 @@ class ServeLoop:
         self._default_deadline_s = (
             serve_cfg.deadline_ms / 1e3 if serve_cfg.deadline_ms > 0 else None
         )
+        # Phase-trace sampling rate (telemetry/tracing.py): deterministic on
+        # the request id, so a retried id stays traced across tiers. The
+        # override parameter exists for harnesses that vary the rate against
+        # ONE warmed engine (the engine's executables are identical either
+        # way — tracing is host-side only).
+        self._trace_sample = float(
+            serve_cfg.trace_sample if trace_sample is None else trace_sample
+        )
         self._stop = threading.Event()
         # wake rides on the BATCHER (its owner): pool replicas share the
         # queue, so a submit must reach whichever loop's worker is idle
@@ -200,12 +210,15 @@ class ServeLoop:
         x: np.ndarray,
         rid: int | str | None = None,
         deadline_ms: float | None = None,
+        trace: bool | None = None,
     ) -> Future:
         """Enqueue one request; the returned future resolves with a
         Prediction or Overloaded (never raises for overload). A malformed
         payload raises ``ValueError`` HERE, synchronously — client errors
         must never reach the worker, where one bad shape would crash the
-        batch it was coalesced into."""
+        batch it was coalesced into. ``trace`` forces (True) or suppresses
+        (False) the phase trace; None (default) samples by the id hash at
+        ``serve.trace_sample`` — 0 creates nothing, the overhead-free pin."""
         x = np.asarray(x, np.float32)
         expect = (*self.engine.cfg.image_hw, 2)
         if x.shape != expect:
@@ -237,11 +250,17 @@ class ServeLoop:
         deadline_s = (
             deadline_ms / 1e3 if deadline_ms is not None else self._default_deadline_s
         )
+        want_trace = (
+            trace
+            if trace is not None
+            else self._trace_sample > 0.0 and trace_sampled(rid, self._trace_sample)
+        )
         req = Request(
             rid=rid,
             x=x,
             deadline=None if deadline_s is None else now + deadline_s,
             future=Future(),
+            trace=TraceContext(rid) if want_trace else None,
         )
         rejected = self.batcher.submit(req, now=now)
         if rejected is not None:
@@ -364,6 +383,11 @@ class ServeLoop:
                 r.future.set_result(o)
         if not batch:
             return bool(shed)
+        # dequeue/dispatch trace boundary: stamped ONLY when the batch holds
+        # a traced request (trace_sample=0 adds zero clock calls here — the
+        # fake-clock tests and the overhead-free pin both count on it)
+        traced = any(r.trace is not None for r in batch)
+        t_dequeue = self.batcher.clock() if traced else None
         t0 = time.perf_counter()
         try:
             # stack INSIDE the guard: a shape-mismatched request failing the
@@ -375,7 +399,7 @@ class ServeLoop:
                 # every one of them with the failure, exactly like a real
                 # engine error (that equivalence is what the chaos proves)
                 self.faults.check_worker_batch(self.name)
-            h, pred, conf, info = self.engine.infer(x)
+            h, pred, conf, info = self.engine.infer(x, traced=traced)
         except BaseException as e:
             # a dying batch must not strand its clients: forward the failure
             # into every future, then let the loop's finally drain the rest
@@ -386,6 +410,27 @@ class ServeLoop:
         dur = time.perf_counter() - t0
         self._last_dispatch_ts = time.monotonic()
         now = self.batcher.clock()
+        if traced:
+            # batch_wait vs queue_wait split (docs/TELEMETRY.md): the batch's
+            # NEWEST member's enqueue time partitions each request's wait —
+            # everything before it is coalescing (waiting for later arrivals
+            # to batch with), everything after is the formed batch waiting
+            # for a free engine. Both from the one batcher clock that also
+            # stamps enqueue_ts and latency_s — never mixed with perf_counter.
+            newest = max(r.enqueue_ts for r in batch)
+            for r in batch:
+                if r.trace is None:
+                    continue
+                r.trace.add_phase("batch_wait", newest - r.enqueue_ts)
+                r.trace.add_phase("queue_wait", t_dequeue - newest)
+                if info.compute_s is not None:
+                    r.trace.add_phase("compute", info.compute_s)
+                if info.fetch_s is not None:
+                    r.trace.add_phase("fetch", info.fetch_s)
+                # future-resolution boundary closes the trace: the total IS
+                # the latency the reply reports, so phase sums reconcile
+                # against the same number the latency histogram sees
+                r.trace.total_s = now - r.enqueue_ts
         preds = []
         for i, r in enumerate(batch):
             p = Prediction(
@@ -397,6 +442,7 @@ class ServeLoop:
                 batch_n=len(batch),
                 deadline_met=None if r.deadline is None else now <= r.deadline,
                 confidence=float(conf[i]),
+                trace=r.trace,
             )
             preds.append(p)
         # metrics before resolution: a client awaiting the future must be able
@@ -497,6 +543,7 @@ class ReplicaPool:
         sink=None,
         log_requests: bool = True,
         faults: FaultPlan | None = None,
+        trace_sample: float | None = None,
     ):
         serve_cfg = engine.cfg.serve
         self.engine = engine
@@ -515,6 +562,7 @@ class ReplicaPool:
         self._log_requests = log_requests
         self._workers_per = workers
         self._faults = faults
+        self._trace_sample = trace_sample  # None = each loop reads cfg
         # ONE breaker fronts the pool: every replica's submit consults it,
         # and since submits funnel through replica 0 the state machine sees
         # every admission decision for the shared queue
@@ -571,6 +619,7 @@ class ReplicaPool:
             name=name,
             faults=self._faults,
             breaker=self.breaker,
+            trace_sample=self._trace_sample,
         )
 
     @property
@@ -776,11 +825,12 @@ class ReplicaPool:
         x: np.ndarray,
         rid: int | str | None = None,
         deadline_ms: float | None = None,
+        trace: bool | None = None,
     ) -> Future:
         """Validated enqueue into the SHARED feed (replica 0 fronts it; the
         liveness check is pool-wide through the coordinator, so work is
         accepted as long as ANY replica can serve it)."""
-        return self._front.submit(x, rid=rid, deadline_ms=deadline_ms)
+        return self._front.submit(x, rid=rid, deadline_ms=deadline_ms, trace=trace)
 
     def merged_metrics(self, sink=None) -> ServeMetrics:
         """Every replica's every worker folded into one collector — exact
@@ -863,7 +913,7 @@ class ReplicaPool:
 
 def _encode(res) -> dict:
     if isinstance(res, Prediction):
-        return {
+        out = {
             "id": res.rid,
             "ok": True,
             "pred": res.scenario,
@@ -871,6 +921,11 @@ def _encode(res) -> dict:
             "latency_ms": round(res.latency_s * 1e3, 3),
             "bucket": res.bucket,
         }
+        if res.trace is not None:
+            # the optional trace wire field (docs/SERVING.md): phase spans in
+            # ms — a fleet router PREPENDS its own pick/wire spans to these
+            out["trace"] = res.trace.to_wire()
+        return out
     return {"id": res.rid, "ok": False, "reason": res.reason}
 
 
@@ -1085,6 +1140,11 @@ async def _handle(
                         np.asarray(m["x"], np.float32),
                         rid=m.get("id"),
                         deadline_ms=m.get("deadline_ms"),
+                        # optional wire field: "trace": true forces a phase
+                        # trace for THIS request (a router propagating its
+                        # sampling decision downstream); absent = the
+                        # server's own serve.trace_sample decides
+                        trace=True if m.get("trace") else None,
                     )
 
                 if dedup is not None and rid is not None:

@@ -16,6 +16,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from qdml_tpu.telemetry.tracing import TraceContext
+
 # Overload reasons (the complete set; reasons are part of the wire contract)
 QUEUE_FULL = "queue_full"          # bounded queue at capacity on submit
 DEADLINE_AT_SUBMIT = "deadline_at_submit"    # deadline already past on admission
@@ -33,6 +35,11 @@ class Request:
     enqueue_ts: float = 0.0           # monotonic seconds, stamped on submit
     deadline: float | None = None     # absolute monotonic seconds; None = no deadline
     future: Future | None = None      # resolved with Prediction | Overloaded
+    # Sampled phase-trace context (telemetry/tracing.py): None for the
+    # untraced default — the overhead-free contract is that no stamp, no
+    # clock call and no allocation happens for a request with trace=None.
+    # ``enqueue_ts`` above doubles as the trace's batcher-enqueue boundary.
+    trace: TraceContext | None = None
 
 
 @dataclass
@@ -51,6 +58,12 @@ class DispatchInfo:
     rows: int            # total static rows dispatched across all chunks
     chunks: int = 1      # executable launches this call made
     mode: str = "bucket"  # tier batching mode ("bucket"|"ragged"; "mixed" across chunks)
+    # Host-measured phase durations for TRACED batches (summed over chunks):
+    # compute = executable call + device fence, fetch = device->host reply
+    # copy. None on the untraced fast path — infer stamps no clock unless the
+    # serve loop asked for a traced dispatch (docs/TELEMETRY.md).
+    compute_s: float | None = None
+    fetch_s: float | None = None
 
     @property
     def fill(self) -> float:
@@ -80,6 +93,11 @@ class Prediction:
     # per-scenario confidence histogram the drift detectors watch
     # (docs/CONTROL.md).
     confidence: float | None = None
+    # The request's sampled phase trace (telemetry/tracing.py), closed at
+    # future resolution — ServeMetrics folds its phases into the per-phase
+    # histograms and the socket reply carries it as the optional ``trace``
+    # field. None for untraced requests (the overwhelming default).
+    trace: TraceContext | None = None
 
     @property
     def ok(self) -> bool:

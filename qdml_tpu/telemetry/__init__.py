@@ -48,3 +48,8 @@ from qdml_tpu.telemetry.spans import (  # noqa: F401
     set_sink,
     span,
 )
+from qdml_tpu.telemetry.tracing import (  # noqa: F401
+    PHASES,
+    TraceContext,
+    trace_sampled,
+)

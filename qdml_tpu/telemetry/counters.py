@@ -46,10 +46,23 @@ class Histogram:
         self._vals.extend(other._vals)
         return self
 
-    def summary(self) -> dict | None:
+    def sum(self) -> float:
+        """Exact sample sum (same unit the samples were added in). Counters
+        that must aggregate EXACTLY across processes ship (n, sum) — two
+        integers/floats that add — where quantiles cannot (the raw samples
+        live in the producing process; see the router's phase aggregation)."""
+        return sum(self._vals)
+
+    def summary(self, unit: str | None = "ms") -> dict | None:
         """``{"n", "mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"}`` or
         None if empty (p99 exists for the serving path, whose SLOs are tail
-        latencies — train-loop readers ignore the extra key)."""
+        latencies — train-loop readers ignore the extra key).
+
+        ``unit=None`` summarizes UNITLESS samples honestly: no *1e3 scaling,
+        unsuffixed keys (``mean``/``p50``/``p95``/``p99``/``max``) — the
+        batch-fill / queue-depth / confidence collectors are counts and
+        fractions, not durations, and used to be stored "as seconds" and
+        rescaled on the way out."""
         if not self._vals:
             return None
         v = sorted(self._vals)
@@ -57,14 +70,19 @@ class Histogram:
         def pct(p: float) -> float:
             return v[min(len(v) - 1, int(round(p / 100.0 * (len(v) - 1))))]
 
-        ms = lambda s: round(s * 1e3, 3)  # noqa: E731
+        if unit == "ms":
+            fmt = lambda s: round(s * 1e3, 3)  # noqa: E731
+            sfx = "_ms"
+        else:
+            fmt = lambda s: round(s, 4)  # noqa: E731
+            sfx = ""
         return {
             "n": len(v),
-            "mean_ms": ms(sum(v) / len(v)),
-            "p50_ms": ms(pct(50)),
-            "p95_ms": ms(pct(95)),
-            "p99_ms": ms(pct(99)),
-            "max_ms": ms(v[-1]),
+            f"mean{sfx}": fmt(sum(v) / len(v)),
+            f"p50{sfx}": fmt(pct(50)),
+            f"p95{sfx}": fmt(pct(95)),
+            f"p99{sfx}": fmt(pct(99)),
+            f"max{sfx}": fmt(v[-1]),
         }
 
 

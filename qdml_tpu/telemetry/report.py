@@ -102,6 +102,8 @@ def _serving_from(obj: dict) -> dict | None:
         "latency": {},
         "rps": None,
         "platform": obj.get("platform"),
+        "phases": None,
+        "trace": None,
         "slo_attainment": None,
         "fleet": None,
         "n_scenarios": None,
@@ -145,6 +147,17 @@ def _serving_from(obj: dict) -> dict | None:
         brk.get("open_fraction"), (int, float)
     ):
         out["breaker_open_fraction"] = float(brk["open_fraction"])
+    # per-phase latency decomposition (request tracing, docs/TELEMETRY.md):
+    # the sampled traced fraction's batch_wait/queue_wait/compute/fetch/wire
+    # histograms plus the coverage fact — the report's attribution input (a
+    # p99 move gates per phase, so it is blamed on the phase that moved)
+    phases = obj.get("phases")
+    if isinstance(phases, dict):
+        ph = {k: v for k, v in phases.items() if isinstance(v, dict)}
+        out["phases"] = ph or None
+    tr = obj.get("trace")
+    if isinstance(tr, dict):
+        out["trace"] = tr
     slo = obj.get("slo")
     if isinstance(slo, dict) and isinstance(slo.get("attainment"), (int, float)):
         out["slo_attainment"] = float(slo["attainment"])
@@ -757,6 +770,113 @@ def build_report_data(
                  "current": c, "delta_pct": round(delta_pct, 2), "status": status_key}
             )
             lines.append(f"| {key} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status_md} |")
+
+    # Phase-decomposition section (request tracing, docs/TELEMETRY.md): the
+    # per-phase p99s from the traced sample, each gated EXACTLY like the
+    # end-to-end latency percentiles (up beyond threshold = regression, same
+    # platform arming rules) — so an end-to-end p99 move is ATTRIBUTED to
+    # the phase that moved instead of staying one opaque number. Router-
+    # aggregated blocks that carry only exact (n, sum, mean) — quantiles
+    # cannot cross a process boundary — contribute no p99 row and are shown
+    # as coverage only.
+    base_ph = (base.get("serving") or {}).get("phases") or {}
+    cur_ph: dict[str, dict] = {}
+    cur_trace: dict | None = None
+    for c_src in curs:
+        s_serving = c_src.get("serving") or {}
+        if s_serving.get("phases"):
+            cur_ph.update(s_serving["phases"])
+        if s_serving.get("trace"):
+            cur_trace = s_serving["trace"]
+    if base_ph or cur_ph:
+        from qdml_tpu.telemetry.tracing import PHASES as _PHASE_ORDER
+
+        lines += ["", "## serving phase decomposition (where the time goes)", ""]
+        if cur_trace is not None:
+            cov = (
+                f"sampled {cur_trace.get('sampled', '?')} of "
+                f"{cur_trace.get('completed', '?')} completed requests"
+            )
+            if isinstance(cur_trace.get("fraction"), (int, float)):
+                cov += f" ({cur_trace['fraction']:.1%})"
+            rec = cur_trace.get("reconciliation")
+            if isinstance(rec, dict) and rec.get("attributed_fraction") is not None:
+                cov += (
+                    f"; phases attribute {rec['attributed_fraction']:.1%} of "
+                    "end-to-end latency"
+                )
+            lines.append(f"- trace coverage: {cov}")
+        lines.append(
+            "- clock-skew rule: every phase is a single-clock duration — wire "
+            "time is router-measured around its own exchange; two hosts' "
+            "clocks are never differenced"
+        )
+        lines += [
+            "",
+            "| phase | baseline p99 (ms) | current p99 (ms) | delta | status |",
+            "|---|---|---|---|---|",
+        ]
+        phase_moved: list[str] = []
+        names = [p for p in _PHASE_ORDER if p in base_ph or p in cur_ph]
+        names += sorted((set(base_ph) | set(cur_ph)) - set(names))
+        for name in names:
+            b = (base_ph.get(name) or {}).get("p99_ms")
+            c = (cur_ph.get(name) or {}).get("p99_ms")
+            metric = f"serve.phase.{name}.p99_ms"
+            if b is None and c is None:
+                continue  # exact-sum-only blocks: no quantile to gate
+            if b is None or c is None:
+                only = "current-only" if b is None else "baseline-only"
+                gates.append(
+                    {"metric": metric, "kind": "phase", "baseline": b,
+                     "current": c, "delta_pct": None, "status": only}
+                )
+                lines.append(
+                    f"| {name} | {'—' if b is None else f'{b:g}'} | "
+                    f"{'—' if c is None else f'{c:g}'} | — | {only} |"
+                )
+                continue
+            delta_pct = _pct(c, b)
+            if delta_pct is None:
+                gates.append(
+                    {"metric": metric, "kind": "phase", "baseline": b,
+                     "current": c, "delta_pct": None, "status": "zero-baseline"}
+                )
+                lines.append(f"| {name} | {b:g} | {c:g} | — | zero-baseline |")
+                continue
+            if delta_pct > threshold_pct:
+                status_key, status_md = "regression", "**REGRESSION**"
+                phase_moved.append(f"{name} ({delta_pct:+.1f}%)")
+                regressions.append(
+                    {"metric": metric, "baseline": b, "current": c,
+                     "delta_pct": round(delta_pct, 2)}
+                )
+            elif delta_pct < -threshold_pct:
+                status_key = status_md = "improved"
+            else:
+                status_key = status_md = "ok"
+            gates.append(
+                {"metric": metric, "kind": "phase", "baseline": b, "current": c,
+                 "delta_pct": round(delta_pct, 2), "status": status_key}
+            )
+            lines.append(
+                f"| {name} | {b:g} | {c:g} | {delta_pct:+.1f}% | {status_md} |"
+            )
+        if phase_moved:
+            e2e = next(
+                (r for r in regressions if r["metric"] == "serving.p99_ms"), None
+            )
+            lines.append("")
+            lines.append(
+                "- p99 attribution: the "
+                + (
+                    f"end-to-end p99 move ({e2e['delta_pct']:+.1f}%) "
+                    if e2e
+                    else "tail move "
+                )
+                + "is carried by: "
+                + ", ".join(phase_moved)
+            )
 
     # Serving-SLO gate: attainment = fraction of deadline-carrying requests
     # answered within their deadline (serve_summary.slo.attainment). The

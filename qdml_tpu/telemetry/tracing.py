@@ -1,0 +1,146 @@
+"""Per-request phase tracing: where a serving request's latency actually went.
+
+The serving story's tail-latency claims (ragged p99, recovery SLO, router
+failover) all rest on ONE opaque number — the enqueue->result latency
+histogram — so a p99 regression cannot be attributed to queue wait vs batch
+coalescing vs device compute vs result fetch vs router wire. This module is
+the attribution layer: a sampled :class:`TraceContext` rides each request
+(trace id = the existing idempotent request id) and collects named phase
+DURATIONS stamped at the five host-side boundaries that already exist —
+client send, batcher enqueue, dequeue/dispatch, device-result fetch, and
+future resolution — plus the router tier's per-attempt wire spans.
+
+Non-negotiable contracts (docs/TELEMETRY.md "request tracing"):
+
+- **Host-side only.** Tracing never touches jitted code: no phase stamp is
+  reachable from a jit-compiled or pallas program (the ``trace-in-jit-path``
+  graftlint rule enforces it — a wall-clock stamp inside a traced program
+  would freeze at trace time, exactly the ``wall-clock-in-jit`` hazard). The
+  serve executables are HLO-identical with tracing on or off, pinned.
+- **Overhead-free when off.** ``serve.trace_sample=0`` (the default) builds
+  no TraceContext, stamps no clock, adds no compiles and no host transfers
+  — pinned in tests/test_tracing.py.
+- **Single-clock durations only.** Every phase is a duration measured on ONE
+  host's clock. Cross-process spans (router wire time) are measured by the
+  process that owns both endpoints of the interval (the router times its own
+  send->reply exchange); two hosts' clocks are NEVER differenced — clock
+  skew would fabricate negative or inflated phases. The client-side
+  reconciliation (loadgen) therefore reports an *unattributed* residual
+  (client wall minus the sum of reported durations) rather than labeling it
+  wire time.
+
+Phase vocabulary (the per-phase ServeMetrics histograms and report gates):
+
+- ``batch_wait`` — enqueue -> the batch's NEWEST member's enqueue: time this
+  request spent waiting for later arrivals to coalesce with (continuous
+  admission drives it toward 0; bucket coalescing pays up to ``max_wait_ms``);
+- ``queue_wait`` — newest member's enqueue -> dequeue: the formed batch's
+  wait for a free engine (shared by every request in the batch);
+- ``compute`` — dispatch -> device results ready (the executable call plus
+  the device fence, host-measured around the pre-compiled call);
+- ``fetch`` — device->host copy of the reply arrays;
+- ``wire`` — one router->backend exchange (router-measured; a failover
+  retry adds a SEPARATE wire span per attempt, so a failed-over request's
+  trace shows exactly where the retries went).
+
+Routers may prepend auxiliary spans (``pick``, ``dedup_wait``); unknown
+phase names histogram fine but only the five above carry report gates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+# The gated phase vocabulary, in pipeline order. ServeMetrics accepts any
+# phase name (routers add pick/dedup_wait), but these five are the report's
+# decomposition gates.
+PHASES: tuple[str, ...] = ("batch_wait", "queue_wait", "compute", "fetch", "wire")
+
+_SAMPLE_BUCKETS = 1 << 16
+
+
+def trace_sampled(rid, rate: float) -> bool:
+    """Deterministic id-hash sampling: the same request id makes the same
+    decision on the client, the router and every backend WITHOUT any
+    coordination bit on the wire — a retried/failed-over id stays traced
+    end to end. ``rate`` <= 0 never samples (the overhead-free default);
+    >= 1 always; in between, a stable md5 bucket of ``str(rid)``."""
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    h = int.from_bytes(hashlib.md5(str(rid).encode()).digest()[:4], "big")
+    return (h % _SAMPLE_BUCKETS) < rate * _SAMPLE_BUCKETS
+
+
+class TraceContext:
+    """One request's ordered (phase, duration) spans + the end-to-end total.
+
+    Durations are seconds internally (the Histogram convention) and
+    milliseconds on the wire (the reply-latency convention). Phases may
+    repeat — a failover retry appends one ``wire`` span per attempt.
+    ``detail`` carries structured non-duration facts (the router's attempt
+    table, dedup re-attachment) that ride the wire for humans and the dryrun
+    checks but never enter a histogram.
+    """
+
+    __slots__ = ("rid", "phases", "total_s", "detail")
+
+    def __init__(self, rid, phases=None, total_s: float | None = None,
+                 detail: dict | None = None):
+        self.rid = rid
+        self.phases: list[tuple[str, float]] = list(phases or [])
+        self.total_s = total_s
+        self.detail = detail
+
+    def add_phase(self, name: str, dur_s: float) -> None:
+        """Append one measured span. Clamped at zero: a fake-clock test (or
+        a coarse clock) must never histogram a negative duration."""
+        self.phases.append((str(name), max(0.0, float(dur_s))))
+
+    def phase_sum_s(self) -> float:
+        return sum(d for _, d in self.phases)
+
+    def prepend(self, phases: list[tuple[str, float]]) -> None:
+        """Insert upstream-tier spans ahead of this trace's own (the router
+        prepends pick/wire before the backend's queue/compute/fetch)."""
+        self.phases[:0] = list(phases)
+
+    def to_wire(self) -> dict:
+        """The optional ``trace`` field of a newline-JSON reply."""
+        out: dict = {
+            "id": self.rid,
+            "phases": [[n, round(d * 1e3, 3)] for n, d in self.phases],
+        }
+        if self.total_s is not None:
+            out["total_ms"] = round(self.total_s * 1e3, 3)
+        if self.detail:
+            out["detail"] = self.detail
+        return out
+
+    @classmethod
+    def from_wire(cls, obj) -> "TraceContext | None":
+        """Parse a reply's ``trace`` field; tolerant — a malformed block from
+        an older/newer peer degrades to None, never an exception on the
+        client's reply path."""
+        if not isinstance(obj, dict):
+            return None
+        phases: list[tuple[str, float]] = []
+        for item in obj.get("phases") or []:
+            if (
+                isinstance(item, (list, tuple))
+                and len(item) == 2
+                and isinstance(item[0], str)
+                and isinstance(item[1], (int, float))
+            ):
+                phases.append((item[0], max(0.0, float(item[1]) / 1e3)))
+            else:
+                return None
+        total = obj.get("total_ms")
+        detail = obj.get("detail")
+        return cls(
+            obj.get("id"),
+            phases=phases,
+            total_s=float(total) / 1e3 if isinstance(total, (int, float)) else None,
+            detail=detail if isinstance(detail, dict) else None,
+        )

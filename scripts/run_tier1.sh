@@ -47,4 +47,38 @@ sys.exit(1 if bad else 0)
   python -c "import json, sys; d = json.load(open('$headline')); sys.exit(0 if d.get('all_pass') else 1)" \
     || { echo "committed dryrun is not all_pass: $headline"; exit 6; }
 done
+# Trace dryrun (docs/TELEMETRY.md, results/trace_dryrun): re-arm the
+# zero-stranded gate over every committed traced window (same invariant-rows
+# rule as above — %-threshold phase/latency rows are the dryrun's own
+# interleaved-contemporaneous comparison, not CI's), and re-check the
+# headline's absolute facts: all_pass, the per-backend ZERO request-path
+# compile deltas with tracing on, and the trace-off window's zero deltas.
+if [ -d results/trace_dryrun ]; then
+  for f in results/trace_dryrun/traced_t*.jsonl; do
+    [ -e "$f" ] || continue
+    rm -f /tmp/_t1_trace.json
+    python -m qdml_tpu.cli report --current="$f" \
+      --baseline=results/trace_dryrun/baseline.jsonl \
+      --json=/tmp/_t1_trace.json > /dev/null || true  # rc judged on the JSON rows below
+    python -c "
+import json, sys
+d = json.load(open('/tmp/_t1_trace.json'))
+invariant_kinds = ('resilience', 'breaker', 'dispatch', 'batching')
+bad = d.get('stranded_failed') or any(
+    g.get('status') == 'regression' and g.get('kind') in invariant_kinds
+    for g in d.get('gates', [])
+)
+sys.exit(1 if bad else 0)
+" || { echo "trace invariant gate failed: $f"; exit 6; }
+  done
+  python -c "
+import json, sys
+d = json.load(open('results/trace_dryrun/TRACE_DRYRUN.json'))
+zero = lambda m: isinstance(m, dict) and all(v == 0 for v in m.values())
+ok = d.get('all_pass') and d.get('compile_cache_per_backend') and all(
+    zero(v) for v in d['compile_cache_per_backend'].values()
+)
+sys.exit(0 if ok else 1)
+" || { echo "trace dryrun headline failed (all_pass / zero-compile)"; exit 6; }
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

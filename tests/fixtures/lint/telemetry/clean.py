@@ -1,0 +1,24 @@
+# lint fixture: NEGATIVE cases for trace-in-jit-path — the analyzer must
+# report NOTHING for this file. Parsed only, never imported/executed.
+import jax
+
+from qdml_tpu.telemetry.tracing import TraceContext, trace_sampled
+
+
+def host_side_serve_one(batch, clock):
+    # the sanctioned surface: stamping AROUND the dispatch on the host side
+    # (serve/server._serve_one's shape) — not jit-reachable, not a kernel
+    tr = TraceContext(batch[0].rid)
+    tr.add_phase("queue_wait", clock() - batch[0].enqueue_ts)
+    return tr
+
+
+def host_side_sampling(rid, rate):
+    # host-side sampling decision before any dispatch: fine
+    return trace_sampled(rid, rate)
+
+
+@jax.jit
+def jitted_without_tracing(x):
+    # compiled code that never touches the tracing API: fine
+    return x * 2

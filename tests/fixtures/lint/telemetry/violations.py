@@ -1,0 +1,26 @@
+# lint fixture: POSITIVE cases for trace-in-jit-path — request-tracing
+# construction/stamping reachable from compiled (jit or pallas) code.
+# Parsed only, never imported/executed.
+import jax
+
+from qdml_tpu.telemetry.tracing import TraceContext, trace_sampled
+
+
+@jax.jit
+def traced_step_with_trace(x, rid):
+    # trace-in-jit-path: TraceContext built inside a jitted function —
+    # the stamp would freeze at trace time
+    tr = TraceContext(rid)
+    # trace-in-jit-path: phase stamping inside the compiled program
+    tr.add_phase("compute", 0.0)
+    return x
+
+
+def kernel_body(x_ref, o_ref):
+    # trace-in-jit-path (pallas): sampling decision inside a kernel body
+    trace_sampled(3, 1.0)
+    o_ref[...] = x_ref[...]
+
+
+def launch(pl, x):
+    return pl.pallas_call(kernel_body, out_shape=x)(x)

@@ -119,6 +119,35 @@ def test_unbounded_readline_fixtures():
     assert not [f for f in findings if f.rule == "unbounded-readline"]
 
 
+def test_trace_in_jit_path_fixtures():
+    """The tracing host-side-only contract rule: TraceContext construction /
+    phase stamping inside a jitted function or a pallas kernel body is a
+    finding; the sanctioned host-side serve-loop shape is clean; and the
+    real stamping surfaces (serve loop, router, loadgen) pass their own
+    rule."""
+    engine = LintEngine(REPO)
+    findings, err = engine.lint_file(f"{FIXDIR}/telemetry/violations.py")
+    assert err is None
+    # jitted TraceContext + jitted add_phase + pallas-kernel trace_sampled
+    assert _rules_found(findings) == {"trace-in-jit-path": 3}
+    kinds = {f.line: f.message for f in findings}
+    assert any("pallas-kernel" in m for m in kinds.values())
+    assert any("jit-reachable" in m for m in kinds.values())
+    findings, err = engine.lint_file(f"{FIXDIR}/telemetry/clean.py")
+    assert err is None
+    assert findings == [], _rules_found(findings)
+    # the sanctioned stamping surfaces are clean under the rule
+    for path in (
+        "qdml_tpu/serve/server.py",
+        "qdml_tpu/serve/loadgen.py",
+        "qdml_tpu/fleet/router.py",
+        "qdml_tpu/telemetry/tracing.py",
+    ):
+        findings, err = engine.lint_file(path)
+        assert err is None
+        assert not [f for f in findings if f.rule == "trace-in-jit-path"], path
+
+
 def test_retry_without_backoff_own_client_is_clean():
     """The sanctioned retry shape — ServeClient.call's jittered exponential
     backoff — passes the rule that exists because of it."""

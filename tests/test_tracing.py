@@ -1,0 +1,539 @@
+"""End-to-end request tracing (telemetry/tracing.py, docs/TELEMETRY.md):
+sampling, wire round-trip, phase decomposition through the serve loop, the
+overhead-free trace_sample=0 pins (HLO identity, zero compiles, zero
+allocations), router span propagation with failover wire spans and dedup
+re-attachment, exact phase aggregation, and the report's phase-gate section.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from qdml_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    ServeConfig,
+    TrainConfig,
+)
+from qdml_tpu.serve import (
+    Prediction,
+    ReplicaPool,
+    ServeClient,
+    ServeEngine,
+    ServeLoop,
+    ServeMetrics,
+    serve_async,
+)
+from qdml_tpu.serve.loadgen import make_request_samples, run_loadgen
+from qdml_tpu.telemetry import Histogram
+from qdml_tpu.telemetry.tracing import PHASES, TraceContext, trace_sampled
+
+
+def _tiny_cfg(**serve_kw):
+    # identical shapes to tests/test_serve.py / test_faults.py so the
+    # persistent compile cache shares the bucket executables across files
+    serve = dict(
+        max_batch=8, buckets=(4, 8), max_wait_ms=1.0, max_queue=32,
+        batching="bucket",
+    )
+    serve.update(serve_kw)
+    return ExperimentConfig(
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=1),
+        serve=ServeConfig(**serve),
+    )
+
+
+@pytest.fixture(scope="module")
+def warmed():
+    """One warmed engine with serve.trace_sample=1.0 in its config — the
+    engine itself never reads the knob (tracing is host-side only), so loops
+    that want the untraced path pass trace_sample=0.0 and share the same
+    executables: one compile budget for the whole module."""
+    from qdml_tpu.train.hdce import init_hdce_state
+    from qdml_tpu.train.qsc import init_sc_state
+
+    cfg = _tiny_cfg(trace_sample=1.0)
+    _, hdce_state = init_hdce_state(cfg, 4)
+    hdce_vars = {"params": hdce_state.params, "batch_stats": hdce_state.batch_stats}
+    _, sc_state = init_sc_state(cfg, quantum=False, steps_per_epoch=4)
+    engine = ServeEngine(cfg, hdce_vars, {"params": sc_state.params})
+    samples = make_request_samples(cfg, 32)
+    engine.warmup()
+    return cfg, engine, samples
+
+
+# ---------------------------------------------------------------------------
+# Unit: sampling + wire format + unitless histograms
+# ---------------------------------------------------------------------------
+
+
+def test_trace_sampled_deterministic_and_rate_shaped():
+    ids = [f"req-{i}" for i in range(2000)]
+    assert not any(trace_sampled(r, 0.0) for r in ids)
+    assert all(trace_sampled(r, 1.0) for r in ids)
+    # deterministic: the same id decides the same way every call (the
+    # client/router/backend agreement property)
+    assert [trace_sampled(r, 0.25) for r in ids] == [
+        trace_sampled(r, 0.25) for r in ids
+    ]
+    frac = sum(trace_sampled(r, 0.25) for r in ids) / len(ids)
+    assert 0.15 < frac < 0.35  # loose: md5 bucketing, not an RNG contract
+    # monotone in rate: an id sampled at a low rate stays sampled at higher
+    sampled_low = {r for r in ids if trace_sampled(r, 0.1)}
+    sampled_high = {r for r in ids if trace_sampled(r, 0.5)}
+    assert sampled_low <= sampled_high
+
+
+def test_trace_context_wire_round_trip():
+    tr = TraceContext("abc")
+    tr.add_phase("batch_wait", 0.001)
+    tr.add_phase("wire", 0.0021)
+    tr.add_phase("wire", 0.004)  # repeated phases survive (failover spans)
+    tr.total_s = 0.0085
+    wire = tr.to_wire()
+    assert wire["phases"] == [["batch_wait", 1.0], ["wire", 2.1], ["wire", 4.0]]
+    back = TraceContext.from_wire(json.loads(json.dumps(wire)))
+    assert back.rid == "abc"
+    assert [n for n, _ in back.phases] == ["batch_wait", "wire", "wire"]
+    assert [d for _, d in back.phases] == pytest.approx([0.001, 0.0021, 0.004])
+    assert back.total_s == pytest.approx(0.0085)
+    assert back.phase_sum_s() == pytest.approx(0.0071)
+    # negative durations clamp (fake/coarse clocks must not poison histograms)
+    tr2 = TraceContext(1)
+    tr2.add_phase("queue_wait", -0.5)
+    assert tr2.phases == [("queue_wait", 0.0)]
+    # malformed wire blocks degrade to None, never raise on the reply path
+    assert TraceContext.from_wire(None) is None
+    assert TraceContext.from_wire({"phases": [["x"]]}) is None
+    assert TraceContext.from_wire({"phases": "garbage"}) is None
+    assert TraceContext.from_wire(42) is None
+
+
+def test_histogram_unitless_summary_and_sum():
+    h = Histogram()
+    for v in (1.0, 2.0, 3.0, 10.0):
+        h.add(v)
+    raw = h.summary(unit=None)
+    # honest unitless keys: no *1e3 scaling, no _ms suffix (queue depth is a
+    # count, batch fill a fraction — the old "stored as seconds" shim is gone)
+    assert raw == {
+        "n": 4, "mean": 4.0, "p50": 3.0, "p95": 10.0, "p99": 10.0, "max": 10.0,
+    }
+    ms = h.summary()
+    assert ms["mean_ms"] == 4000.0 and ms["p50_ms"] == 3000.0
+    assert h.sum() == pytest.approx(16.0)
+    assert Histogram().summary(unit=None) is None
+
+
+def test_phase_histogram_merge_exact_across_workers():
+    """The replica/worker merge pin, phase edition: merged per-phase
+    quantiles equal quantiles of the concatenated samples (Histogram keeps
+    raw samples — mirrors the tests/test_numerics.py Histogram.merge pin)."""
+    rng = np.random.default_rng(7)
+    workers = []
+    all_samples: dict[str, list[float]] = {p: [] for p in PHASES}
+    for w in range(3):
+        m = ServeMetrics()
+        for i in range(40):
+            tr = TraceContext(f"w{w}-{i}")
+            for p in PHASES:
+                d = float(rng.exponential(0.002))
+                tr.add_phase(p, d)
+                all_samples[p].append(d)
+            pred = Prediction(
+                rid=f"w{w}-{i}", h=np.zeros(4, np.float32), scenario=0,
+                latency_s=tr.phase_sum_s(), bucket=8, batch_n=1, trace=tr,
+            )
+            m.observe_prediction(pred)
+        workers.append(m)
+    agg = ServeMetrics()
+    for m in workers:
+        agg.merge(m)
+    assert agg.traced == 120
+    for p in PHASES:
+        ref = Histogram()
+        for d in all_samples[p]:
+            ref.add(d)
+        assert agg.phase[p].summary() == ref.summary()
+        assert agg.phase[p].sum() == pytest.approx(ref.sum())
+    # the (n, sum_ms) pair the router sums exactly across processes
+    blk = agg.phases()
+    for p in PHASES:
+        assert blk[p]["n"] == 120
+        assert blk[p]["sum_ms"] == pytest.approx(
+            round(sum(all_samples[p]) * 1e3, 3), abs=1e-2
+        )
+
+
+# ---------------------------------------------------------------------------
+# Serve loop: decomposition + reconciliation + coverage
+# ---------------------------------------------------------------------------
+
+
+def test_serve_loop_phases_decompose_latency(warmed):
+    cfg, engine, samples = warmed
+    loop = ServeLoop(engine).start()  # cfg trace_sample=1.0: all traced
+    try:
+        futs = [loop.submit(samples["x"][i], rid=i) for i in range(16)]
+        results = [f.result(timeout=30.0) for f in futs]
+    finally:
+        loop.stop()
+    assert all(isinstance(r, Prediction) and r.trace is not None for r in results)
+    for r in results:
+        names = [n for n, _ in r.trace.phases]
+        assert names == ["batch_wait", "queue_wait", "compute", "fetch"]
+        # the future-resolution boundary closes the trace at the SAME number
+        # the latency histogram sees
+        assert r.trace.total_s == pytest.approx(r.latency_s)
+        # phases partition the latency: sum never exceeds it, and the
+        # unattributed residual (stack + metrics) stays small in-process
+        assert r.trace.phase_sum_s() <= r.latency_s + 1e-6
+        assert r.trace.phase_sum_s() >= 0.5 * r.latency_s
+    m = loop.merged_metrics()
+    blk = m.phases()
+    assert set(blk) == {"batch_wait", "queue_wait", "compute", "fetch"}
+    assert all(blk[p]["n"] == 16 for p in blk)
+    cov = m.trace_coverage()
+    assert cov == {"sampled": 16, "completed": 16, "fraction": 1.0}
+    s = m.summary()
+    assert s["phases"] == blk and s["trace"] == cov
+    # unitless satellite: queue depth / batch fill keep their back-compat
+    # keys, now with honest p99 alongside
+    assert set(s["queue_depth"]) == {"n", "mean", "p50", "p95", "p99", "max"}
+
+
+def test_trace_sample_zero_is_overhead_free(warmed, monkeypatch):
+    """The non-negotiable pin: trace_sample=0 builds no TraceContext, stamps
+    no dequeue clock, compiles nothing new, transfers nothing extra — and
+    the executables are the SAME objects either way (tracing never enters
+    the compiled program)."""
+    import qdml_tpu.serve.server as server_mod
+    from qdml_tpu.utils.compile_cache import compile_cache_stats
+
+    cfg, engine, samples = warmed
+    built = []
+
+    class _CountingCtx(TraceContext):
+        def __init__(self, *a, **kw):
+            built.append(a)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(server_mod, "TraceContext", _CountingCtx)
+    pre = compile_cache_stats()
+    loop = ServeLoop(engine, trace_sample=0.0).start()
+    try:
+        futs = [loop.submit(samples["x"][i], rid=i) for i in range(12)]
+        results = [f.result(timeout=30.0) for f in futs]
+    finally:
+        loop.stop()
+    assert built == []  # zero allocations on the untraced path
+    assert all(r.trace is None for r in results)
+    assert compile_cache_stats() == pre  # zero extra compiles
+    assert engine.request_path_compiles() == {"hits": 0, "misses": 0, "requests": 0}
+    m = loop.merged_metrics()
+    assert m.phases() is None and m.trace_coverage() is None
+    assert m.summary()["phases"] is None
+    # untraced infer stamps nothing (DispatchInfo timing stays None)
+    *_out, info = engine.infer(samples["x"][:4])
+    assert info.compute_s is None and info.fetch_s is None
+
+
+def test_trace_knob_leaves_hlo_identical(warmed):
+    """trace_sample is invisible to XLA: the serving forward lowers to
+    byte-identical HLO whatever the knob says (the serve.checkify-OFF
+    compile-identity pattern applied to tracing)."""
+    import dataclasses
+
+    import jax
+
+    cfg, engine, _ = warmed
+    hdce_live, clf_live = engine.live_vars()
+    texts = []
+    for rate in (0.0, 1.0):
+        c = dataclasses.replace(
+            cfg, serve=dataclasses.replace(cfg.serve, trace_sample=rate)
+        )
+        e = ServeEngine(c, hdce_live, clf_live)
+        lowered = jax.jit(e._forward).lower(
+            hdce_live, clf_live, np.zeros((4, *c.image_hw, 2), np.float32)
+        )
+        texts.append(lowered.as_text())
+    assert texts[0] == texts[1]
+
+
+def test_traced_infer_matches_untraced_numerics(warmed):
+    cfg, engine, samples = warmed
+    x = samples["x"][:5]
+    h0, p0, c0, i0 = engine.infer(x)
+    h1, p1, c1, i1 = engine.infer(x, traced=True)
+    np.testing.assert_array_equal(h0, h1)
+    np.testing.assert_array_equal(p0, p1)
+    assert i1.compute_s is not None and i1.compute_s >= 0
+    assert i1.fetch_s is not None and i1.fetch_s >= 0
+    # chunked oversize dispatch sums phase durations across chunks
+    big = np.concatenate([samples["x"]] * 2)[:19]
+    *_rest, info = engine.infer(big, traced=True)
+    assert info.chunks == 3 and info.compute_s > 0 and info.fetch_s > 0
+
+
+# ---------------------------------------------------------------------------
+# Socket + router propagation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def backend(warmed):
+    """One socket backend (untraced by default — trace_sample=0 override) on
+    an ephemeral port, with its own event loop thread."""
+    cfg, engine, samples = warmed
+    aloop = asyncio.new_event_loop()
+    t = threading.Thread(target=aloop.run_forever, daemon=True)
+    t.start()
+    loop_ = ServeLoop(engine, trace_sample=0.0, name="trace-backend").start()
+    ready: Future = Future()
+    task = asyncio.run_coroutine_threadsafe(
+        serve_async(loop_, "127.0.0.1", 0, ready, host_id="trace-b0",
+                    dedup_ttl_s=5.0),
+        aloop,
+    )
+    port = ready.result(timeout=30.0)
+    yield cfg, samples, port, loop_
+    task.cancel()
+    aloop.call_soon_threadsafe(aloop.stop)
+    t.join(timeout=10.0)
+    loop_.stop()
+
+
+def test_socket_trace_force_flag_and_reply_schema(backend):
+    cfg, samples, port, _loop = backend
+    with ServeClient("127.0.0.1", port, timeout_s=10.0) as c:
+        plain = c.request(samples["x"][0], rid="plain-1")
+        traced = c.request(samples["x"][1], rid="traced-1", trace=True)
+    # server samples at 0: only the client-forced request carries a trace
+    assert plain.get("ok") and "trace" not in plain
+    tr = traced.get("trace")
+    assert tr is not None and tr["id"] == "traced-1"
+    names = [p[0] for p in tr["phases"]]
+    assert names == ["batch_wait", "queue_wait", "compute", "fetch"]
+    assert all(isinstance(p[1], float) and p[1] >= 0 for p in tr["phases"])
+    assert tr["total_ms"] == pytest.approx(traced["latency_ms"], abs=0.01)
+
+
+def test_router_prepends_spans_and_failover_wire_spans(backend):
+    """The trace-propagation parity satellite: router spans + backend spans
+    reconcile with the client-observed total; a dead-backend failover shows
+    up as SEPARATE wire spans; a dedup re-attached retry carries the
+    dedup_wait span and the re-attachment flag."""
+    import time as _time
+
+    from qdml_tpu.fleet import FleetRouter
+
+    cfg, samples, port, _loop = backend
+    # backend 0 is a dead port: requests whose ring primary lands there must
+    # fail over to the live host, leaving a failed wire span behind
+    router = FleetRouter(
+        [("127.0.0.1", 1), ("127.0.0.1", port)],
+        timeout_s=2.0, retries=0, eject_failures=1000,  # never eject: every
+        # traced request may pay the dead attempt (the failover span source)
+        eject_s=0.01, readmit_probes=1, poll_interval_s=30.0, failover=2,
+        trace_sample=1.0,
+    )
+    try:
+        failover_tr = None
+        for i in range(32):
+            rid = f"ft-{i}"
+            t0 = _time.perf_counter()
+            rep = router.request({"id": rid, "x": samples["x"][0].tolist()})
+            wall = _time.perf_counter() - t0
+            assert rep.get("ok") is True
+            tr = TraceContext.from_wire(rep.get("trace"))
+            assert tr is not None
+            names = [n for n, _ in tr.phases]
+            assert names[0] == "pick" and "wire" in names
+            # parity: router spans + backend spans never exceed the
+            # client-observed wall (durations partition, no double count)
+            assert tr.phase_sum_s() <= wall + 5e-3
+            # backend-side phases came through the wire intact
+            assert {"batch_wait", "queue_wait", "compute", "fetch"} <= set(names)
+            attempts = tr.detail["router"]["attempts"]
+            assert attempts[-1]["ok"] is True
+            if len(attempts) >= 2:
+                failover_tr = (tr, attempts)
+        assert failover_tr is not None, "no request's primary was the dead host"
+        tr, attempts = failover_tr
+        assert attempts[0]["ok"] is False
+        assert [n for n, _ in tr.phases].count("wire") == len(attempts) >= 2
+        assert tr.detail["router"]["failover_retries"] >= 1
+        # net wire on the successful attempt: exchange minus the backend's
+        # reported serve total (duration subtraction, clock-skew-free)
+        ok_att = attempts[-1]
+        assert ok_att["wire_ms"] == pytest.approx(
+            max(0.0, ok_att["exchange_ms"] - ok_att["server_ms"]), abs=0.01
+        )
+        # dedup re-attachment: same id again -> identical reply, dedup_wait
+        rep1 = router.request({"id": "pin-1", "x": samples["x"][2].tolist()})
+        rep2 = router.request({"id": "pin-1", "x": samples["x"][2].tolist()})
+        assert rep2["h"] == rep1["h"]
+        tr2 = rep2["trace"]
+        assert tr2["phases"][0][0] == "dedup_wait"
+        assert tr2["detail"]["dedup_reattached"] is True
+        assert router.dedup.hits == 1
+    finally:
+        router.stop()
+
+
+def test_router_metrics_aggregation_sums_phases_exactly(backend):
+    from qdml_tpu.fleet import FleetRouter
+
+    cfg, samples, port, _loop = backend
+    router = FleetRouter(
+        [("127.0.0.1", port)], timeout_s=5.0, retries=0,
+        poll_interval_s=30.0, trace_sample=1.0,
+    )
+    try:
+        for i in range(10):
+            rep = router.request({"id": f"agg-{i}", "x": samples["x"][i].tolist()})
+            assert rep.get("ok") is True
+        m = router.live_metrics()
+        per_backend = m["per_backend"]
+        assert len(per_backend) == 1
+        b_phases = next(iter(per_backend.values()))["phases"]
+        agg_phases = m["phases"]
+        # EXACT summation across the aggregation: per-phase n and sum_ms of
+        # the fleet view equal the per-backend blocks' sums (one backend
+        # here makes the equality literal; the summing code path is the same
+        # for N)
+        for name in ("batch_wait", "queue_wait", "compute", "fetch"):
+            assert agg_phases[name]["n"] == b_phases[name]["n"] == 10
+            assert agg_phases[name]["sum_ms"] == pytest.approx(
+                b_phases[name]["sum_ms"], abs=1e-6
+            )
+        # the router's own wire row: raw samples live router-side, so it has
+        # exact quantiles AND the (n, sum_ms) pair
+        assert agg_phases["wire"]["n"] == 10
+        assert {"p50_ms", "p99_ms", "sum_ms"} <= set(agg_phases["wire"])
+        assert m["trace"]["sampled"] == 10
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Loadgen + report
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_summary_carries_phases_and_reconciliation(warmed, tmp_path):
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    cfg, engine, _ = warmed  # cfg.serve.trace_sample == 1.0
+    path = str(tmp_path / "traced_loadgen.jsonl")
+    logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+    try:
+        summary = run_loadgen(cfg, engine, rate=2000.0, n=48, logger=logger)
+    finally:
+        logger.close()
+    assert summary["trace"]["sampled"] == summary["completed"] == 48
+    rec = summary["trace"]["reconciliation"]
+    assert rec["n"] == 48
+    assert rec["mean_phase_sum_ms"] <= rec["mean_latency_ms"] + 1e-3
+    assert rec["attributed_fraction"] > 0.5
+    assert set(summary["phases"]) == {"batch_wait", "queue_wait", "compute", "fetch"}
+    # satellite: the end-of-run metrics poll carries the decomposition too —
+    # no second verb round-trip per committed window
+    assert summary["server_metrics"]["phases"] is not None
+    assert summary["server_metrics"]["trace"]["sampled"] == 48
+
+
+def test_replica_pool_trace_sample_override(warmed):
+    cfg, engine, samples = warmed
+    pool = ReplicaPool(engine, replicas=2, trace_sample=0.0).start()
+    try:
+        futs = [pool.submit(samples["x"][i], rid=i) for i in range(8)]
+        results = [f.result(timeout=30.0) for f in futs]
+    finally:
+        pool.stop()
+    assert all(r.trace is None for r in results)
+    assert pool.merged_metrics().trace_coverage() is None
+
+
+def _summary_with_phases(platform, p99s: dict, latency_p99: float,
+                         trace: dict | None = None) -> dict:
+    return {
+        "kind": "serve_summary",
+        "platform": platform,
+        "rps": 100.0,
+        "completed": 100,
+        "latency_ms": {"p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": latency_p99},
+        "phases": {
+            name: {"n": 50, "mean_ms": v / 2, "p50_ms": v / 3, "p95_ms": v * 0.9,
+                   "p99_ms": v, "max_ms": v * 1.1, "sum_ms": 50 * v / 2}
+            for name, v in p99s.items()
+        },
+        "trace": trace or {"sampled": 50, "completed": 100, "fraction": 0.5},
+        "stranded_futures": 0,
+    }
+
+
+def test_report_phase_section_gates_and_attribution(tmp_path):
+    from qdml_tpu.telemetry.report import build_report_data
+
+    base = tmp_path / "base.jsonl"
+    cur = tmp_path / "cur.jsonl"
+    base.write_text(json.dumps(_summary_with_phases(
+        "cpu", {"batch_wait": 0.5, "queue_wait": 1.0, "compute": 2.0,
+                "fetch": 0.4, "wire": 1.0}, latency_p99=5.0)) + "\n")
+    # compute p99 triples, everything else flat: the end-to-end p99 move
+    # must be ATTRIBUTED to compute
+    cur.write_text(json.dumps(_summary_with_phases(
+        "cpu", {"batch_wait": 0.5, "queue_wait": 1.0, "compute": 6.0,
+                "fetch": 0.4, "wire": 1.0}, latency_p99=9.0)) + "\n")
+    data = build_report_data([str(cur)], str(base), threshold_pct=10.0)
+    by_metric = {g["metric"]: g for g in data["gates"]}
+    assert by_metric["serve.phase.compute.p99_ms"]["status"] == "regression"
+    assert by_metric["serve.phase.compute.p99_ms"]["kind"] == "phase"
+    for name in ("batch_wait", "queue_wait", "fetch", "wire"):
+        assert by_metric[f"serve.phase.{name}.p99_ms"]["status"] == "ok"
+    md = data["markdown"]
+    assert "serving phase decomposition" in md
+    assert "trace coverage: sampled 50 of 100" in md
+    assert "clock-skew rule" in md and "never differenced" in md
+    assert "p99 attribution" in md and "compute (+200.0%)" in md
+    assert any(r["metric"] == "serve.phase.compute.p99_ms"
+               for r in data["regressions"])
+    # flat phases -> ok round trip, section still renders with coverage
+    data2 = build_report_data([str(base)], str(base), threshold_pct=10.0)
+    assert not any(
+        g["kind"] == "phase" and g["status"] == "regression"
+        for g in data2["gates"]
+    )
+    assert "p99 attribution" not in data2["markdown"]
+
+
+def test_report_phase_platform_mismatch_disarms(tmp_path):
+    from qdml_tpu.telemetry.report import build_report_data
+
+    base = tmp_path / "base.jsonl"
+    cur = tmp_path / "cur.jsonl"
+    base.write_text(json.dumps(_summary_with_phases(
+        "tpu", {"compute": 2.0}, latency_p99=5.0)) + "\n")
+    cur.write_text(json.dumps(_summary_with_phases(
+        "cpu", {"compute": 20.0}, latency_p99=50.0)) + "\n")
+    data = build_report_data([str(cur)], str(base), threshold_pct=10.0)
+    # phase rows are latency-shaped: reported, but the platform mismatch
+    # disarms the gate exactly like the serving-latency section
+    assert data["gate_armed"] is False
+    assert any(
+        g["metric"] == "serve.phase.compute.p99_ms"
+        and g["status"] == "regression"
+        for g in data["gates"]
+    )
