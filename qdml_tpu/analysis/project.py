@@ -311,3 +311,46 @@ TRACE_STAMP_CALLS: frozenset[str] = frozenset(
 GATE_MATRIX_CONSTRUCTORS: frozenset[str] = frozenset(
     {"rot_gate", "gate_h", "gate_rx"}
 )
+
+# Cumulative run-lifetime counters (serve/metrics.py ServeMetrics,
+# fleet/router.py, serve/breaker.py): dividing one by a wall-clock span is
+# an UNWINDOWED rate — it averages the counter's entire lifetime, so a
+# restarted process reports garbage (negative deltas upstream, wildly
+# smoothed rates here) and a long-running one can never surface a
+# regression. Windowed rates come from snapshot differencing
+# (telemetry/timeseries.counter_delta — rule unwindowed-cumulative-rate;
+# the differencing module itself is sanctioned, RATE_SANCTIONED_MODULES).
+# Matched on the numerator's last (underscore-stripped) name segment;
+# run-level SUMMARY rates over an explicit full-run span are sanctioned by
+# suppression at the site.
+CUMULATIVE_COUNTERS: frozenset[str] = frozenset(
+    {
+        "completed",
+        "rows_useful",
+        "rows_padded",
+        "shed",
+        "forwarded",
+        "failed_forwards",
+        "failovers",
+        "fast_fails",
+        "admitted",
+        "dedup_hits",
+        "give_ups",
+        "slo_met",
+        "slo_total",
+        "restarts",
+        "ejections",
+        "readmissions",
+    }
+)
+
+# Wall-time denominators for unwindowed-cumulative-rate: the clock reads
+# that measure spans (subset of WALL_CLOCK_CALLS — now()/today() produce
+# datetimes, not seconds) plus any local name assigned from an expression
+# containing one (elapsed = time.monotonic() - t0).
+WALL_TIME_CALLS: frozenset[str] = frozenset({"time", "monotonic", "perf_counter"})
+
+# Modules allowed to divide counters by time: the snapshot-differencing
+# helpers themselves (they difference FIRST, then divide the delta by the
+# window width — the pattern the rule exists to funnel everything through).
+RATE_SANCTIONED_MODULES: tuple[str, ...] = ("qdml_tpu/telemetry/timeseries.py",)

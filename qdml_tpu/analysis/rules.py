@@ -1116,6 +1116,100 @@ def rule_trace_in_jit_path(ctx: ModuleContext) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 18. unwindowed-cumulative-rate — lifetime counter / wall-time division
+# ---------------------------------------------------------------------------
+
+
+def rule_unwindowed_cumulative_rate(ctx: ModuleContext) -> list[Finding]:
+    """A cumulative run-lifetime counter (``project.CUMULATIVE_COUNTERS``)
+    divided by a wall-clock span: the "rate" averages the counter's WHOLE
+    lifetime, so a restart makes it garbage and a long run makes it inert
+    (a regression in the last minute moves a week-long average by nothing).
+    Windowed rates difference snapshots first
+    (``telemetry/timeseries.counter_delta`` — that module is the sanctioned
+    home, ``project.RATE_SANCTIONED_MODULES``). Wall-time denominators are
+    direct span-clock reads (``project.WALL_TIME_CALLS``), arithmetic over
+    them, or a local name assigned from such an expression (two dataflow
+    passes: ``now = time.monotonic()`` then ``elapsed = now - t0``).
+    Run-level SUMMARY rates over an explicit full-run span are legitimate
+    and sanctioned by suppression at the site. Deliberately NOT caught:
+    deltas (``d_completed / dt`` — already windowed), divisions by counts
+    or config values, and cross-function flows (a span passed as an
+    argument) — the shipped shape is the in-function ``counter /
+    (monotonic() - t0)`` one-liner."""
+    if ctx.path in project.RATE_SANCTIONED_MODULES:
+        return []
+
+    def _clock_call(sub: ast.AST) -> bool:
+        if not isinstance(sub, ast.Call):
+            return False
+        callee = ctx.canonical(sub.func) or dotted_name(sub.func) or ""
+        return callee.rsplit(".", 1)[-1] in project.WALL_TIME_CALLS
+
+    # names bound to wall-time spans, two passes for the one-step chain
+    span_names: set[str] = set()
+    for _pass in (0, 1):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            clockish = any(
+                _clock_call(sub) or (
+                    isinstance(sub, ast.Name) and sub.id in span_names
+                )
+                for sub in ast.walk(node.value)
+            )
+            if not clockish:
+                continue
+            # plain-name targets only: `self._t0 = monotonic()` must bind
+            # nothing (walking the Attribute target would bind `self` and
+            # poison the whole module's dataflow)
+            for t in node.targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for n in elts:
+                    if isinstance(n, ast.Name):
+                        span_names.add(n.id)
+
+    def _wall_time(expr: ast.AST) -> bool:
+        return any(
+            _clock_call(sub)
+            or (isinstance(sub, ast.Name) and sub.id in span_names)
+            for sub in ast.walk(expr)
+        )
+
+    def _counter(expr: ast.AST) -> str | None:
+        for sub in ast.walk(expr):
+            name = None
+            if isinstance(sub, ast.Attribute):
+                name = sub.attr
+            elif isinstance(sub, ast.Name):
+                name = sub.id
+            if name and name.lstrip("_") in project.CUMULATIVE_COUNTERS:
+                return name
+        return None
+
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div)):
+            continue
+        counter = _counter(node.left)
+        if counter is None or not _wall_time(node.right):
+            continue
+        out.append(
+            ctx.finding(
+                "unwindowed-cumulative-rate",
+                node,
+                f"cumulative counter {counter!r} divided by a wall-clock "
+                "span: a lifetime average is garbage after a restart and "
+                "inert on a long run — difference snapshots first "
+                "(telemetry/timeseries.counter_delta) and divide the DELTA "
+                "by the window width; a run-level summary rate over the "
+                "full run span is sanctioned by suppression",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -1195,6 +1289,10 @@ RULES: dict[str, tuple[Callable[[ModuleContext], list[Finding]], str]] = {
     "trace-in-jit-path": (
         rule_trace_in_jit_path,
         "TraceContext construction / phase stamping reachable from jit or pallas code",
+    ),
+    "unwindowed-cumulative-rate": (
+        rule_unwindowed_cumulative_rate,
+        "cumulative counter divided by wall time outside the sanctioned differencing helpers",
     ),
     # "slow-marker" is data-driven (needs a --durations report) and lives in
     # qdml_tpu.analysis.slowmarkers; the CLI folds it in when given the data.

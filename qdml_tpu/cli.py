@@ -65,6 +65,22 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
                                   # swap fan-out + metrics/health aggregation
                                   # (point `control` at fleet.host:fleet.port
                                   # to supervise the whole fleet)
+    python -m qdml_tpu.cli monitor --addr=HOST:PORT [--duration=S]
+                                  [--interval=S] [--out=FILE.jsonl]
+                                  [--slo-target=0.99] [--threshold=8]
+                                  # flight deck (docs/TELEMETRY.md): scrape
+                                  # health/metrics (NEVER inference), window
+                                  # cumulative counters into rates, multi-
+                                  # window SLO error-budget burn alerting;
+                                  # monitor --render --current=F.jsonl
+                                  # [--events=stack.jsonl] renders the
+                                  # correlated event timeline
+    python -m qdml_tpu.cli plan   --trace=W.jsonl[,..] (--validate |
+                                  --target-rps=X --p99-ms=Y)
+                                  # trace-replay capacity planner: DES of
+                                  # the batcher->engine->fetch pipeline from
+                                  # committed phase spans; --validate gates
+                                  # predicted-vs-measured p99/throughput
 
 Every command's metrics JSONL starts with a run-manifest header (config hash,
 git SHA, device topology, perf knobs, seeds) and carries span/counter records
@@ -101,7 +117,8 @@ _COMMANDS = (
     "loadgen",
     "control",
     "route",
-)  # "report" and "lint" dispatch before config parsing (no jax, no workdir)
+)  # "report"/"lint"/"monitor"/"plan" dispatch before config parsing
+# (host-side: no jax, no workdir)
 
 _PASSTHROUGH = (  # command args, not config overrides
     "--out=",
@@ -144,6 +161,19 @@ def main(argv: list[str] | None = None) -> int:
         from qdml_tpu.analysis.cli import lint_main
 
         return lint_main(argv[1:])
+    if argv[0] == "monitor":
+        # Host-side scraper: attaches to a RUNNING serve/route address over
+        # the cheap health/metrics verbs only — no jax, no config parsing,
+        # never an inference request (docs/TELEMETRY.md "flight deck").
+        from qdml_tpu.telemetry.timeseries import monitor_main
+
+        return monitor_main(argv[1:])
+    if argv[0] == "plan":
+        # Host-side capacity planner over COMMITTED trace windows: exit
+        # code is the planner-validation gate (docs/TELEMETRY.md).
+        from qdml_tpu.telemetry.capacity import plan_main
+
+        return plan_main(argv[1:])
     # Make JAX_PLATFORMS=cpu actually select the CPU backend (the plugin
     # rewrites jax_platforms at interpreter start; qdml_tpu.utils.platform
     # is the single home for the workaround).

@@ -58,6 +58,7 @@ from qdml_tpu.control.autoscale import Autoscaler
 from qdml_tpu.control.deploy import Deployer
 from qdml_tpu.control.drift import DriftMonitor
 from qdml_tpu.control.events import emit_record
+from qdml_tpu.telemetry.timeseries import counter_delta
 
 # an adaptation that keeps failing its canary must not retrain forever on
 # the same drift episode: after this many failed attempts per scenario the
@@ -76,6 +77,9 @@ class PoolPoller:
 
     def metrics(self) -> dict:
         return self.pool.live_metrics()
+
+    def health(self) -> dict:
+        return self.pool.health()
 
     def swap(self, tags: dict) -> dict:
         return self.engine.swap_from_workdir(self.workdir, tags=tags)
@@ -111,6 +115,11 @@ class SocketPoller:
 
     def metrics(self) -> dict:
         return self._verb({"op": "metrics"})["metrics"]
+
+    def health(self) -> dict:
+        """The cheap 1 Hz liveness view (no histogram merges server-side) —
+        what the continuous monitor scrapes between metrics polls."""
+        return self._verb({"op": "health"})["health"]
 
     def swap(self, tags: dict) -> dict:
         return self._verb({"op": "swap", "tags": tags})["swap"]
@@ -223,9 +232,19 @@ class FleetController:
         per = m.get("per_scenario") or {}
         for key, cur in per.items():
             prev = self._prev_scenario.get(key, {"n": 0, "conf_sum": 0.0})
-            dn = cur.get("n", 0) - prev.get("n", 0)
-            dconf = cur.get("conf_sum", 0.0) - prev.get("conf_sum", 0.0)
-            if dn >= self.min_window and cur.get("conf_sum") is not None:
+            dn, reset = counter_delta(prev.get("n"), cur.get("n"))
+            dconf, _ = counter_delta(prev.get("conf_sum"), cur.get("conf_sum"))
+            if reset:
+                # a restarted backend's counters started over: naive
+                # subtraction would feed the detector a negative "window",
+                # and the clamped delta mixes pre-/post-restart history —
+                # report the reset, skip this window's detector feed
+                emit_record(
+                    self._sink, "counter_reset", source="control_loop",
+                    counter=f"per_scenario[{key}].n",
+                    prev=prev.get("n", 0), cur=cur.get("n", 0),
+                )
+            elif dn >= self.min_window and cur.get("conf_sum") is not None:
                 ev = self.monitor.observe(int(key), "confidence", dconf / dn)
                 if ev:
                     events.append(ev)
@@ -235,9 +254,20 @@ class FleetController:
         }
         disp = m.get("dispatch") or {}
         prev_d = self._prev_dispatch
-        d_routed = (disp.get("routed_rows") or 0) - (prev_d.get("routed_rows") or 0)
-        d_over = (disp.get("overflow_rows") or 0) - (prev_d.get("overflow_rows") or 0)
-        if d_routed >= self.min_window:
+        d_routed, r_reset = counter_delta(
+            prev_d.get("routed_rows"), disp.get("routed_rows")
+        )
+        d_over, o_reset = counter_delta(
+            prev_d.get("overflow_rows"), disp.get("overflow_rows")
+        )
+        if r_reset or o_reset:
+            emit_record(
+                self._sink, "counter_reset", source="control_loop",
+                counter="dispatch.routed_rows",
+                prev=prev_d.get("routed_rows") or 0,
+                cur=disp.get("routed_rows") or 0,
+            )
+        elif d_routed >= self.min_window:
             ev = self.monitor.observe(-1, "overflow_rate", d_over / d_routed)
             if ev:
                 events.append(ev)
@@ -255,8 +285,17 @@ class FleetController:
         self._prev_slo = dict(slo) if slo else self._prev_slo
         if not slo:
             return None
-        dn = slo.get("n", 0) - (prev or {}).get("n", 0)
-        dmet = slo.get("met", 0) - (prev or {}).get("met", 0)
+        dn, reset = counter_delta((prev or {}).get("n"), slo.get("n"))
+        dmet, _ = counter_delta((prev or {}).get("met"), slo.get("met"))
+        if reset:
+            # restart mid-window: attainment over a clamped window would
+            # blend two processes' histories — report, return no reading
+            emit_record(
+                self._sink, "counter_reset", source="control_loop",
+                counter="slo.n",
+                prev=(prev or {}).get("n", 0), cur=slo.get("n", 0),
+            )
+            return None
         return dmet / dn if dn > 0 else None
 
     def _adapt(self, scenario: int) -> dict:

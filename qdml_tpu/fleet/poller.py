@@ -43,6 +43,12 @@ class FleetPoller:
         the same payload the router's ``{"op": "metrics"}`` verb serves."""
         return self.router.live_metrics()
 
+    def health(self) -> dict:
+        """The cheap cached-poll view (per-backend rows carry ``uptime_s`` /
+        ``start_seq``, the monitor's restart detectors) — the same payload
+        the router's ``{"op": "health"}`` verb serves."""
+        return self.router.health()
+
     def swap(self, tags: dict) -> dict:
         rec = self.router.swap_fanout(tags)
         if not rec["ok"]:

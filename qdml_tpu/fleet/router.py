@@ -225,6 +225,11 @@ class Backend:
         self.queue_depth: int = 0
         self.replicas: int = 0
         self.swap_epoch: int = 0
+        # restart-visibility epoch forwarded from the backend's health reply
+        # (docs/TELEMETRY.md "monitoring"): a monitor behind the router sees
+        # per-backend restarts without polling each host itself
+        self.uptime_s: float | None = None
+        self.start_seq: int | None = None
         self.last_poll_ts: float = 0.0
         self.poll_ok: bool = False
         # router-side wire metrics, guarded by _mlock (request threads add
@@ -307,6 +312,8 @@ class Backend:
             "queue_depth": self.queue_depth,
             "replicas": self.replicas,
             "swap_epoch": self.swap_epoch,
+            "uptime_s": self.uptime_s,
+            "start_seq": self.start_seq,
             "poll_ok": self.poll_ok,
             "poll_age_s": age,
             **self.state.summary(),
@@ -495,6 +502,10 @@ class FleetRouter:
         self._trace_wire = Histogram()
         self._poll_stop = threading.Event()
         self._poll_thread: threading.Thread | None = None
+        # the router's own restart-visibility epoch (same contract as the
+        # backends': a monitor scraping the front detects a router restart)
+        self._monitor_t0 = time.monotonic()
+        self._start_seq = int(time.time() * 1000)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -552,6 +563,10 @@ class FleetRouter:
             b.queue_depth = int(h.get("queue_depth") or 0)
             b.replicas = int(h.get("replicas") or h.get("workers") or 1)
             b.swap_epoch = int(h.get("swap_epoch") or 0)
+            if h.get("uptime_s") is not None:
+                b.uptime_s = float(h["uptime_s"])
+            if h.get("start_seq") is not None:
+                b.start_seq = int(h["start_seq"])
             if h.get("host_id"):
                 b.host_id = str(h["host_id"])
             if h.get("listen"):
@@ -865,6 +880,8 @@ class FleetRouter:
             "swap_epoch": min(
                 (b.swap_epoch for b in self.backends), default=0
             ),
+            "uptime_s": round(time.monotonic() - self._monitor_t0, 3),
+            "start_seq": self._start_seq,
             "router": self.router_summary(),
             "per_backend": rows,
         }

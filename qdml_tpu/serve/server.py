@@ -202,6 +202,13 @@ class ServeLoop:
         # any worker stamps them; the supervisor/health verb only AGE them)
         self._heartbeat = 0.0          # newest worker pump iteration
         self._last_dispatch_ts = 0.0   # newest served batch
+        # restart-visibility epoch (docs/TELEMETRY.md "monitoring"): a
+        # monitor differencing cumulative counters across polls must detect
+        # a restart BETWEEN two scrapes — uptime_s alone can miss one when
+        # the poll gap exceeds the new uptime, so start_seq stamps the
+        # construction instant as an identity the restart resets
+        self._monitor_t0 = time.monotonic()
+        self._start_seq = int(time.time() * 1000)
 
     # -- client side --------------------------------------------------------
 
@@ -365,6 +372,8 @@ class ServeLoop:
                 else round(now - self._last_dispatch_ts, 4)
             ),
             "swap_epoch": self.engine.swap_epoch,
+            "uptime_s": round(now - self._monitor_t0, 3),
+            "start_seq": self._start_seq,
             "breaker": None if self._breaker is None else self._breaker.summary(),
         }
 
@@ -605,6 +614,11 @@ class ReplicaPool:
         self._restart_total = 0
         self._sup_stop = threading.Event()
         self._sup_thread: threading.Thread | None = None
+        # restart-visibility epoch, pool-level (the pool survives replica
+        # restarts; only a PROCESS restart resets these — exactly the event
+        # the monitor's counter differencing must re-anchor on)
+        self._monitor_t0 = time.monotonic()
+        self._start_seq = int(time.time() * 1000)
 
     def _make_replica(self, i: int) -> ServeLoop:
         return self._new_loop(f"serve-replica-{i}")
@@ -898,6 +912,8 @@ class ReplicaPool:
                 None if last_ts == 0.0 else round(now - last_ts, 4)
             ),
             "swap_epoch": self.engine.swap_epoch,
+            "uptime_s": round(now - self._monitor_t0, 3),
+            "start_seq": self._start_seq,
             "restarts": self._restart_total,
             "supervised": (
                 self._sup_thread is not None and self._sup_thread.is_alive()

@@ -16,7 +16,14 @@ metrics artifact is ever orphaned from its provenance again:
   exposes them, and the persistent-compile-cache hit/miss counters
   (``qdml_tpu.utils.compile_cache``);
 - :mod:`qdml_tpu.telemetry.report` — the ``qdml-tpu report`` regression gate
-  over one or more telemetry artifacts vs a committed baseline.
+  over one or more telemetry artifacts vs a committed baseline;
+- :mod:`qdml_tpu.telemetry.timeseries` / :mod:`~qdml_tpu.telemetry.burnrate`
+  — the ``qdml-tpu monitor`` flight deck: metrics-verb-only scraping of a
+  running serve/route address, counter differencing into fixed windows, and
+  multi-window SLO error-budget burn-rate alerting with an event-correlated
+  timeline;
+- :mod:`qdml_tpu.telemetry.capacity` — the ``qdml-tpu plan`` trace-replay
+  capacity planner, validated against committed dryrun windows.
 
 The long-standing ``MetricsLogger`` (``qdml_tpu.utils.metrics``), ``StepTimer``
 and ``trace()`` (``qdml_tpu.utils.profiling``) are thin facades over this
@@ -52,4 +59,15 @@ from qdml_tpu.telemetry.tracing import (  # noqa: F401
     PHASES,
     TraceContext,
     trace_sampled,
+)
+from qdml_tpu.telemetry.timeseries import (  # noqa: F401
+    MonitorScraper,
+    SnapshotDiff,
+    counter_delta,
+)
+from qdml_tpu.telemetry.burnrate import (  # noqa: F401
+    BurnAlerter,
+    BurnRateRule,
+    burn_rate,
+    render_timeline,
 )

@@ -220,6 +220,7 @@ def extract(path: str) -> dict:
         "platform": None,
         "qsc_scaling": None,
         "scenario_scaling": None,
+        "monitor": None,
     }
     for obj in _iter_objs(path):
         if not isinstance(obj, dict):
@@ -228,6 +229,12 @@ def extract(path: str) -> dict:
             # last wins: an appended/resumed stream carries one manifest per
             # invocation, and the last record belongs to the last invocation
             src["manifest"] = obj
+            continue
+        if obj.get("kind") == "monitor_summary":
+            # the flight deck's end-of-attachment rollup (qdml-tpu monitor):
+            # burn-rate peaks, alert counts by mark/signal, planner
+            # validation — last wins like every other summary record
+            src["monitor"] = obj
             continue
         if obj.get("kind") == "cost" and obj.get("name"):
             key = str(obj["name"])
@@ -459,6 +466,7 @@ def build_report_data(
     gate_armed = True
     transfer_failed = False
     stranded_failed = False
+    monitor_failed = False
 
     # Lint gate (qdml-tpu lint --json artifact): folded in alongside the perf
     # gates so CI reads ONE exit code. Static analysis is host-side — the
@@ -540,6 +548,10 @@ def build_report_data(
             # protocol's resolution invariant on ANY hardware — always-armed
             # like lint, forces the regression exit under platform disarm
             "stranded_failed": stranded_failed,
+            # monitor invariants (alert expectations + planner validation)
+            # are correctness properties of the observability stack itself —
+            # always-armed like lint/stranded, forces the regression exit
+            "monitor_failed": monitor_failed,
             "note": note,
             "markdown": "\n".join(lines),
         }
@@ -1022,6 +1034,105 @@ def build_report_data(
                  "current": c_stranded, "delta_pct": None}
             )
 
+    # Monitoring section (qdml-tpu monitor, docs/TELEMETRY.md "flight
+    # deck"): the burn-rate alerting and the capacity planner are part of
+    # the observability stack itself, so their invariants gate ALWAYS-ARMED
+    # like lint/stranded — a monitor that fails to page during an injected
+    # fault (or pages on a healthy baseline) is broken on any hardware.
+    cur_mon = None
+    for c_src in curs:
+        if c_src.get("monitor") is not None:
+            cur_mon = c_src["monitor"]  # last monitor_summary wins
+    if cur_mon is not None:
+        lines += ["", "## monitoring (flight deck)", ""]
+        alerts = cur_mon.get("alerts") or {}
+        lines.append(
+            f"- monitor: {cur_mon.get('windows', 0)} windows at "
+            f"{cur_mon.get('interval_s', 0)}s, "
+            f"{cur_mon.get('scrape_errors', 0)} scrape errors, "
+            f"{cur_mon.get('counter_resets', 0)} counter resets, "
+            f"{alerts.get('fired', 0)} alert(s) fired / "
+            f"{alerts.get('resolved', 0)} resolved"
+        )
+        # peak burn per signal: informational — the alert-expectation gate
+        # below is the pass/fail judgment, the peaks say how close it came
+        peaks = cur_mon.get("peak_burn") or {}
+        hot = {
+            s: p for s, p in peaks.items()
+            if isinstance(p, dict) and (p.get("fast") or 0) > 0
+        }
+        if hot:
+            lines.append(
+                "- peak burn (fast/slow x budget): " + ", ".join(
+                    f"{s} {p.get('fast', 0):g}/{p.get('slow', 0):g}"
+                    for s, p in sorted(hot.items())
+                )
+            )
+        by_mark = alerts.get("by_mark") or {}
+        expect = cur_mon.get("expect") or {}
+        for mark in sorted(expect.get("fired") or []):
+            fired = int(by_mark.get(mark, 0))
+            ok = fired > 0
+            gates.append(
+                {"metric": f"monitor.alerts[{mark}]", "kind": "monitor",
+                 "baseline": 1, "current": fired, "delta_pct": None,
+                 "status": "ok" if ok else "regression"}
+            )
+            lines.append(
+                f"- alert expectation `{mark}` (fault injected, >=1 must "
+                f"fire): {fired} " + ("ok" if ok else "**REGRESSION**")
+            )
+            if not ok:
+                monitor_failed = True
+                regressions.append(
+                    {"metric": f"monitor.alerts[{mark}]", "baseline": 1,
+                     "current": fired, "delta_pct": None}
+                )
+        for mark in sorted(expect.get("quiet") or []):
+            fired = int(by_mark.get(mark, 0))
+            ok = fired == 0
+            gates.append(
+                {"metric": f"monitor.alerts[{mark}]", "kind": "monitor",
+                 "baseline": 0, "current": fired, "delta_pct": None,
+                 "status": "ok" if ok else "regression"}
+            )
+            lines.append(
+                f"- alert expectation `{mark}` (healthy window, none may "
+                f"fire): {fired} " + ("ok" if ok else "**REGRESSION**")
+            )
+            if not ok:
+                monitor_failed = True
+                regressions.append(
+                    {"metric": f"monitor.alerts[{mark}]", "baseline": 0,
+                     "current": fired, "delta_pct": None}
+                )
+        planner = cur_mon.get("planner")
+        if isinstance(planner, dict):
+            p_ok = bool(planner.get("ok"))
+            gates.append(
+                {"metric": "monitor.planner_validation", "kind": "monitor",
+                 "baseline": None, "current": planner.get("max_p99_ratio"),
+                 "delta_pct": None,
+                 "status": "ok" if p_ok else "regression"}
+            )
+            band = planner.get("band") or {}
+            lines.append(
+                f"- planner validation ({planner.get('n_windows', 0)} "
+                f"windows, p99 within x{band.get('p99_factor', '?')} "
+                f"(wire-mode x{band.get('wire_p99_factor', '?')}), "
+                f"rps within {band.get('rps_frac', '?')}): max p99 ratio "
+                f"{planner.get('max_p99_ratio')}, max rps err "
+                f"{planner.get('max_rps_err')} "
+                + ("ok" if p_ok else "**REGRESSION**")
+            )
+            if not p_ok:
+                monitor_failed = True
+                regressions.append(
+                    {"metric": "monitor.planner_validation", "baseline": None,
+                     "current": planner.get("max_p99_ratio"),
+                     "delta_pct": None}
+                )
+
     # Roofline section: achieved-vs-roofline fraction per train sub-bench
     # (bench.py details.*.roofline.fraction — telemetry/cost.py). The sign is
     # inverted like latency in spirit but the metric is a fraction of the
@@ -1369,6 +1480,7 @@ def report_main(argv: list[str]) -> int:
             or data["lint_failed"]
             or data.get("transfer_failed")
             or data.get("stranded_failed")
+            or data.get("monitor_failed")
         )
         else EXIT_OK
     )

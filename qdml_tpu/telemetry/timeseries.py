@@ -1,0 +1,539 @@
+"""Continuous fleet monitoring: the time-series scraper behind
+``qdml-tpu monitor`` (docs/TELEMETRY.md "monitoring").
+
+PR 15 decomposed every request's latency into phase spans and the fleet
+tier aggregates exact counters — but both are consumed once, at end of
+run. This module watches a LIVE serve/route address continuously:
+
+- **scrape discipline**: only the cheap observability verbs, ever —
+  ``{"op": "health"}`` (1 Hz contract, no histogram merges) and
+  ``{"op": "metrics"}`` (exact merged counters). The monitor never sends
+  an inference request, so an attached monitor provably leaves the request
+  path alone (the dryrun pins an all-zero request-path compile delta and a
+  backend counter audit, scripts/monitor_dryrun.py);
+- **windowing**: cumulative counters are DIFFERENCED between consecutive
+  scrapes into fixed-width windows (the PR-10 snapshot-differencing
+  pattern the FleetController uses), through :func:`counter_delta` — the
+  one sanctioned reset-safe helper. A restarted backend's counters start
+  over; naive subtraction yields a negative "rate" that would page on
+  recovery. ``counter_delta`` clamps the window and FLAGS it, and the
+  scraper emits a structured ``counter_reset`` record instead of garbage
+  (the ``unwindowed-cumulative-rate`` lint rule keeps ad-hoc
+  cumulative/wall-time divisions out of the rest of the tree);
+- **restart attribution**: the health verb's ``start_seq`` construction
+  epoch (serve/server.py) names WHICH backend restarted between scrapes —
+  ``uptime_s`` alone misses a restart older than the poll gap;
+- **bounded state**: in-memory history lives in fixed-size rings
+  (:class:`Ring`); a monitor attached for a week holds the same memory as
+  one attached for a minute. The full stream appends to manifest-headed
+  JSONL (kinds: ``monitor_timeseries``, ``monitor_event``,
+  ``counter_reset``, ``monitor_alert``, ``monitor_summary``).
+
+Burn-rate evaluation itself lives in telemetry/burnrate.py; the capacity
+planner in telemetry/capacity.py. All three are host-side tools — no jax
+import anywhere on this path (``qdml-tpu monitor`` dispatches before the
+CLI's platform/distributed init, like ``report`` and ``lint``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+
+def counter_delta(prev, cur) -> tuple[float, bool]:
+    """Reset-safe cumulative-counter differencing: ``(delta, reset)``.
+
+    The sanctioned way to turn two snapshots of a monotonic counter into a
+    window. When ``cur < prev`` the source restarted (process death, pool
+    re-spawn, an aggregation that lost a member mid-poll): the honest
+    window is unknowable, so the delta clamps to ``cur`` (everything the
+    reborn counter has seen) and ``reset=True`` tells the caller to emit a
+    structured ``counter_reset`` instead of feeding detectors a negative
+    rate. ``None`` snapshots count as 0 (a backend that has not reported
+    yet)."""
+    p = float(prev or 0)
+    c = float(cur or 0)
+    if c < p:
+        return c, True
+    return c - p, False
+
+
+class SnapshotDiff:
+    """Named cumulative counters differenced across polls (reset-safe).
+
+    One instance per monitored stream; :meth:`window` returns this poll's
+    delta for one named counter and records the new snapshot. Resets are
+    per-name: one backend's restart must not poison every other counter's
+    window."""
+
+    def __init__(self):
+        self._prev: dict[str, float] = {}
+
+    def window(self, name: str, cur) -> tuple[float, bool]:
+        delta, reset = counter_delta(self._prev.get(name), cur)
+        self._prev[name] = float(cur or 0)
+        return delta, reset
+
+
+class Ring:
+    """Fixed-capacity record history (newest-wins, O(1) append).
+
+    The monitor's only in-memory state: render/evaluate reads walk the
+    ring, the JSONL stream keeps the full history on disk."""
+
+    def __init__(self, cap: int = 512):
+        self._q: deque = deque(maxlen=int(cap))
+
+    def add(self, rec: dict) -> None:
+        self._q.append(rec)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __iter__(self):
+        return iter(list(self._q))
+
+    def last(self) -> dict | None:
+        return self._q[-1] if self._q else None
+
+
+def _num(x) -> float:
+    """A counter that may arrive as an int, a float, or a per-kind dict
+    (the fleet aggregation's ``shed``/``faults`` blocks sum per kind)."""
+    if isinstance(x, dict):
+        return float(sum(v or 0 for v in x.values()))
+    return float(x or 0)
+
+
+def _breaker_totals(m: dict, h: dict) -> dict:
+    """Fast-fail/admission counters + state, from whichever view carries
+    them: the single-host snapshot's top-level ``breaker`` block, or the
+    fleet aggregation's per-backend rows."""
+    blk = m.get("breaker") or h.get("breaker")
+    if isinstance(blk, dict):
+        return {
+            "fast_fails": _num(blk.get("fast_fails")),
+            "admitted": _num(blk.get("admitted")),
+            "states": {"_": str(blk.get("state"))},
+        }
+    out = {"fast_fails": 0.0, "admitted": 0.0, "states": {}}
+    for bid, row in (m.get("per_backend") or {}).items():
+        b = (row or {}).get("breaker")
+        if isinstance(b, dict):
+            out["fast_fails"] += _num(b.get("fast_fails"))
+            out["admitted"] += _num(b.get("admitted"))
+            out["states"][str(bid)] = str(b.get("state"))
+    return out
+
+
+class MonitorScraper:
+    """The continuous scrape loop over one poller (SocketPoller at a serve
+    or router address, FleetPoller in-process, or any object with
+    ``health()``/``metrics()``).
+
+    Each :meth:`scrape_once`:
+
+    1. polls ``health`` + ``metrics`` (the ONLY verbs it ever sends);
+    2. differences every cumulative counter into this window
+       (:class:`SnapshotDiff`), emitting ``counter_reset`` records for any
+       that went backwards;
+    3. derives ``monitor_event`` records from snapshot changes — backend
+       restart (``start_seq`` changed / ``uptime_s`` went down),
+       quarantine-set growth, breaker transitions, swap-epoch bumps,
+       router ejection/re-admission deltas;
+    4. feeds the windowed error/total pairs into the burn-rate alerter
+       (telemetry/burnrate.py) and emits any ``monitor_alert``
+       transitions;
+    5. appends one ``monitor_timeseries`` record.
+
+    ``mark(tag)`` labels subsequent windows (the dryrun tags its baseline
+    / fault / recovery segments, and the alert-expectation report gate is
+    judged per tag). ``feed_external`` lets a harness wire client-side
+    ledgers (stranded futures live in the loadgen, not the server) into
+    the same alerter.
+    """
+
+    #: burn signals derived from server-side counters every scrape
+    SIGNALS = ("slo", "shed", "breaker", "quarantine", "router")
+
+    def __init__(
+        self,
+        poller,
+        sink=None,
+        interval_s: float = 1.0,
+        alerter=None,
+        ring: int = 512,
+        clock=time.monotonic,
+    ):
+        self.poller = poller
+        self.sink = sink
+        self.interval_s = float(interval_s)
+        self.alerter = alerter
+        self.clock = clock
+        self.ring = Ring(ring)
+        self.events = Ring(ring)
+        self.alerts = Ring(ring)
+        self.diff = SnapshotDiff()
+        self.seq = 0
+        self.scrape_errors = 0
+        self.resets_total = 0
+        self._t0: float | None = None
+        self._last_t: float | None = None
+        self._mark = ""
+        self._marks: list[str] = []
+        self._prev_backends: dict[str, dict] = {}
+        self._prev_breaker_states: dict[str, str] = {}
+        self._prev_swap_epoch: int | None = None
+        self._prev_quarantined = 0
+
+    # -- emission ------------------------------------------------------------
+
+    def _emit(self, kind: str, **payload) -> dict:
+        if self.sink is not None and getattr(self.sink, "active", True):
+            self.sink.emit(kind, **payload)
+        return payload
+
+    def mark(self, tag: str) -> None:
+        """Label windows scraped from now on (dryrun segments; the
+        per-segment alert-expectation gate keys on these)."""
+        self._mark = str(tag)
+        if self._mark and self._mark not in self._marks:
+            self._marks.append(self._mark)
+        self._emit("monitor_event", event="mark", mark=self._mark,
+                   t_s=self._rel(self.clock()))
+
+    def _rel(self, t: float) -> float:
+        if self._t0 is None:
+            self._t0 = t
+        return round(t - self._t0, 4)
+
+    # -- derived events ------------------------------------------------------
+
+    def _backend_rows(self, h: dict) -> dict[str, dict]:
+        per = h.get("per_backend")
+        if isinstance(per, dict):
+            return {str(k): (v or {}) for k, v in per.items()}
+        return {str(h.get("host_id") or "local"): h}
+
+    def _derive_events(self, h: dict, t_s: float) -> list[dict]:
+        evs: list[dict] = []
+        rows = self._backend_rows(h)
+        for bid, row in rows.items():
+            prev = self._prev_backends.get(bid)
+            seq, up = row.get("start_seq"), row.get("uptime_s")
+            if prev is not None:
+                p_seq, p_up = prev.get("start_seq"), prev.get("uptime_s")
+                restarted = (
+                    seq is not None and p_seq is not None and seq != p_seq
+                ) or (
+                    seq is None and up is not None and p_up is not None
+                    and up < p_up
+                )
+                if restarted:
+                    evs.append({"event": "backend_restart", "backend": bid,
+                                "start_seq": seq, "uptime_s": up})
+                if row.get("poll_ok") is False and prev.get("poll_ok") is True:
+                    evs.append({"event": "backend_unreachable", "backend": bid})
+            self._prev_backends[bid] = {
+                "start_seq": seq, "uptime_s": up,
+                "poll_ok": row.get("poll_ok"),
+            }
+        q = h.get("quarantined")
+        qn = len(q) if isinstance(q, (list, tuple)) else int(q or 0)
+        if qn > self._prev_quarantined:
+            evs.append({"event": "quarantine",
+                        "delta": qn - self._prev_quarantined, "now": qn})
+        self._prev_quarantined = qn
+        swap = h.get("swap_epoch")
+        if swap is not None and self._prev_swap_epoch is not None \
+                and swap != self._prev_swap_epoch:
+            evs.append({"event": "swap_epoch", "from": self._prev_swap_epoch,
+                        "to": swap})
+        if swap is not None:
+            self._prev_swap_epoch = int(swap)
+        return evs
+
+    def _breaker_events(self, states: dict[str, str]) -> list[dict]:
+        evs = []
+        for bid, st in states.items():
+            p = self._prev_breaker_states.get(bid)
+            if p is not None and st != p and st != "None":
+                evs.append({"event": "breaker_transition", "backend": bid,
+                            "from": p, "to": st})
+            self._prev_breaker_states[bid] = st
+        return evs
+
+    # -- the scrape ----------------------------------------------------------
+
+    def scrape_once(self) -> dict | None:
+        """One window: poll, difference, derive, alert, emit. Returns the
+        ``monitor_timeseries`` payload (None on a failed poll — the scrape
+        survives a restarting endpoint and reports it)."""
+        t = self.clock()
+        t_s = self._rel(t)
+        try:
+            h = self.poller.health()
+            m = self.poller.metrics()
+        except Exception as e:  # lint: disable=broad-except(a monitor must survive its target restarting mid-scrape: the failed poll is itself the observation, reported as a scrape_error event)
+            self.scrape_errors += 1
+            ev = {"event": "scrape_error", "t_s": t_s,
+                  "error": f"{type(e).__name__}: {e}"}
+            self.events.add(ev)
+            self._emit("monitor_event", **ev)
+            return None
+        dt = None if self._last_t is None else round(t - self._last_t, 4)
+        self._last_t = t
+
+        resets: list[str] = []
+
+        def win(name: str, cur) -> float:
+            d, reset = self.diff.window(name, cur)
+            if reset:
+                resets.append(name)
+            return d
+
+        d_completed = win("completed", m.get("completed"))
+        d_shed = win("shed", _num(m.get("shed")))
+        d_restarts = win("restarts", m.get("restarts"))
+        d_faults = win("faults", _num(m.get("faults")))
+        slo = m.get("slo") or {}
+        d_slo_n = win("slo_n", slo.get("n"))
+        d_slo_met = win("slo_met", slo.get("met"))
+        brk = _breaker_totals(m, h)
+        d_ff = win("breaker_fast_fails", brk["fast_fails"])
+        d_adm = win("breaker_admitted", brk["admitted"])
+        router = h.get("router") or {}
+        d_fwd = win("router_forwarded", router.get("forwarded"))
+        d_rfail = win("router_failed", router.get("failed_forwards"))
+        d_fov = win("router_failovers", router.get("failovers"))
+        d_eject = win("router_ejections", router.get("ejections"))
+        d_readmit = win("router_readmissions", router.get("readmissions"))
+
+        for name in resets:
+            self.resets_total += 1
+            self._emit("counter_reset", counter=name, t_s=t_s,
+                       mark=self._mark)
+
+        evs = self._derive_events(h, t_s)
+        evs.extend(self._breaker_events(brk["states"]))
+        if d_restarts > 0:
+            evs.append({"event": "replica_restart", "delta": d_restarts})
+        if d_eject > 0:
+            evs.append({"event": "backend_ejected", "delta": d_eject})
+        if d_readmit > 0:
+            evs.append({"event": "backend_readmitted", "delta": d_readmit})
+        for ev in evs:
+            ev.setdefault("t_s", t_s)
+            ev.setdefault("mark", self._mark)
+            self.events.add(ev)
+            self._emit("monitor_event", **ev)
+
+        replicas = int(h.get("replicas") or h.get("workers") or 1)
+        quarantine_errs = (
+            sum(e.get("delta", 1) for e in evs
+                if e["event"] in ("quarantine", "replica_restart",
+                                  "backend_restart"))
+        )
+        burn = {}
+        fired: list[dict] = []
+        if self.alerter is not None and dt is not None:
+            self.alerter.feed(t_s, "slo", d_slo_n - d_slo_met, d_slo_n)
+            self.alerter.feed(t_s, "shed", d_shed, d_completed + d_shed)
+            self.alerter.feed(t_s, "breaker", d_ff, d_adm + d_ff)
+            self.alerter.feed(t_s, "quarantine", quarantine_errs,
+                              max(1, replicas))
+            if router:
+                self.alerter.feed(t_s, "router", d_rfail + d_fov, d_fwd)
+            fired = self.alerter.evaluate(t_s, mark=self._mark)
+            for a in fired:
+                self.alerts.add(a)
+                self._emit("monitor_alert", **a)
+            burn = self.alerter.burns(t_s)
+
+        self.seq += 1
+        rec = {
+            "seq": self.seq,
+            "t_s": t_s,
+            "dt_s": dt,
+            "mark": self._mark,
+            "completed": d_completed,
+            "rps": None if not dt else round(d_completed / dt, 3),
+            "shed": d_shed,
+            "faults": d_faults,
+            "restarts": d_restarts,
+            "slo": (
+                None if d_slo_n <= 0
+                else {"n": d_slo_n, "met": d_slo_met,
+                      "attainment": round(d_slo_met / d_slo_n, 4)}
+            ),
+            "breaker": {"fast_fails": d_ff, "admitted": d_adm,
+                        "states": brk["states"]},
+            "router": (
+                None if not router
+                else {"forwarded": d_fwd, "failed": d_rfail,
+                      "failovers": d_fov, "ejections": d_eject,
+                      "readmissions": d_readmit}
+            ),
+            "queue_depth": int(h.get("queue_depth") or 0),
+            "replicas": replicas,
+            "backends_live": h.get("backends_live"),
+            "swap_epoch": h.get("swap_epoch"),
+            "resets": resets or None,
+            "burn": burn or None,
+            "alerts": [a["signal"] for a in fired] or None,
+        }
+        self.ring.add(rec)
+        self._emit("monitor_timeseries", **rec)
+        return rec
+
+    def feed_external(self, signal: str, errors: float, total: float) -> None:
+        """Client-side ledgers (stranded futures, give-ups) into the same
+        alerter: the server cannot observe a client that hung forever, so
+        harnesses that hold the loadgen summary wire it here."""
+        if self.alerter is not None:
+            t_s = self._rel(self.clock())
+            self.alerter.feed(t_s, signal, errors, total)
+            for a in self.alerter.evaluate(t_s, mark=self._mark):
+                self.alerts.add(a)
+                self._emit("monitor_alert", **a)
+
+    def run(self, duration_s: float, stop: threading.Event | None = None) -> int:
+        """Scrape every ``interval_s`` for ``duration_s`` (or until
+        ``stop``); returns the number of windows taken."""
+        stop = stop or threading.Event()
+        end = self.clock() + float(duration_s)
+        while self.clock() < end and not stop.is_set():
+            t0 = self.clock()
+            self.scrape_once()
+            lag = self.interval_s - (self.clock() - t0)
+            if lag > 0 and stop.wait(lag):
+                break
+        return self.seq
+
+    def summary(self, extra: dict | None = None) -> dict:
+        """The ``monitor_summary`` payload (emitted by :meth:`finish`):
+        window/alert/reset totals, per-mark alert counts, peak burn per
+        signal — the facts the report's monitor gates read."""
+        by_mark: dict[str, int] = {m: 0 for m in self._marks}
+        by_signal: dict[str, int] = {}
+        firing = resolved = 0
+        for a in self.alerts:
+            if a.get("state") == "firing":
+                firing += 1
+                by_mark[a.get("mark") or ""] = by_mark.get(a.get("mark") or "", 0) + 1
+                by_signal[a["signal"]] = by_signal.get(a["signal"], 0) + 1
+            elif a.get("state") == "resolved":
+                resolved += 1
+        out = {
+            "windows": self.seq,
+            "interval_s": self.interval_s,
+            "duration_s": self._rel(self.clock()) if self._t0 is not None else 0.0,
+            "scrape_errors": self.scrape_errors,
+            "counter_resets": self.resets_total,
+            "events": len(self.events),
+            "alerts": {"fired": firing, "resolved": resolved,
+                       "by_mark": by_mark, "by_signal": by_signal},
+            "peak_burn": None if self.alerter is None else self.alerter.peaks(),
+        }
+        if extra:
+            out.update(extra)
+        return out
+
+    def finish(self, extra: dict | None = None) -> dict:
+        rec = self.summary(extra)
+        self._emit("monitor_summary", **rec)
+        return rec
+
+
+# ---------------------------------------------------------------------------
+# CLI: qdml-tpu monitor
+# ---------------------------------------------------------------------------
+
+
+def _arg(argv: list[str], name: str, default):
+    return next(
+        (a.split("=", 1)[1] for a in argv if a.startswith(f"--{name}=")),
+        default,
+    )
+
+
+def monitor_main(argv: list[str]) -> int:
+    """``qdml-tpu monitor --addr=HOST:PORT [--interval=1.0] [--duration=30]
+    [--out=monitor.jsonl] [--slo-target=0.99] [--threshold=8]
+    [--fast=0 --slow=0 (0 = scale to duration)] [--debounce=2]`` — attach,
+    scrape, alert, summarize; or ``qdml-tpu monitor --render
+    --current=monitor.jsonl [--events=a.jsonl,b.jsonl] [--out=timeline.md]``
+    to render the committed stream as the markdown timeline dashboard.
+    Host-side only: no jax, no config, no inference."""
+    from qdml_tpu.telemetry.burnrate import BurnAlerter, render_timeline
+
+    if any(a == "--render" for a in argv):
+        cur = _arg(argv, "current", None)
+        if not cur:
+            print("monitor --render needs --current=<monitor.jsonl>")
+            return 2
+        records = []
+        with open(cur) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    records.append(json.loads(line))
+        extra = []
+        ev_paths = _arg(argv, "events", "")
+        for p in [x for x in ev_paths.split(",") if x]:
+            with open(p) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        extra.append(json.loads(line))
+        md = render_timeline(records, extra_events=extra)
+        out = _arg(argv, "out", None)
+        if out:
+            with open(out, "w") as fh:
+                fh.write(md)
+            print(f"wrote {out}")
+        else:
+            print(md)
+        return 0
+
+    addr = _arg(argv, "addr", None)
+    if not addr or ":" not in addr:
+        print("monitor needs --addr=HOST:PORT (a serve or route endpoint)")
+        return 2
+    host, port = addr.rsplit(":", 1)
+    interval = float(_arg(argv, "interval", "1.0"))
+    duration = float(_arg(argv, "duration", "30"))
+    out_path = _arg(argv, "out", "monitor.jsonl")
+    slo_target = float(_arg(argv, "slo-target", "0.99"))
+    threshold = float(_arg(argv, "threshold", "8"))
+    fast = float(_arg(argv, "fast", "0"))
+    slow = float(_arg(argv, "slow", "0"))
+    debounce = int(_arg(argv, "debounce", "2"))
+
+    from qdml_tpu.control.loop import SocketPoller
+    from qdml_tpu.telemetry.manifest import run_manifest
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    alerter = BurnAlerter.for_run(
+        duration_s=duration, interval_s=interval, slo_target=slo_target,
+        threshold=threshold, fast_s=fast or None, slow_s=slow or None,
+        debounce=debounce,
+    )
+    logger = MetricsLogger(
+        out_path, echo=False,
+        manifest=run_manifest(argv=["monitor"] + list(argv), include_jax=False),
+    )
+    scraper = MonitorScraper(
+        SocketPoller(host, int(port), timeout_s=max(5.0, interval * 4)),
+        sink=logger.telemetry, interval_s=interval, alerter=alerter,
+    )
+    try:
+        scraper.run(duration)
+        summary = scraper.finish()
+    finally:
+        logger.close()
+    print(json.dumps({"monitor": summary}, default=str))
+    return 0

@@ -1,0 +1,502 @@
+"""Flight-deck monitoring dryrun over REAL backend serve processes (ISSUE 16).
+
+The live proof of the continuous monitor (docs/TELEMETRY.md "flight
+deck"): spawn 2 genuine ``qdml-tpu serve`` processes, front them with a
+:class:`FleetRouter` (trace bit ON so every window carries phase spans),
+attach a :class:`MonitorScraper` to the front door over the health/metrics
+verbs only, and drive marked traffic segments through it — healthy
+baseline, an idle probe, an injected backend STALL (SIGSTOP mid-window),
+and a recovery window. Every gate is absolute/invariant (no %-latency
+rows — the monitor judges behavior, not this harness's tail noise):
+
+- **paging discipline**: a burn-rate alert FIRES during the injected-stall
+  segment and NEVER during the healthy baseline or the idle probe (the
+  committed ``monitor.jsonl`` carries the transitions; ``qdml-tpu report``
+  re-arms the same expectation from the summary's ``expect`` block);
+- **scrape discipline, proven twice**: the monitor's poller is wrapped in
+  a verb audit (health/metrics only — anything else would AttributeError
+  into a scrape_error), and an idle monitored segment leaves every
+  backend's own completed counter bitwise unchanged while scrapes keep
+  landing; post-run, each backend's ``compile_cache_after_warmup`` delta
+  is all-zero (monitoring rides the observability path, never inference);
+- **timeline correlation**: ``monitor --render`` shows the stall
+  segment's alert annotated with the router's ejection/readmission events
+  on the same clock (the router's global-sink events land in the monitor
+  stream itself);
+- **planner validation**: the trace-replay capacity model self-replays
+  the committed trace_dryrun + fleet_router windows AND this run's fresh
+  traced windows inside the documented band, and the planning sweep
+  answers a "hosts for X rps at p99 <= Y ms" question with a concrete
+  fleet size;
+- **report round-trip exit 0** with the monitoring section's always-armed
+  gates (alert expectations + planner band) green.
+
+Writes ``results/monitor_dryrun/``: ``monitor.jsonl`` (the attachment
+stream), ``baseline_t0/stall_t0/recovery_t0.jsonl`` (traffic windows),
+``timeline.md``, ``report_monitor.md``, ``MONITOR_DRYRUN.json``.
+
+Run: ``python scripts/monitor_dryrun.py [--n=240] [--rate=60]
+[--deadline-ms=500] [--seed=0]``
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+
+def _arg(argv, name, default):
+    return next((a.split("=", 1)[1] for a in argv if a.startswith(f"--{name}=")), default)
+
+
+def _free_port() -> int:
+    with socket.socket() as sk:
+        sk.bind(("127.0.0.1", 0))
+        return sk.getsockname()[1]
+
+
+class VerbAuditPoller:
+    """The monitor's poller, pinned: ONLY the observability verbs exist on
+    this object — a scraper reaching for request/swap/scale would
+    AttributeError into its scrape_error path, and the audit set proves
+    what it actually used."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls: set = set()
+
+    def health(self):
+        self.calls.add("health")
+        return self._inner.health()
+
+    def metrics(self):
+        self.calls.add("metrics")
+        return self._inner.metrics()
+
+
+def main(argv: list[str]) -> int:
+    n = int(_arg(argv, "n", "240"))
+    rate = float(_arg(argv, "rate", "60"))
+    deadline_ms = float(_arg(argv, "deadline-ms", "500"))
+    threshold = _arg(argv, "threshold", "50")
+    seed = int(_arg(argv, "seed", "0"))
+    force_cpu(2)
+
+    import asyncio
+    import dataclasses
+    from concurrent.futures import Future
+
+    from qdml_tpu.config import (
+        ControlConfig,
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        ServeConfig,
+        TrainConfig,
+    )
+    from qdml_tpu.control.loop import SocketPoller
+    from qdml_tpu.fleet import FleetRouter, route_async, spawn_backend
+    from qdml_tpu.serve import ServeClient, make_request_samples, run_loadgen_socket
+    from qdml_tpu.telemetry import run_manifest, set_sink
+    from qdml_tpu.telemetry.burnrate import BurnAlerter, BurnRateRule
+    from qdml_tpu.telemetry.capacity import (
+        load_summary,
+        plan_backends,
+        validate_windows,
+    )
+    from qdml_tpu.telemetry.report import report_main
+    from qdml_tpu.telemetry.timeseries import MonitorScraper, monitor_main
+    from qdml_tpu.train.hdce import train_hdce
+    from qdml_tpu.train.qsc import train_classifier
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    out_dir = os.path.join("results", "monitor_dryrun")
+    os.makedirs(out_dir, exist_ok=True)
+    for stale in glob.glob(os.path.join(out_dir, "*.jsonl")):
+        os.remove(stale)  # telemetry streams APPEND: a prior run's records
+        # would smuggle its alerts/windows into this run's gates
+    scratch = tempfile.mkdtemp(prefix="monitor_")
+
+    cfg = ExperimentConfig(
+        name="monitor_dryrun",
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=8, workdir=scratch, probe_every=0),
+        serve=ServeConfig(
+            max_batch=16, buckets=(4, 16), max_wait_ms=2.0, max_queue=64,
+            batching="bucket", dedup_ttl_s=10.0, conn_timeout_s=5.0,
+            supervise=True,
+        ),
+        control=ControlConfig(min_window=6, autoscale=False),
+    )
+    workdir = os.path.join(scratch, f"Pn_{cfg.data.pilot_num}", cfg.name)
+    print("training fleet models (8-epoch HDCE + 8-epoch SC) ...", flush=True)
+    tlog = MetricsLogger(os.path.join(scratch, "train.jsonl"), echo=False,
+                        manifest=run_manifest(cfg))
+    try:
+        train_hdce(cfg, logger=tlog, workdir=workdir)
+        sc_cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, n_epochs=8)
+        )
+        train_classifier(sc_cfg, quantum=False, logger=tlog, workdir=workdir)
+    finally:
+        tlog.close()
+    samples = make_request_samples(cfg, int(n * 1.5))  # the stall window
+    # runs 1.5x long so the fault + debounce + page resolve inside it
+
+    backend_overrides = [
+        "--name=monitor_dryrun",
+        "--data.n_ant=16", "--data.n_sub=8", "--data.n_beam=4",
+        "--data.data_len=64", "--model.features=8", "--train.batch_size=16",
+        f"--train.workdir={scratch}",
+        "--serve.max_batch=16", "--serve.buckets=(4,16)",
+        "--serve.max_wait_ms=2.0", "--serve.max_queue=64",
+        "--serve.batching=bucket", "--serve.dedup_ttl_s=10.0",
+        "--serve.conn_timeout_s=5.0", "--serve.supervise=true",
+        # the ROUTER's trace bit turns tracing on; backends sample at 0
+        "--serve.trace_sample=0.0",
+    ]
+    ports = [_free_port(), _free_port()]
+
+    def spawn(i: int):
+        print(f"spawning backend {i} on :{ports[i]} ...", flush=True)
+        b = spawn_backend(backend_overrides, port=ports[i])
+        print(json.dumps({"backend": i, "port": b.port, "host_id": b.host_id,
+                          "compiles_after_warmup": b.banner[
+                              "compile_cache_after_warmup"]}), flush=True)
+        return b
+
+    backends = [spawn(0), spawn(1)]
+    router = FleetRouter(
+        [("127.0.0.1", p) for p in ports],
+        balance="hash", timeout_s=1.0, retries=0,
+        eject_failures=2, eject_s=0.5, readmit_probes=1,
+        poll_interval_s=0.2, failover=2, seed=seed,
+        dedup_ttl_s=120.0,
+        trace_sample=1.0,  # every window carries phase spans: the fresh
+        # windows join the committed set in the planner's validation gate
+    ).start()
+    aloop = asyncio.new_event_loop()
+    tloop = threading.Thread(target=aloop.run_forever, daemon=True)
+    tloop.start()
+    ready: Future = Future()
+    front_task = asyncio.run_coroutine_threadsafe(
+        route_async(router, "127.0.0.1", 0, ready,
+                    conn_timeout_s=5.0, max_line_bytes=1 << 20),
+        aloop,
+    )
+    front = ("127.0.0.1", ready.result(timeout=30.0))
+    print(json.dumps({"router_front": front[1]}), flush=True)
+
+    # -------- attach the monitor (health/metrics only, audited) -----------
+    mon_path = os.path.join(out_dir, "monitor.jsonl")
+    mlog = MetricsLogger(mon_path, echo=False, manifest=run_manifest(cfg))
+    # the router's structured fleet events (backend_ejected/readmitted) go
+    # to the process-global sink: point it at the monitor stream so the
+    # timeline correlates alerts with the stack's own events on one clock
+    set_sink(mlog.telemetry)
+    audit = VerbAuditPoller(SocketPoller(front[0], front[1], timeout_s=5.0))
+    alerter = BurnAlerter.for_run(duration_s=30.0, interval_s=0.4,
+                                  slo_target=0.99, threshold=8.0, debounce=2)
+    # harness-scaled router rule: a fast-ejecting router (eject_failures=2,
+    # 1s timeouts) caps the failover fraction a 3-second stall can produce
+    # at ~10-13% of forwards — burn ~5-6x on the 0.02 budget — and the page
+    # must fire AND the slow window must fill inside one short window, so
+    # the router pair runs tighter/lower than the production-shaped default
+    # (a real deployment keeps for_run's scaling). Budget and mechanics are
+    # unchanged; only the pair's geometry is scaled to the run.
+    alerter.rules["router"] = BurnRateRule(
+        "router", budget=0.02, fast_s=1.2, slow_s=3.6,
+        threshold=3.0, debounce=2,
+    )
+    scraper = MonitorScraper(audit, sink=mlog.telemetry, interval_s=0.4,
+                             alerter=alerter)
+    stop_mon = threading.Event()
+    scraper.mark("baseline_t0")
+    mon_thread = threading.Thread(
+        target=scraper.run, args=(600.0,), kwargs={"stop": stop_mon},
+        daemon=True,
+    )
+    mon_thread.start()
+
+    window_seq = [0]
+
+    def serve_window(tag: str, n_win: int, during=None):
+        side_err: list = []
+        side = None
+        if during is not None:
+            def _side():
+                try:
+                    during()
+                except Exception as e:  # lint: disable=broad-except(the injection side thread must report its failure into the headline, not die silently and fake a passing run)
+                    side_err.append(f"{type(e).__name__}: {e}")
+            side = threading.Thread(target=_side, daemon=True)
+            side.start()
+        path = os.path.join(out_dir, f"{tag}.jsonl")
+        logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+        window_seq[0] += 1  # fresh loadgen ids per window (dedup discipline)
+        try:
+            summary = run_loadgen_socket(
+                cfg, front, rate=rate, n=n_win,
+                seed=seed + 1000 * window_seq[0],
+                deadline_ms=deadline_ms, logger=logger, clients=8,
+                x=samples["x"],
+            )
+        finally:
+            logger.close()
+        if side is not None:
+            side.join(timeout=60.0)
+        if side_err:
+            summary["injection_error"] = side_err[0]
+        return summary, path
+
+    def backend_poll(port: int, verb: str = "metrics") -> dict | None:
+        try:
+            with ServeClient("127.0.0.1", port, timeout_s=5.0, retries=1) as c:
+                rep = c.metrics() if verb == "metrics" else c.health()
+                return rep.get(verb)
+        except Exception:  # lint: disable=broad-except(a dead/stalled backend is an expected poll outcome here; the caller records None)
+            return None
+
+    def per_port_completed() -> dict:
+        out = {}
+        for p in ports:
+            m = backend_poll(p)
+            out[p] = None if m is None else int(m.get("completed") or 0)
+        return out
+
+    headline: dict = {
+        "n": n, "rate": rate, "deadline_ms": deadline_ms, "seed": seed,
+        "monitor": {"interval_s": scraper.interval_s,
+                    "burn_windows": {
+                        s: {"fast_s": r.fast_s, "slow_s": r.slow_s,
+                            "threshold": r.threshold, "budget": r.budget}
+                        for s, r in alerter.rules.items()
+                    }},
+        "backends": {b.host_id: {"port": b.port} for b in backends},
+        "classes": {},
+    }
+    all_pass = True
+
+    def finish_class(kind: str, checks: dict, ok: bool) -> None:
+        nonlocal all_pass
+        checks["ok"] = ok
+        headline["classes"][kind] = checks
+        all_pass = all_pass and ok
+        print(json.dumps({kind: {"ok": ok}}), flush=True)
+
+    # -------- baseline segment: healthy fleet under the monitor ----------
+    base_summary, base_path = serve_window("baseline_t0", n)
+    time.sleep(1.2)  # stream drains; any late window still carries this mark
+    finish_class("baseline", {
+        "completed": base_summary["completed"],
+        "stranded_futures": base_summary["stranded_futures"],
+        "slo": base_summary["slo"],
+        "path": base_path,
+    }, base_summary["stranded_futures"] == 0 and base_summary["completed"] > 0)
+
+    # -------- idle probe: the scrape path adds ZERO requests --------------
+    scraper.mark("idle_probe")
+    seq0 = scraper.seq
+    before_idle = per_port_completed()
+    time.sleep(2.5)
+    after_idle = per_port_completed()
+    idle_scrapes = scraper.seq - seq0
+    idle_ok = (
+        idle_scrapes >= 2
+        and all(before_idle[p] is not None and after_idle[p] == before_idle[p]
+                for p in ports)
+    )
+    finish_class("scrape_inference_free_idle", {
+        "scrapes_during_idle": idle_scrapes,
+        "completed_before": before_idle,
+        "completed_after": after_idle,
+    }, idle_ok)
+
+    # -------- injected stall: the monitor must page ----------------------
+    scraper.mark("stall_t0")
+
+    def inject_stall():
+        time.sleep(1.0)
+        backends[1].stall()
+        time.sleep(3.0)
+        backends[1].resume()
+
+    stall_summary, stall_path = serve_window(
+        "stall_t0", int(n * 1.5), during=inject_stall
+    )
+    time.sleep(2.0)  # late burn transitions still attribute to stall_t0
+
+    # router re-admits the resumed backend before the recovery window
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and len(router.live_backends()) < 2:
+        router.poll_once()
+        time.sleep(0.1)
+
+    scraper.mark("recovery_t0")
+    rec_summary, rec_path = serve_window("recovery_t0", n)
+    time.sleep(1.2)
+    stop_mon.set()
+    mon_thread.join(timeout=15.0)
+
+    fired_marks = sorted({
+        a.get("mark") for a in scraper.alerts if a.get("state") == "firing"
+    })
+    alert_ok = (
+        "stall_t0" in fired_marks
+        and "baseline_t0" not in fired_marks
+        and "idle_probe" not in fired_marks
+        and stall_summary.get("injection_error") is None
+    )
+    finish_class("burn_alert_paging", {
+        "fired_marks": fired_marks,
+        "alerts": list(scraper.alerts),
+        "peak_burn": alerter.peaks(),
+        "stall_window": {
+            "completed": stall_summary["completed"],
+            "stranded_futures": stall_summary["stranded_futures"],
+            "slo": stall_summary["slo"],
+        },
+        "injection_error": stall_summary.get("injection_error"),
+        "backends_live_after": len(router.live_backends()),
+    }, alert_ok and stall_summary["stranded_futures"] == 0
+       and len(router.live_backends()) == 2)
+
+    # -------- scrape discipline: verbs + per-backend compile deltas -------
+    verbs = sorted(audit.calls)
+    compile_gate = {}
+    for b in backends:
+        m = backend_poll(b.port)
+        compile_gate[b.host_id] = None if m is None else m.get(
+            "compile_cache_after_warmup")
+    compiles_ok = all(
+        isinstance(v, dict) and all(c == 0 for c in v.values())
+        for v in compile_gate.values()
+    ) and len(compile_gate) == 2
+    finish_class("scrape_verbs_and_compiles", {
+        "verbs_used": verbs,
+        "per_backend_compiles": compile_gate,
+        "scrape_errors": scraper.scrape_errors,
+    }, verbs == ["health", "metrics"] and compiles_ok)
+
+    # -------- capacity planner: validate committed + fresh windows --------
+    committed = sorted(glob.glob(os.path.join(
+        "results", "trace_dryrun", "traced_t*.jsonl"
+    ))) + sorted(glob.glob(os.path.join(
+        "results", "fleet_router", "baseline*.jsonl"
+    )))
+    fresh = [base_path, rec_path]
+    validation = validate_windows(committed + fresh, n_samples=8000, seed=seed)
+    # the planning demo: answer a real question against this run's own
+    # traced baseline — target above the window's exogenous floor (adders
+    # the fleet size cannot shrink), so the sweep must resolve a size
+    meas = load_summary(base_path)
+    meas_p99 = float((meas.get("latency_ms") or {}).get("p99_ms") or 100.0)
+    plan = plan_backends(
+        base_path, target_rps=float(meas.get("rps") or rate),
+        p99_ms=meas_p99 * 1.6, max_backends=6, n_samples=3000, seed=seed,
+    )
+    plan_ok = plan["backends_needed"] is not None
+    finish_class("planner", {
+        "validation": {k: v for k, v in validation.items() if k != "rows"},
+        "windows": [r["path"] for r in validation["rows"]],
+        "plan_demo": {"target_rps": plan["target_rps"],
+                      "p99_target_ms": plan["p99_target_ms"],
+                      "backends_needed": plan["backends_needed"]},
+    }, validation["ok"] and plan_ok)
+
+    # -------- summary + timeline + report round-trip ----------------------
+    expect = {"fired": ["stall_t0"], "quiet": ["baseline_t0", "idle_probe"]}
+    scraper.finish(extra={"expect": expect, "planner": validation,
+                          "plan_demo": plan})
+    set_sink(None)
+    mlog.close()
+
+    timeline_path = os.path.join(out_dir, "timeline.md")
+    rc_render = monitor_main([
+        "--render", f"--current={mon_path}", f"--events={stall_path}",
+        f"--out={timeline_path}",
+    ])
+    with open(timeline_path) as fh:
+        timeline = fh.read()
+    timeline_ok = (
+        rc_render == 0
+        and "**ALERT" in timeline
+        and ("backend_ejected" in timeline or "backend_readmitted" in timeline)
+        and "capacity-planner validation: PASS" in timeline
+    )
+    finish_class("timeline", {
+        "path": timeline_path,
+        "render_exit": rc_render,
+        "has_alert_row": "**ALERT" in timeline,
+        "has_stack_event": "backend_ejected" in timeline
+        or "backend_readmitted" in timeline,
+    }, timeline_ok)
+
+    # round-trip 1 (exit-code plumbing, repo self-vs-self pattern): the
+    # committed baseline + monitor stream against the baseline itself must
+    # exit 0 WITH the monitor gates armed — a monitor_failed would flip it
+    report_md = os.path.join(out_dir, "report_monitor.md")
+    rc = report_main([
+        f"--current={base_path},{mon_path}", f"--baseline={base_path}",
+        f"--threshold={threshold}", f"--out={report_md}",
+        f"--json={os.path.join(out_dir, 'report_monitor.json')}",
+    ])
+    with open(report_md) as fh:
+        monitor_lines = [ln.strip() for ln in fh if "alert expectation" in ln
+                         or "planner validation" in ln]
+    # round-trip 2 (the CI stage's judgment, scripts/run_tier1.sh): the
+    # recovery window judged on INVARIANT + monitor rows only — %-latency
+    # rows between two windows on this 2-core harness are contention noise,
+    # which is exactly why the tier-1 stage reads the JSON rows, not the rc
+    rec_json = os.path.join(out_dir, "report_recovery.json")
+    report_main([
+        f"--current={rec_path},{mon_path}", f"--baseline={base_path}",
+        f"--threshold={threshold}",
+        f"--out={os.path.join(out_dir, 'report_recovery.md')}",
+        f"--json={rec_json}",
+    ])
+    with open(rec_json) as fh:
+        rec_gate = json.load(fh)
+    invariant_kinds = ("resilience", "breaker", "dispatch", "batching",
+                      "monitor")
+    invariants_ok = (
+        not rec_gate.get("stranded_failed")
+        and not rec_gate.get("monitor_failed")
+        and not any(
+            g.get("status") == "regression" and g.get("kind") in invariant_kinds
+            for g in rec_gate.get("gates", [])
+        )
+    )
+    finish_class("report_roundtrip", {
+        "selfcheck_exit": rc,
+        "monitor_gate_lines": monitor_lines,
+        "recovery_invariants_ok": invariants_ok,
+    }, rc == 0 and invariants_ok and len(monitor_lines) >= 4)
+
+    # -------- teardown + headline ----------------------------------------
+    front_task.cancel()
+    aloop.call_soon_threadsafe(aloop.stop)
+    tloop.join(timeout=10.0)
+    router.stop()
+    for b in backends:
+        b.terminate()
+    headline["all_pass"] = all_pass
+    with open(os.path.join(out_dir, "MONITOR_DRYRUN.json"), "w") as fh:
+        json.dump(headline, fh, indent=2, default=str)
+    print(json.dumps({"all_pass": all_pass}))
+    return 0 if all_pass else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

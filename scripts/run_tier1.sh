@@ -81,4 +81,44 @@ ok = d.get('all_pass') and d.get('compile_cache_per_backend') and all(
 sys.exit(0 if ok else 1)
 " || { echo "trace dryrun headline failed (all_pass / zero-compile)"; exit 6; }
 fi
+# Monitor dryrun (docs/TELEMETRY.md "flight deck", results/monitor_dryrun):
+# re-arm the invariant rows PLUS the always-armed monitor gates — the alert
+# expectations baked into the committed monitor_summary's expect block (the
+# injected-stall segment must have paged, the healthy segments must not)
+# and the capacity planner's validation band — then re-check the headline's
+# absolute facts (all_pass, health/metrics-only scrape verbs, zero
+# per-backend request-path compile deltas) and re-run the planner's
+# self-replay validation from scratch over committed windows (exit 0).
+if [ -d results/monitor_dryrun ]; then
+  rm -f /tmp/_t1_monitor.json
+  python -m qdml_tpu.cli report \
+    --current=results/monitor_dryrun/recovery_t0.jsonl,results/monitor_dryrun/monitor.jsonl \
+    --baseline=results/monitor_dryrun/baseline_t0.jsonl \
+    --json=/tmp/_t1_monitor.json > /dev/null || true  # rc judged on the JSON rows below
+  python -c "
+import json, sys
+d = json.load(open('/tmp/_t1_monitor.json'))
+invariant_kinds = ('resilience', 'breaker', 'dispatch', 'batching', 'monitor')
+bad = d.get('stranded_failed') or d.get('monitor_failed') or any(
+    g.get('status') == 'regression' and g.get('kind') in invariant_kinds
+    for g in d.get('gates', [])
+)
+sys.exit(1 if bad else 0)
+" || { echo "monitor invariant gate failed"; exit 6; }
+  python -c "
+import json, sys
+d = json.load(open('results/monitor_dryrun/MONITOR_DRYRUN.json'))
+c = d.get('classes') or {}
+sv = c.get('scrape_verbs_and_compiles') or {}
+zero = lambda m: isinstance(m, dict) and all(v == 0 for v in m.values())
+comp = sv.get('per_backend_compiles') or {}
+ok = (d.get('all_pass') and sv.get('verbs_used') == ['health', 'metrics']
+      and comp and all(zero(v) for v in comp.values()))
+sys.exit(0 if ok else 1)
+" || { echo "monitor dryrun headline failed (all_pass / verbs / zero-compile)"; exit 6; }
+  python -m qdml_tpu.cli plan \
+    --trace=results/trace_dryrun/traced_t0.jsonl,results/monitor_dryrun/baseline_t0.jsonl,results/monitor_dryrun/recovery_t0.jsonl \
+    --validate > /dev/null \
+    || { echo "planner self-replay validation failed"; exit 6; }
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

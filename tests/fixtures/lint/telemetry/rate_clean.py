@@ -1,0 +1,32 @@
+"""Fixture: rate shapes unwindowed-cumulative-rate must NOT flag —
+windowed deltas, count-over-count ratios, and divisions by non-time
+values. Expected: no findings."""
+
+import time
+
+
+class Windowed:
+    def __init__(self):
+        self.completed = 0
+        self.slo_met = 0
+        self.slo_total = 0
+        self._prev = 0
+
+    def good_windowed_delta(self, dt_s):
+        # a DELTA over the window width is the sanctioned shape
+        d_completed = self.completed - self._prev
+        self._prev = self.completed
+        return d_completed / max(dt_s, 1e-9)
+
+    def good_count_ratio(self):
+        # count over count: attainment, not a rate
+        return self.slo_met / max(1, self.slo_total)
+
+    def good_non_time_divisor(self, n_backends):
+        # counter divided by a count is a share, not a rate
+        return self.completed / max(1, n_backends)
+
+    def good_time_numerator(self, t0):
+        # span over count: mean latency, fine
+        elapsed = time.monotonic() - t0
+        return elapsed / max(1, self.slo_total)

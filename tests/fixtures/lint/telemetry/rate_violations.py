@@ -1,0 +1,27 @@
+"""Fixture: unwindowed-cumulative-rate findings — cumulative lifetime
+counters divided by wall-clock spans (the restart-garbage / long-run-inert
+rate shape). Expected: exactly 3 unwindowed-cumulative-rate findings."""
+
+import time
+
+
+class Metrics:
+    def __init__(self):
+        self.completed = 0
+        self.rows_useful = 0
+        self._t0 = time.monotonic()
+
+    def bad_direct_clock(self):
+        # finding 1: counter divided by a direct span-clock expression
+        return self.completed / (time.monotonic() - self._t0)
+
+    def bad_local_span(self):
+        # finding 2: counter divided by a local bound to a clock span
+        elapsed = time.monotonic() - self._t0
+        return self.rows_useful / elapsed
+
+    def bad_chained_span(self, t0):
+        # finding 3: one-step dataflow chain (now -> elapsed)
+        now = time.perf_counter()
+        elapsed = now - t0
+        return self.completed / max(elapsed, 1e-9)

@@ -148,6 +148,50 @@ def test_trace_in_jit_path_fixtures():
         assert not [f for f in findings if f.rule == "trace-in-jit-path"], path
 
 
+def test_unwindowed_cumulative_rate_fixtures():
+    """The windowed-rate discipline rule: a cumulative lifetime counter
+    divided by a wall-clock span (directly, via a span-bound local, or
+    through a one-step name chain) is a finding; windowed deltas,
+    count-over-count ratios and non-time divisors are clean; the sanctioned
+    differencing module is exempt by path; and the real counter surfaces
+    pass their own rule (run-level summary rates carry inline suppressions
+    with reasons)."""
+    from qdml_tpu.analysis.rules import rule_unwindowed_cumulative_rate
+
+    engine = LintEngine(REPO)
+    findings, err = engine.lint_file(f"{FIXDIR}/telemetry/rate_violations.py")
+    assert err is None
+    assert _rules_found(findings) == {"unwindowed-cumulative-rate": 3}
+    findings, err = engine.lint_file(f"{FIXDIR}/telemetry/rate_clean.py")
+    assert err is None
+    assert findings == [], _rules_found(findings)
+    # the sanctioned differencing module is exempt by relpath, even for a
+    # shape the rule would otherwise flag
+    with open(f"{FIXDIR}/telemetry/rate_violations.py") as fh:
+        src = fh.read()
+    assert rule_unwindowed_cumulative_rate(
+        _ctx(src, "qdml_tpu/telemetry/timeseries.py")
+    ) == []
+    # and the same source under any other qdml_tpu path fires
+    assert len(rule_unwindowed_cumulative_rate(
+        _ctx(src, "qdml_tpu/serve/other.py")
+    )) == 3
+    # the real cumulative-counter surfaces pass their own rule (the
+    # run-level summary rates in serve/metrics.py via reasoned suppression)
+    for path in (
+        "qdml_tpu/serve/metrics.py",
+        "qdml_tpu/fleet/router.py",
+        "qdml_tpu/control/loop.py",
+        "qdml_tpu/telemetry/burnrate.py",
+    ):
+        findings, err = engine.lint_file(path)
+        assert err is None
+        assert not [
+            f for f in findings
+            if f.rule == "unwindowed-cumulative-rate" and not f.suppressed
+        ], path
+
+
 def test_retry_without_backoff_own_client_is_clean():
     """The sanctioned retry shape — ServeClient.call's jittered exponential
     backoff — passes the rule that exists because of it."""

@@ -1,0 +1,314 @@
+"""Trace-replay capacity planner (qdml_tpu/telemetry/capacity.py): the
+queue theory is pinned against closed forms (M/D/1 Crommelin, M/M/1
+sojourn), the window models against synthetic committed artifacts, and
+the sweep/CLI against their contracts. Host-side — no engine, no jax."""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+
+import pytest
+
+from qdml_tpu.telemetry.capacity import (
+    P99_BAND,
+    RPS_BAND_FRAC,
+    WIRE_P99_BAND,
+    QuantileDist,
+    load_summary,
+    md1_wait_cdf,
+    md1_wait_quantile,
+    mm1_sojourn_quantile,
+    plan_backends,
+    plan_main,
+    replay_arrivals,
+    simulate_queue,
+    validate_window,
+    validate_windows,
+    window_model,
+)
+
+
+# ---------------------------------------------------------------------------
+# queue theory vs the simulator
+# ---------------------------------------------------------------------------
+
+
+def _sim_wait_quantiles(lam, services, qs, n=60000, seed=3):
+    arr = replay_arrivals(n, lam, "poisson", seed=seed)
+    waits = sorted(simulate_queue(arr, services))
+    return [waits[min(n - 1, int(q * n))] for q in qs]
+
+
+def test_simulator_matches_md1_closed_form():
+    """The DES against Crommelin's exact M/D/1 waiting-time CDF — the
+    planner's queue core is real queueing theory, not vibes."""
+    lam, d = 0.7, 1.0
+    sim = _sim_wait_quantiles(lam, [d] * 60000, [0.5, 0.9, 0.99])
+    for q, w_sim in zip([0.5, 0.9, 0.99], sim):
+        w_exact = md1_wait_quantile(q, lam, d)
+        assert w_exact == pytest.approx(w_sim, rel=0.10, abs=0.05), (
+            f"q={q}: sim {w_sim} vs M/D/1 {w_exact}"
+        )
+
+
+def test_simulator_matches_mm1_closed_form():
+    lam, mu = 0.6, 1.0
+    rng = random.Random(11)
+    n = 60000
+    arr = replay_arrivals(n, lam, "poisson", seed=5)
+    svc = [rng.expovariate(mu) for _ in range(n)]
+    waits = simulate_queue(arr, svc)
+    soj = sorted(w + s for w, s in zip(waits, svc))
+    q90_sim = soj[int(0.9 * n)]
+    q90_exact = mm1_sojourn_quantile(0.9, lam, mu)
+    assert q90_exact == pytest.approx(q90_sim, rel=0.08)
+
+
+def test_md1_cdf_shape_and_quantile_inversion():
+    lam, d = 0.5, 1.0
+    assert md1_wait_cdf(0.0, lam, d) == pytest.approx(1 - lam * d)  # P(W=0)=1-rho
+    assert md1_wait_cdf(-1.0, lam, d) == 0.0
+    prev = 0.0
+    for t in [0.0, 0.5, 1.0, 2.0, 4.0, 8.0]:
+        cur = md1_wait_cdf(t, lam, d)
+        assert 0.0 <= cur <= 1.0 and cur >= prev  # monotone CDF
+        prev = cur
+    for q in (0.5, 0.9, 0.99):
+        t = md1_wait_quantile(q, lam, d)
+        assert md1_wait_cdf(t, lam, d) == pytest.approx(q, abs=1e-3)
+    # unstable queue: no finite wait distribution
+    assert md1_wait_cdf(10.0, lam=1.5, d=1.0) == 0.0
+
+
+def test_simulate_queue_multiserver_and_empty():
+    assert simulate_queue([], []) == []
+    # two servers, simultaneous arrivals with unit service: no one waits
+    waits = simulate_queue([0.0, 0.0], [1.0, 1.0], servers=2)
+    assert waits == [0.0, 0.0]
+    # one server: the second waits a full service time
+    waits = simulate_queue([0.0, 0.0], [1.0, 1.0], servers=1)
+    assert waits == [0.0, 1.0]
+
+
+def test_replay_arrivals_processes():
+    uni = replay_arrivals(100, 50.0, "uniform")
+    gaps = [b - a for a, b in zip(uni, uni[1:])]
+    assert all(g == pytest.approx(0.02) for g in gaps)
+    poi = replay_arrivals(5000, 50.0, "poisson", seed=1)
+    assert len(poi) == 5000 and poi == sorted(poi)
+    assert 5000 / poi[-1] == pytest.approx(50.0, rel=0.1)
+    # mmpp alternates hot/cold phases; same deterministic seed, same answer
+    mm = replay_arrivals(1000, 50.0, "mmpp", burstiness=3.0, seed=2)
+    assert mm == replay_arrivals(1000, 50.0, "mmpp", burstiness=3.0, seed=2)
+
+
+# ---------------------------------------------------------------------------
+# quantile-dist reconstruction
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_dist_interpolation_and_mean():
+    d = QuantileDist.from_summary(
+        {"n": 100, "mean_ms": 11.0, "p50_ms": 10.0, "p95_ms": 20.0,
+         "p99_ms": 30.0, "max_ms": 40.0}
+    )
+    assert d.quantile(0.5) == pytest.approx(10.0)
+    assert d.quantile(0.99) == pytest.approx(30.0)
+    assert d.quantile(1.0) == pytest.approx(40.0)
+    # piecewise-linear between anchors
+    mid = d.quantile(0.725)
+    assert 10.0 < mid < 20.0
+    # sampling stays inside the support
+    rng = random.Random(0)
+    xs = [d.sample(rng) for _ in range(2000)]
+    assert min(xs) >= 0.0 and max(xs) <= 40.0
+    med = sorted(xs)[1000]
+    assert med == pytest.approx(10.0, rel=0.15)
+    assert 0.0 < d.mean() < 40.0
+
+
+def test_quantile_dist_missing_is_none():
+    assert QuantileDist.from_summary(None) is None
+    assert QuantileDist.from_summary({"p50_ms": None}) is None
+
+
+# ---------------------------------------------------------------------------
+# window models + validation bands (synthetic committed artifacts)
+# ---------------------------------------------------------------------------
+
+
+def _phase(p50, p95=None, p99=None, mx=None):
+    return {"n": 500, "mean_ms": p50, "p50_ms": p50,
+            "p95_ms": p95 or p50 * 1.2, "p99_ms": p99 or p50 * 1.4,
+            "max_ms": mx or p50 * 1.6}
+
+
+def _traced_summary(p99_ms=32.0, mean_ms=21.0, rps=100.0):
+    return {
+        "kind": "serve_summary",
+        "n_requests": 2000,
+        "rps": rps,
+        "offered_rps": rps * 1.01,
+        "arrival": {"process": "poisson", "burstiness": 1.0},
+        "latency_ms": {"mean_ms": mean_ms, "p50_ms": mean_ms,
+                       "p95_ms": p99_ms * 0.9, "p99_ms": p99_ms,
+                       "max_ms": p99_ms * 1.3},
+        "phases": {
+            "batch_wait": _phase(4.0),
+            "queue_wait": _phase(1.0),
+            "compute": _phase(10.0),
+            "fetch": _phase(2.0),
+            "wire": _phase(3.0),
+            "pick": _phase(0.5),
+        },
+        "trace": {"reconciliation": {"mean_unattributed_ms": 0.5}},
+    }
+
+
+def _wire_summary(p99_ms=30.0):
+    return {
+        "kind": "serve_summary",
+        "completed": 1500,
+        "rps": 90.0,
+        "latency_ms": {"mean_ms": 21.0, "p50_ms": 20.0, "p95_ms": 27.0,
+                       "p99_ms": p99_ms, "max_ms": 45.0},
+        "router": {"wire_latency_ms": _phase(20.0, 26.0, 29.0, 44.0)},
+    }
+
+
+def _write_window(tmp_path, name, summary):
+    p = tmp_path / name
+    with open(p, "w") as fh:
+        fh.write(json.dumps({"kind": "manifest", "argv": ["test"]}) + "\n")
+        fh.write(json.dumps(summary) + "\n")
+    return str(p)
+
+
+def test_window_model_picks_phases_then_wire_then_none(tmp_path):
+    assert window_model(_traced_summary())["mode"] == "phases"
+    assert window_model(_wire_summary())["mode"] == "wire"
+    bare = {"kind": "serve_summary", "latency_ms": {"p99_ms": 5.0}}
+    assert window_model(bare)["mode"] is None
+    with pytest.raises(ValueError):
+        load_summary(_write_window(tmp_path, "empty.jsonl",
+                                   {"kind": "counters", "completed": 1}))
+
+
+def test_validate_window_phases_mode_self_consistent(tmp_path):
+    """A window whose client quantiles match its phase composition must
+    validate well inside the band."""
+    path = _write_window(tmp_path, "traced.jsonl", _traced_summary())
+    row = validate_window(path, n_samples=8000, seed=1)
+    assert row["mode"] == "phases" and row["ok"] is True
+    assert row["p99_ratio"] == pytest.approx(1.0, abs=math.log(P99_BAND))
+    assert row["rps_err"] <= RPS_BAND_FRAC
+    assert row["band"]["p99_factor"] == P99_BAND
+
+
+def test_validate_window_flags_inconsistent_phases(tmp_path):
+    """Client p99 wildly above what the phases can compose: the self-replay
+    must FAIL the band, not rubber-stamp the artifact."""
+    bad = _traced_summary(p99_ms=300.0, mean_ms=150.0)
+    path = _write_window(tmp_path, "bad.jsonl", bad)
+    row = validate_window(path, n_samples=4000, seed=1)
+    assert row["ok"] is False and row["p99_ratio"] < 1.0 / P99_BAND
+
+
+def test_validate_window_wire_mode_gets_wider_band(tmp_path):
+    """Wire-mode windows cannot see client-side connection queueing, so
+    they get the documented wider band: a 3x gap fails phases mode but
+    passes wire mode."""
+    assert WIRE_P99_BAND > P99_BAND
+    wire = _wire_summary(p99_ms=90.0)  # wire dist p99 29ms -> ~3x gap
+    path = _write_window(tmp_path, "wire.jsonl", wire)
+    row = validate_window(path, n_samples=4000, seed=1)
+    assert row["mode"] == "wire"
+    assert row["p99_ratio"] < 1.0 / P99_BAND  # would fail the phases band
+    assert row["ok"] is True                  # inside the wire band
+
+
+def test_validate_windows_aggregates_and_skips_unjudgeable(tmp_path):
+    good = _write_window(tmp_path, "a.jsonl", _traced_summary())
+    bare = _write_window(
+        tmp_path, "b.jsonl",
+        {"kind": "serve_summary", "latency_ms": {}, "n_requests": 0},
+    )
+    rep = validate_windows([good, bare], n_samples=4000, seed=1)
+    assert rep["n_windows"] == 1 and rep["ok"] is True
+    assert rep["rows"][1]["ok"] is None and "note" in rep["rows"][1]
+    assert rep["max_p99_ratio"] >= 1.0  # folded |log ratio|, always >= 1
+
+
+# ---------------------------------------------------------------------------
+# planning sweep
+# ---------------------------------------------------------------------------
+
+
+def test_plan_backends_sweep_monotone_and_answers(tmp_path):
+    path = _write_window(tmp_path, "traced.jsonl", _traced_summary())
+    rep = plan_backends(path, target_rps=300.0, p99_ms=60.0,
+                        max_backends=8, n_samples=3000, seed=2)
+    sweep = rep["sweep"]
+    assert [r["backends"] for r in sweep] == list(range(1, 9))
+    # per-backend load and predicted p99 fall as the fleet grows
+    p99s = [r["predicted_p99_ms"] for r in sweep]
+    assert p99s[0] > p99s[-1]
+    assert all(b["per_backend_rps"] < a["per_backend_rps"]
+               for a, b in zip(sweep, sweep[1:]))
+    k = rep["backends_needed"]
+    assert k is not None
+    # minimality: everything below the answer misses the target
+    for r in sweep:
+        if r["backends"] < k:
+            assert not r["meets_target"]
+    assert sweep[k - 1]["meets_target"] and sweep[k - 1]["stable"]
+    # compute+fetch mean ~12ms -> 1 backend at 300rps is rho ~3.6: unstable
+    assert sweep[0]["stable"] is False
+
+
+def test_plan_backends_exogenous_floor_returns_none(tmp_path):
+    """Adding backends only shrinks queue wait; batch_wait/wire/pick and
+    the residual are an exogenous floor a sweep cannot beat. A target
+    below the floor must answer None, not a fantasy fleet size."""
+    path = _write_window(tmp_path, "traced.jsonl", _traced_summary())
+    rep = plan_backends(path, target_rps=100.0, p99_ms=5.0,
+                        max_backends=4, n_samples=2000, seed=2)
+    assert rep["backends_needed"] is None
+    assert all(not r["meets_target"] for r in rep["sweep"])
+
+
+def test_plan_backends_requires_phases(tmp_path):
+    path = _write_window(tmp_path, "wire.jsonl", _wire_summary())
+    with pytest.raises(ValueError, match="no phase spans"):
+        plan_backends(path, target_rps=50.0, p99_ms=100.0)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_plan_main_exit_codes(tmp_path, capsys):
+    good = _write_window(tmp_path, "good.jsonl", _traced_summary())
+    bad = _write_window(tmp_path, "bad.jsonl",
+                        _traced_summary(p99_ms=300.0, mean_ms=150.0))
+    assert plan_main([]) == 2                      # no trace
+    assert plan_main([f"--trace={good}"]) == 2     # no question asked
+    capsys.readouterr()
+    # validation: all-pass 0, any-fail 3
+    assert plan_main([f"--trace={good}", "--validate", "--seed=1"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["plan_validation"]["ok"] is True
+    assert plan_main([f"--trace={good},{bad}", "--validate", "--seed=1"]) == 3
+    # planning: answered 0, unmeetable 3; --json round-trips
+    outp = tmp_path / "plan.json"
+    rc = plan_main([f"--trace={good}", "--target-rps=300", "--p99-ms=60",
+                    "--seed=2", f"--json={outp}"])
+    capsys.readouterr()
+    assert rc == 0
+    assert json.loads(outp.read_text())["backends_needed"] is not None
+    assert plan_main([f"--trace={good}", "--target-rps=100",
+                      "--p99-ms=5", "--max-backends=2", "--seed=2"]) == 3
+    capsys.readouterr()

@@ -1,0 +1,535 @@
+"""Flight-deck monitoring: reset-safe counter differencing, multi-window
+burn-rate alerting, the scrape loop's discipline + derived events, and the
+timeline renderer (qdml_tpu/telemetry/timeseries.py + burnrate.py).
+
+All host-side — no engine, no sockets: the scraper runs against fake
+pollers with a fake clock, so the windowing/alerting semantics pin exactly
+(the live end-to-end path is scripts/monitor_dryrun.py's committed run).
+"""
+
+from __future__ import annotations
+
+import random
+
+from qdml_tpu.telemetry.burnrate import (
+    BurnAlerter,
+    BurnRateRule,
+    burn_rate,
+    render_timeline,
+)
+from qdml_tpu.telemetry.timeseries import (
+    MonitorScraper,
+    Ring,
+    SnapshotDiff,
+    counter_delta,
+)
+
+
+# ---------------------------------------------------------------------------
+# counter_delta / SnapshotDiff — reset-safe differencing
+# ---------------------------------------------------------------------------
+
+
+def test_counter_delta_basic_and_none():
+    assert counter_delta(10, 15) == (5.0, False)
+    assert counter_delta(None, 7) == (7.0, False)   # first report
+    assert counter_delta(None, None) == (0.0, False)
+    assert counter_delta(3, 3) == (0.0, False)
+
+
+def test_counter_delta_reset_clamps_and_flags():
+    # restart: counter went backwards — window clamps to everything the
+    # reborn counter saw, and the reset is FLAGGED, never a negative rate
+    d, reset = counter_delta(100, 12)
+    assert d == 12.0 and reset is True
+
+
+def test_counter_delta_never_negative_across_random_restarts():
+    """Property: over any monotonic-with-restarts counter trajectory, every
+    window is >= 0 and resets are flagged exactly when the value drops."""
+    rng = random.Random(7)
+    for _trial in range(50):
+        value, prev = 0.0, None
+        for _step in range(200):
+            if rng.random() < 0.07:
+                value = float(rng.randrange(0, 5))  # restart
+            else:
+                value += rng.randrange(0, 20)
+            d, reset = counter_delta(prev, value)
+            assert d >= 0.0
+            assert reset == (prev is not None and value < prev)
+            prev = value
+
+
+def test_snapshot_diff_resets_are_per_name():
+    diff = SnapshotDiff()
+    assert diff.window("a", 10) == (10.0, False)
+    assert diff.window("b", 5) == (5.0, False)
+    # "a" restarts; "b" keeps differencing cleanly
+    assert diff.window("a", 2) == (2.0, True)
+    assert diff.window("b", 9) == (4.0, False)
+    assert diff.window("a", 6) == (4.0, False)  # post-reset windows are clean
+
+
+def test_ring_is_bounded():
+    r = Ring(cap=4)
+    for i in range(10):
+        r.add({"i": i})
+    assert len(r) == 4
+    assert [x["i"] for x in r] == [6, 7, 8, 9]
+    assert r.last() == {"i": 9}
+
+
+# ---------------------------------------------------------------------------
+# burn-rate rules — multi-window, debounce, latch, zero-traffic
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rate_zero_traffic_is_none_not_nan():
+    assert burn_rate(0, 0, 0.01) is None
+    assert burn_rate(5, 0, 0.01) is None          # no eligible traffic
+    assert burn_rate(0, 100, 0.01) == 0.0
+    assert burn_rate(1, 100, 0.01) == 1.0          # spending exactly budget
+    assert burn_rate(2, 100, 0.01) == 2.0
+
+
+def _rule(**kw):
+    kw.setdefault("signal", "slo")
+    kw.setdefault("budget", 0.01)
+    kw.setdefault("fast_s", 2.0)
+    kw.setdefault("slow_s", 6.0)
+    kw.setdefault("threshold", 10.0)
+    kw.setdefault("debounce", 2)
+    return BurnRateRule(**kw)
+
+
+def test_rule_fires_only_when_both_windows_exceed():
+    """A short error spike saturates the fast window but not the slow one:
+    no alert. Sustained errors push BOTH over: alert."""
+    r = _rule()
+    t = 0.0
+    # 6s of healthy traffic fills the slow window with good evidence
+    for _ in range(6):
+        t += 1.0
+        r.feed(t, 0, 100)
+        assert r.evaluate(t) is None
+    # one bad window: the fast window saturates but the slow one is still
+    # diluted by the healthy history
+    t += 1.0
+    r.feed(t, 50, 100)
+    burns = r.burns(t)
+    assert burns["fast"] >= 10.0 and burns["slow"] < 10.0
+    assert r.evaluate(t) is None and r.firing is False
+    # sustained: errors keep coming until the slow window crosses too,
+    # then debounce=2 needs two consecutive over-threshold evaluations
+    fired = None
+    for _ in range(10):
+        t += 1.0
+        r.feed(t, 50, 100)
+        a = r.evaluate(t)
+        if a is not None:
+            fired = a
+            break
+    assert fired is not None and fired["state"] == "firing"
+    assert fired["fast_burn"] >= 10.0 and fired["slow_burn"] >= 10.0
+    assert r.fired_count == 1
+
+
+def test_rule_debounce_requires_consecutive_evidence():
+    r = _rule(fast_s=1.0, slow_s=1.0, debounce=3)
+    t = 0.0
+    # two over-threshold evaluations, then a healthy one: counter resets
+    for _ in range(2):
+        t += 1.0
+        r.feed(t, 50, 100)
+        assert r.evaluate(t) is None
+    t += 1.0
+    r.feed(t, 0, 100)
+    assert r.evaluate(t) is None and r._pending == 0
+    # three consecutive: fires on the third
+    results = []
+    for _ in range(3):
+        t += 1.0
+        r.feed(t, 50, 100)
+        results.append(r.evaluate(t))
+    assert results[:2] == [None, None]
+    assert results[2] is not None and results[2]["state"] == "firing"
+
+
+def test_rule_latches_until_both_windows_recover():
+    r = _rule(fast_s=1.0, slow_s=4.0, debounce=1)
+    t = 0.0
+    for _ in range(4):
+        t += 1.0
+        r.feed(t, 50, 100)
+        if r.evaluate(t) is not None:
+            break
+    assert r.firing
+    # fast window recovers immediately; slow still holds the bad samples —
+    # the alert must stay latched (no resolved transition)
+    t += 1.0
+    r.feed(t, 0, 100)
+    assert r.evaluate(t) is None and r.firing is True
+    # keep feeding healthy windows until the slow window flushes
+    resolved = None
+    for _ in range(8):
+        t += 1.0
+        r.feed(t, 0, 100)
+        a = r.evaluate(t)
+        if a is not None:
+            resolved = a
+            break
+    assert resolved is not None and resolved["state"] == "resolved"
+    assert r.firing is False and r.resolved_count == 1
+
+
+def test_rule_zero_traffic_windows_freeze_state():
+    """An idle window (no eligible traffic) is no evidence either way: it
+    must not advance the debounce, fire, or resolve."""
+    r = _rule(fast_s=1.0, slow_s=1.0, debounce=1)
+    t = 1.0
+    r.feed(t, 0, 0)
+    assert r.evaluate(t) is None and r.firing is False
+    # while firing, zero traffic must not resolve
+    t += 1.0
+    r.feed(t, 50, 100)
+    assert r.evaluate(t)["state"] == "firing"
+    t += 2.0  # past the windows: they now hold nothing
+    assert r.evaluate(t) is None and r.firing is True
+
+
+def test_alerter_for_run_scales_windows_and_slo_budget():
+    a = BurnAlerter.for_run(duration_s=30.0, interval_s=0.5, slo_target=0.95)
+    slo = a.rules["slo"]
+    assert abs(slo.budget - 0.05) < 1e-12
+    assert slo.fast_s >= 1.0 and slo.slow_s >= 3 * slo.fast_s
+    assert slo.slow_s <= 3600.0
+    assert set(a.rules) >= {"slo", "shed", "breaker", "quarantine", "router",
+                            "stranded"}
+    # stamped mark rides every transition
+    r = a.rules["stranded"]
+    t = 0.0
+    fired = []
+    for _ in range(10):
+        t += 1.0
+        a.feed(t, "stranded", 5, 100)
+        fired += a.evaluate(t, mark="fault_seg")
+    assert fired and all(x["mark"] == "fault_seg" for x in fired)
+
+
+# ---------------------------------------------------------------------------
+# the scraper — fake pollers, fake clock
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+class _Sink:
+    active = True
+
+    def __init__(self):
+        self.records = []
+
+    def emit(self, kind, **payload):
+        self.records.append({"kind": kind, **payload})
+
+
+class _ServePoller:
+    """Single-host serve shapes; scripted counter evolution."""
+
+    def __init__(self):
+        self.calls = []
+        self.completed = 0
+        self.slo_n = 0
+        self.slo_met = 0
+        self.start_seq = 111
+        self.uptime = 5.0
+
+    def health(self):
+        self.calls.append("health")
+        return {
+            "warm": True, "replicas": 2, "queue_depth": 1,
+            "quarantined": [], "swap_epoch": 0,
+            "uptime_s": self.uptime, "start_seq": self.start_seq,
+        }
+
+    def metrics(self):
+        self.calls.append("metrics")
+        return {
+            "completed": self.completed,
+            "shed": {}, "faults": {}, "restarts": 0,
+            "slo": {"n": self.slo_n, "met": self.slo_met},
+            "breaker": {"state": "closed", "fast_fails": 0,
+                        "admitted": self.completed},
+        }
+
+
+def test_scraper_uses_only_observability_verbs_and_windows_rates():
+    clk, sink, p = _Clock(), _Sink(), _ServePoller()
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=clk)
+    p.completed, p.slo_n, p.slo_met = 100, 50, 50
+    assert s.scrape_once()["dt_s"] is None  # first window has no width
+    clk.t += 2.0
+    p.completed, p.slo_n, p.slo_met = 160, 80, 78
+    p.uptime += 2.0
+    rec = s.scrape_once()
+    # scrape discipline: health + metrics only, never an inference verb
+    assert set(p.calls) == {"health", "metrics"}
+    # windowed, not lifetime: 60 completions over 2s
+    assert rec["completed"] == 60.0 and rec["rps"] == 30.0
+    assert rec["slo"] == {"n": 30.0, "met": 28.0, "attainment": 0.9333}
+    kinds = {r["kind"] for r in sink.records}
+    assert "monitor_timeseries" in kinds and "counter_reset" not in kinds
+
+
+def test_scraper_restart_emits_reset_and_event_never_negative():
+    clk, sink, p = _Clock(), _Sink(), _ServePoller()
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=clk)
+    p.completed, p.slo_n, p.slo_met = 500, 400, 400
+    s.scrape_once()
+    # process restart: counters start over, construction epoch changes
+    clk.t += 1.0
+    p.completed, p.slo_n, p.slo_met = 30, 20, 20
+    p.start_seq, p.uptime = 222, 0.4
+    rec = s.scrape_once()
+    assert rec["completed"] == 30.0 and rec["rps"] >= 0.0
+    assert "completed" in rec["resets"]
+    resets = [r for r in sink.records if r["kind"] == "counter_reset"]
+    assert {r["counter"] for r in resets} >= {"completed", "slo_n", "slo_met"}
+    events = [r for r in sink.records if r["kind"] == "monitor_event"]
+    assert any(e.get("event") == "backend_restart" for e in events)
+    assert s.resets_total == len(resets)
+
+
+def test_scraper_survives_poller_failure_as_scrape_error():
+    class _Dead:
+        def health(self):
+            raise ConnectionRefusedError("down")
+
+        def metrics(self):  # pragma: no cover - never reached
+            return {}
+
+    clk, sink = _Clock(), _Sink()
+    s = MonitorScraper(_Dead(), sink=sink, interval_s=1.0, clock=clk)
+    assert s.scrape_once() is None
+    assert s.scrape_errors == 1
+    evs = [r for r in sink.records if r["kind"] == "monitor_event"]
+    assert any(e.get("event") == "scrape_error" for e in evs)
+
+
+class _RouterPoller:
+    """Fleet shapes: per-backend rows + router aggregation."""
+
+    def __init__(self):
+        self.forwarded = 0
+        self.failed = 0
+        self.failovers = 0
+        self.ejections = 0
+        self.seqs = {"b0": 1, "b1": 2}
+
+    def health(self):
+        return {
+            "fleet": True, "backends": 2,
+            "backends_live": 2 - (1 if self.ejections else 0),
+            "queue_depth": 0, "replicas": 2, "swap_epoch": 0,
+            "router": {
+                "forwarded": self.forwarded,
+                "failed_forwards": self.failed,
+                "failovers": self.failovers,
+                "ejections": self.ejections, "readmissions": 0,
+            },
+            "per_backend": {
+                b: {"poll_ok": True, "start_seq": seq, "uptime_s": 9.0}
+                for b, seq in self.seqs.items()
+            },
+        }
+
+    def metrics(self):
+        return {
+            "completed": self.forwarded, "shed": {}, "faults": {},
+            "restarts": 0, "slo": {"n": self.forwarded,
+                                   "met": self.forwarded - self.failed},
+            "per_backend": {},
+        }
+
+
+def test_scraper_router_signal_alerts_during_fault_segment_only():
+    """The dryrun's paging path in miniature: healthy windows under
+    'baseline' never alert; a sustained failover storm under 'fault' fires
+    the router burn alert, tagged with the segment mark."""
+    clk, sink, p = _Clock(), _Sink(), _RouterPoller()
+    alerter = BurnAlerter(
+        {"router": BurnRateRule("router", 0.02, fast_s=2.0, slow_s=6.0,
+                                threshold=8.0, debounce=2)}
+    )
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, alerter=alerter,
+                       clock=clk)
+    s.mark("baseline")
+    for _ in range(8):
+        clk.t += 1.0
+        p.forwarded += 50
+        s.scrape_once()
+    assert len(s.alerts) == 0
+    s.mark("fault")
+    fired = []
+    for _ in range(10):
+        clk.t += 1.0
+        p.forwarded += 50
+        p.failed += 20
+        p.failovers += 5
+        rec = s.scrape_once()
+        if rec["alerts"]:
+            fired.append(rec)
+    assert fired, "router burn alert must fire during the fault segment"
+    alerts = [r for r in sink.records if r["kind"] == "monitor_alert"]
+    assert alerts[0]["signal"] == "router" and alerts[0]["mark"] == "fault"
+    summ = s.summary()
+    assert summ["alerts"]["by_mark"].get("fault", 0) >= 1
+    assert summ["alerts"]["by_mark"].get("baseline", 0) == 0
+    assert summ["peak_burn"]["router"]["fast"] >= 8.0
+
+
+def test_scraper_derives_ejection_event_from_router_counters():
+    clk, sink, p = _Clock(), _Sink(), _RouterPoller()
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=clk)
+    s.scrape_once()
+    clk.t += 1.0
+    p.ejections = 1
+    s.scrape_once()
+    evs = [r for r in sink.records if r["kind"] == "monitor_event"]
+    assert any(e.get("event") == "backend_ejected" for e in evs)
+
+
+def test_scraper_detects_per_backend_restart_by_start_seq():
+    clk, sink, p = _Clock(), _Sink(), _RouterPoller()
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=clk)
+    s.scrape_once()
+    clk.t += 1.0
+    p.seqs["b1"] = 99  # backend b1 restarted; b0 did not
+    s.scrape_once()
+    restarts = [
+        r for r in sink.records
+        if r["kind"] == "monitor_event" and r.get("event") == "backend_restart"
+    ]
+    assert [r["backend"] for r in restarts] == ["b1"]
+
+
+# ---------------------------------------------------------------------------
+# control-loop windowing (satellite: reset-safe differencing in the
+# FleetController's detector feeds)
+# ---------------------------------------------------------------------------
+
+
+class _ObsMonitor:
+    def __init__(self):
+        self.observed = []
+
+    def observe(self, scenario, metric, value):
+        self.observed.append((scenario, metric, value))
+        return None
+
+
+def _bare_controller():
+    from qdml_tpu.control.loop import FleetController
+
+    ctl = FleetController.__new__(FleetController)
+    ctl.monitor = _ObsMonitor()
+    ctl.min_window = 1
+    ctl._prev_scenario = {}
+    ctl._prev_dispatch = {}
+    ctl._prev_slo = None
+    ctl._sink = _Sink()
+    return ctl
+
+
+def test_control_windowed_slo_reset_returns_none_and_reports():
+    ctl = _bare_controller()
+    assert ctl._windowed_slo({"n": 100, "met": 99}) == 0.99
+    assert ctl._windowed_slo({"n": 150, "met": 148}) == 0.98
+    # server restarted: cumulative counters went backwards — a naive
+    # difference would be a NEGATIVE attainment; the reset-safe path
+    # reports a counter_reset and yields no reading for this window
+    got = ctl._windowed_slo({"n": 40, "met": 39})
+    assert got is None
+    resets = [r for r in ctl._sink.records if r.get("name") == "counter_reset"]
+    assert resets and resets[0]["counter"] == "slo.n"
+    # next window differences cleanly from the post-restart snapshot
+    assert ctl._windowed_slo({"n": 80, "met": 79}) == 1.0
+
+
+def test_control_window_scenarios_skips_detector_feed_on_reset():
+    ctl = _bare_controller()
+    ctl._window_scenarios(
+        {"per_scenario": {"0": {"n": 100, "conf_sum": 90.0}}}
+    )
+    ctl._window_scenarios(
+        {"per_scenario": {"0": {"n": 200, "conf_sum": 185.0}}}
+    )
+    assert ctl.monitor.observed[-1] == (0, "confidence", 0.95)
+    n_obs = len(ctl.monitor.observed)
+    # restart: n drops — the detector must NOT be fed a fabricated mean
+    ctl._window_scenarios(
+        {"per_scenario": {"0": {"n": 10, "conf_sum": 9.0}}}
+    )
+    assert len(ctl.monitor.observed) == n_obs
+    resets = [r for r in ctl._sink.records if r.get("name") == "counter_reset"]
+    assert resets and "per_scenario[0].n" in resets[0]["counter"]
+    # windows never negative in the observe stream
+    assert all(v >= 0 for _, _, v in ctl.monitor.observed)
+
+
+# ---------------------------------------------------------------------------
+# timeline rendering
+# ---------------------------------------------------------------------------
+
+
+def test_render_timeline_correlates_alerts_with_stack_events():
+    records = [
+        {"kind": "manifest", "argv": ["monitor"], "ts": 1000.0},
+        {"kind": "monitor_timeseries", "ts": 1001.0, "t_s": 1.0, "seq": 1,
+         "mark": "baseline", "rps": 50.0, "slo": {"n": 50, "met": 50},
+         "queue_depth": 0, "replicas": 2, "backends_live": 2,
+         "burn": {"slo": {"fast": 0.0, "slow": 0.0}}},
+        {"kind": "monitor_event", "event": "backend_restart",
+         "backend": "b1", "t_s": 1.6, "mark": "fault"},
+        {"kind": "monitor_timeseries", "ts": 1002.0, "t_s": 2.0, "seq": 2,
+         "mark": "fault", "rps": 20.0, "slo": {"n": 40, "met": 20},
+         "queue_depth": 7, "replicas": 2, "backends_live": 1,
+         "burn": {"slo": {"fast": 50.0, "slow": 12.0},
+                  "router": {"fast": 30.0, "slow": 9.0}}},
+        {"kind": "monitor_alert", "signal": "router", "state": "firing",
+         "t_s": 2.0, "mark": "fault", "fast_burn": 30.0, "slow_burn": 9.0,
+         "threshold": 8.0, "budget": 0.02, "fast_s": 2.0, "slow_s": 6.0},
+        {"kind": "monitor_summary", "windows": 2, "duration_s": 2.0,
+         "interval_s": 1.0, "scrape_errors": 0, "counter_resets": 1,
+         "alerts": {"fired": 1, "resolved": 0,
+                    "by_mark": {"fault": 1}, "by_signal": {"router": 1}},
+         "peak_burn": {"router": {"fast": 30.0, "slow": 9.0}},
+         "planner": {"ok": True, "n_windows": 3, "max_p99_ratio": 1.4,
+                     "max_rps_err": 0.05}},
+    ]
+    # a sibling stack stream's event (kind=counters) merges by wall clock:
+    # ts 1001.7 -> t_s 0.7 after the manifest offset... offset comes from
+    # the first window (ts 1001 at t_s 1.0), so 1001.7 maps to t_s 1.7
+    stack = [
+        {"kind": "counters", "name": "replica_restarted", "ts": 1001.7,
+         "replica": "serve-replica-0"},
+        {"kind": "counters", "name": "loss", "ts": 1001.8},  # not an event
+    ]
+    md = render_timeline(records, extra_events=stack)
+    assert "**ALERT router**" in md
+    assert "backend_restart(b1)" in md
+    assert "replica_restarted(serve-replica-0)" in md
+    assert "loss" not in md
+    # the firing alert lists the events inside its fast window
+    assert "correlated events" in md
+    assert "router FIRING" in md
+    assert "capacity-planner validation: PASS" in md
+    # segment marks label their windows
+    assert "| baseline |" in md and "| fault |" in md
