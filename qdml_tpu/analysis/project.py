@@ -105,13 +105,34 @@ LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
             "_latency": "_mlock",
             "_forwarded": "_mlock",
             "_failed": "_mlock",
+            # in-flight forward count: incremented by request executors,
+            # read by the retirement drain wait — an unlocked read could
+            # terminate a backend with a forward still on the wire
+            "_inflight": "_mlock",
             "_clients": "_clients_lock",
             "_made": "_clients_lock",
         },
         "RouterDedup": {"_entries": "_lock"},
         # traced-request net-wire histogram: fed by every request executor
-        # thread that traced a forward, read by the metrics aggregation
-        "FleetRouter": {"_trace_wire": "_trace_lock"},
+        # thread that traced a forward, read by the metrics aggregation;
+        # the consistent-hash ring + member table are REPLACED (never
+        # mutated) under _ring_lock on admission/retirement while every
+        # request thread snapshots them — an unlocked swap could hand a
+        # reader a ring indexed against the wrong member list
+        "FleetRouter": {
+            "_trace_wire": "_trace_lock",
+            "_ring": "_ring_lock",
+            "_ring_idx": "_ring_lock",
+        },
+    },
+    # elastic-fleet lifecycle state (docs/FLEET.md "elastic fleet"): the
+    # member/process tables are written by scale operations (controller
+    # thread) while status() serves concurrent front-door reads
+    "qdml_tpu/fleet/lifecycle.py": {
+        "BackendLifecycle": {
+            "_members": "_lock",
+            "_procs": "_lock",
+        },
     },
     # fleet-control shared state (docs/CONTROL.md): the controller tick
     # thread writes these while status/report paths read them
@@ -122,6 +143,10 @@ LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
     "qdml_tpu/control/autoscale.py": {
         # the autoscaler's current target replica count (hysteresis state)
         "Autoscaler": {"_target": "_lock"},
+    },
+    "qdml_tpu/control/fleet_scale.py": {
+        # fleet-tier twin: target backend count + streaks + planner pin
+        "FleetAutoscaler": {"_target": "_lock", "_planner": "_lock"},
     },
     "qdml_tpu/control/deploy.py": {
         # the post-deploy rollback watch window

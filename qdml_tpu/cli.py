@@ -76,11 +76,22 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
                                   # [--events=stack.jsonl] renders the
                                   # correlated event timeline
     python -m qdml_tpu.cli plan   --trace=W.jsonl[,..] (--validate |
-                                  --target-rps=X --p99-ms=Y)
+                                  --target-rps=X --p99-ms=Y
+                                  [--emit-target=T.json])
                                   # trace-replay capacity planner: DES of
                                   # the batcher->engine->fetch pipeline from
                                   # committed phase spans; --validate gates
-                                  # predicted-vs-measured p99/throughput
+                                  # predicted-vs-measured p99/throughput;
+                                  # --emit-target writes the sealed fleet
+                                  # target the fleet autoscaler consumes
+    python -m qdml_tpu.cli fleet-scale --addr=HOST:PORT [--backends=N]
+                                  # elastic-fleet lever (docs/FLEET.md):
+                                  # {"op": "fleet"} against a RUNNING router
+                                  # — status form without --backends, else
+                                  # spawn-and-warm/drain-then-retire to N
+                                  # via the router's lifecycle manager
+                                  # (fleet.elastic=true); exit 3 when the
+                                  # fleet did not converge
 
 Every command's metrics JSONL starts with a run-manifest header (config hash,
 git SHA, device topology, perf knobs, seeds) and carries span/counter records
@@ -144,6 +155,48 @@ def _workdir(cfg) -> str:
     return os.path.join(cfg.train.workdir, f"Pn_{cfg.data.pilot_num}", cfg.name)
 
 
+def fleet_scale_main(argv: list[str]) -> int:
+    """``qdml-tpu fleet-scale --addr=HOST:PORT [--backends=N]
+    [--timeout-s=S]``: the ``{"op": "fleet"}`` verb from the shell. Without
+    ``--backends`` prints the membership/lifecycle status (always answers);
+    with it, asks the router's lifecycle manager to converge the serving
+    backend count — spawn-and-warm admissions and drain-then-retire
+    removals, which can take minutes (``--timeout-s`` defaults to 900).
+    Exit 0 on success/status, 3 when the fleet did not converge (typed
+    reason printed), 2 on usage errors."""
+    import json
+
+    from qdml_tpu.serve.client import ServeClient, ServeClientError
+
+    def arg(name, default):
+        return next(
+            (a.split("=", 1)[1] for a in argv if a.startswith(f"--{name}=")),
+            default,
+        )
+
+    addr = arg("addr", None)
+    if not addr or ":" not in addr:
+        print("fleet-scale needs --addr=HOST:PORT (a running qdml-tpu route)")
+        return 2
+    host, port = addr.rsplit(":", 1)
+    backends = arg("backends", None)
+    timeout_s = float(arg("timeout-s", "900"))
+    client = ServeClient(host, int(port), timeout_s=timeout_s, retries=0)
+    try:
+        rep = client.fleet(
+            backends=None if backends is None else int(backends)
+        )
+    except (ServeClientError, ConnectionError, OSError) as e:
+        print(json.dumps({"ok": False, "reason": f"{type(e).__name__}: {e}"}))
+        return 3
+    finally:
+        client.close_connection()
+    # rep is the full wire reply: ok carries the convergence verdict for
+    # the scaling form (and the typed fleet_scale_unavailable refusal)
+    print(json.dumps(rep, indent=2))
+    return 0 if rep.get("ok") else 3
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
@@ -174,6 +227,11 @@ def main(argv: list[str] | None = None) -> int:
         from qdml_tpu.telemetry.capacity import plan_main
 
         return plan_main(argv[1:])
+    if argv[0] == "fleet-scale":
+        # Host-side elastic-fleet lever: one {"op": "fleet"} exchange with
+        # a RUNNING router — no jax, no config parsing, the router's
+        # lifecycle manager does the spawning (docs/FLEET.md).
+        return fleet_scale_main(argv[1:])
     # Make JAX_PLATFORMS=cpu actually select the CPU backend (the plugin
     # rewrites jax_platforms at interpreter start; qdml_tpu.utils.platform
     # is the single home for the workaround).

@@ -427,6 +427,27 @@ class FleetConfig:
     # reuses serve.conn_timeout_s / serve.max_line_bytes).
     host: str = "127.0.0.1"
     port: int = 8378
+    # -- elastic membership (fleet/lifecycle.py, docs/FLEET.md) --------------
+    # Attach a BackendLifecycle to `qdml-tpu route`, arming the
+    # {"op": "fleet", "backends": N} scaling form (spawn-and-warm admission,
+    # drain-then-retire). Off by default: a fixed hand-started backend set
+    # answers the scaling form with the typed fleet_scale_unavailable reason.
+    elastic: bool = False
+    # Comma-separated dotted-config flags every SPAWNED backend gets
+    # ("--train.workdir=/ckpts,--serve.workers=2"): the spawned process must
+    # restore the same checkpoints the boot-time fleet serves.
+    spawn_overrides: str = ""
+    # Spawn-and-warm deadline: banner + AOT warmup + autotune must complete
+    # within this, or the standby is quarantined.
+    spawn_timeout_s: float = 600.0
+    # Retirement drain: how long a draining host may take to finish its
+    # in-flight forwards before removal proceeds (stranded forwards are
+    # reported — the dryrun gates on zero).
+    drain_wait_s: float = 30.0
+    # After removal, how long the retiring process stays alive for any
+    # DIRECT-connected client's server-side dedup window before SIGINT
+    # (router-mediated retries re-attach router-side regardless).
+    dedup_grace_s: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -487,6 +508,22 @@ class ControlConfig:
     queue_low: float = 2.0
     scale_debounce: int = 2
     cooldown_ticks: int = 3
+    # -- fleet autoscaler (control/fleet_scale.py, docs/FLEET.md) ------------
+    # The backend-COUNT axis, mirroring the replica autoscaler's hysteresis
+    # discipline one tier up: sustained fleet-total queue depth above
+    # fleet_queue_high for fleet_debounce consecutive ticks admits one warmed
+    # backend (<= max_backends); below fleet_queue_low with healthy SLO
+    # retires one (>= min_backends); fleet_cooldown_ticks between actions
+    # (spawn-and-warm is seconds-to-minutes — the cooldown must outlast it).
+    # A planner target (plan --emit-target JSON) overrides the watermark
+    # policy when loaded. Requires a lifecycle-armed poller (fleet.elastic).
+    fleet_autoscale: bool = False
+    min_backends: int = 1
+    max_backends: int = 4
+    fleet_queue_high: float = 32.0
+    fleet_queue_low: float = 2.0
+    fleet_debounce: int = 2
+    fleet_cooldown_ticks: int = 5
 
 
 @dataclass(frozen=True)

@@ -127,6 +127,17 @@ class SocketPoller:
     def scale(self, n: int) -> dict:
         return self._verb({"op": "scale", "replicas": n})["scale"]
 
+    def fleet(self, backends: int | None = None) -> dict:
+        """Backend-count axis (router endpoints): membership status, or —
+        with ``backends`` — converge the serving member count through the
+        router's lifecycle manager. A plain serve host answers the status
+        form with ``bad_request`` and a lifecycle-less router answers the
+        scaling form with ``fleet_scale_unavailable``; both surface here as
+        the typed RuntimeError ``_verb`` raises on ok=false."""
+        if backends is None:
+            return self._verb({"op": "fleet"})["fleet"]
+        return self._verb({"op": "fleet", "backends": int(backends)})["fleet"]
+
 
 class FleetController:
     """The loop. Construct with a poller, call :meth:`tick` (or :meth:`run`);

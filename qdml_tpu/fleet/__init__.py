@@ -17,13 +17,24 @@ still terminated every connection. This package is the missing tier:
   attachment, so drift adaptation, canary-gated tagged hot-swap and
   queue-depth autoscaling (now choosing WHICH host) span the fleet;
 - :mod:`~qdml_tpu.fleet.spawn` — real ``qdml-tpu serve`` subprocess
-  harness for the committed dryrun (scripts/fleet_router_dryrun.py).
+  harness for the committed dryrun (scripts/fleet_router_dryrun.py);
+- :class:`~qdml_tpu.fleet.lifecycle.BackendLifecycle` — elastic
+  membership: spawn-and-warm admission (a cold backend is never admitted),
+  ring-safe drain-then-retire, the ``{"op": "fleet"}`` /
+  ``qdml-tpu fleet-scale`` lever the fleet autoscaler drives
+  (docs/FLEET.md "elastic fleet").
 """
 
 from qdml_tpu.fleet.frontend import (  # noqa: F401
+    lifecycle_from_config,
     route_async,
     router_from_config,
     run_router,
+)
+from qdml_tpu.fleet.lifecycle import (  # noqa: F401
+    AdmissionFailed,
+    BackendLifecycle,
+    verify_warm,
 )
 from qdml_tpu.fleet.poller import FleetPoller  # noqa: F401
 from qdml_tpu.fleet.router import (  # noqa: F401

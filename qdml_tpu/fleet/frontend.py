@@ -10,6 +10,16 @@ a router from a single host, which is the point: ``run_loadgen_socket``,
 ``ServeClient``, the fleet controller's ``SocketPoller`` and a human with
 ``nc`` all work unchanged.
 
+The two scaling axes (docs/FLEET.md "elastic fleet"): ``{"op": "scale",
+"replicas": N}`` targets the fleet-total REPLICA count inside the existing
+hosts (the router picks which host to resize — replica axis), while
+``{"op": "fleet", "backends": N}`` changes the BACKEND-PROCESS count
+itself through the attached :class:`~qdml_tpu.fleet.lifecycle.
+BackendLifecycle` (spawn-and-warm admission, drain-then-retire). A router
+without a lifecycle manager answers the scaling form with the typed
+``fleet_scale_unavailable`` reason; the argument-free ``{"op": "fleet"}``
+status form always answers with the membership/lifecycle view.
+
 Connection hardening is the serve front-end's, reused verbatim: bounded
 reads through :func:`qdml_tpu.serve.server._read_line` (idle/slow-loris
 reap with a typed ``idle_timeout`` reply), ``bad_json`` on garbage with the
@@ -53,7 +63,8 @@ def router_from_config(cfg: ExperimentConfig, seed: int = 0) -> FleetRouter:
 
 
 async def _handle_front(
-    reader, writer, router: FleetRouter, conn_timeout_s: float
+    reader, writer, router: FleetRouter, conn_timeout_s: float,
+    lifecycle=None,
 ) -> None:
     aloop = asyncio.get_running_loop()
 
@@ -114,9 +125,52 @@ async def _handle_front(
                     if not rec["ok"]:
                         rep["reason"] = "swap_failed: partial fan-out (see swap.backends)"
                 elif op == "scale":
+                    # replica axis: resize pools INSIDE the existing hosts
                     n = int(msg["replicas"])
                     rec = await aloop.run_in_executor(None, router.scale_fleet, n)
                     rep = {"id": msg.get("id"), "ok": True, "scale": rec}
+                elif op == "fleet":
+                    # backend-count axis: membership itself. Status form
+                    # (no "backends") always answers; the scaling form
+                    # needs an attached lifecycle manager.
+                    if "backends" not in msg:
+                        status = (
+                            lifecycle.status() if lifecycle is not None
+                            else {
+                                "backends": len(router.live_backends()),
+                                "backends_draining": sum(
+                                    1 for b in router.backends if b.draining
+                                ),
+                                "fleet": {
+                                    b.host_id: {
+                                        "addr": b.addr,
+                                        **router.state_row(b),
+                                    }
+                                    for b in router.backends
+                                },
+                            }
+                        )
+                        status["elastic"] = lifecycle is not None
+                        rep = {"id": msg.get("id"), "ok": True, "fleet": status}
+                    elif lifecycle is None:
+                        rep = {
+                            "id": msg.get("id"), "ok": False,
+                            "reason": "fleet_scale_unavailable: router has "
+                                      "no lifecycle manager (fleet.elastic)",
+                        }
+                    else:
+                        n = int(msg["backends"])
+                        rec = await aloop.run_in_executor(
+                            None, lifecycle.scale_to, n
+                        )
+                        rep = {"id": msg.get("id"), "ok": bool(rec["ok"]),
+                               "fleet": rec}
+                        if not rec["ok"]:
+                            rep["reason"] = (
+                                "fleet_scale_failed: converged to "
+                                f"{rec['backends']} of {rec['target']} "
+                                "(see fleet.actions)"
+                            )
                 else:
                     # inference: the router needs an id for dedup + hash
                     # affinity; an anonymous request gets a fresh one for
@@ -152,11 +206,16 @@ async def route_async(
     ready: "asyncio.Future | None" = None,
     conn_timeout_s: float = 30.0,
     max_line_bytes: int = 8_388_608,
+    lifecycle=None,
 ) -> None:
     """Accept front-door connections until cancelled; resolves ``ready``
-    with the bound port (port=0 = ephemeral, the test/dryrun pattern)."""
+    with the bound port (port=0 = ephemeral, the test/dryrun pattern).
+    ``lifecycle`` (a :class:`~qdml_tpu.fleet.lifecycle.BackendLifecycle`)
+    arms the ``{"op": "fleet"}`` scaling form."""
     server = await asyncio.start_server(
-        lambda r, w: _handle_front(r, w, router, conn_timeout_s),
+        lambda r, w: _handle_front(
+            r, w, router, conn_timeout_s, lifecycle=lifecycle
+        ),
         host=host,
         port=port,
         limit=max_line_bytes,
@@ -168,12 +227,35 @@ async def route_async(
         await server.serve_forever()
 
 
+def lifecycle_from_config(cfg: ExperimentConfig, router: FleetRouter):
+    """The ``fleet.elastic`` wiring: a :class:`BackendLifecycle` whose
+    spawned backends get ``fleet.spawn_overrides`` (comma-separated dotted
+    flags — ``--train.workdir=...`` included so they restore the serving
+    checkpoints). Returns None when elasticity is off."""
+    if not cfg.fleet.elastic:
+        return None
+    from qdml_tpu.fleet.lifecycle import BackendLifecycle
+
+    overrides = [
+        o.strip() for o in cfg.fleet.spawn_overrides.split(",") if o.strip()
+    ]
+    return BackendLifecycle(
+        router,
+        spawn_overrides=overrides,
+        spawn_timeout_s=cfg.fleet.spawn_timeout_s,
+        drain_wait_s=cfg.fleet.drain_wait_s,
+        dedup_grace_s=cfg.fleet.dedup_grace_s,
+    )
+
+
 def run_router(cfg: ExperimentConfig, logger=None) -> None:
     """Blocking entry for ``qdml-tpu route``: prime the backend table,
     announce (actual bound port + router identity + backend table), route
     until interrupted. No checkpoints, no jax compute — the router is pure
-    protocol; backends own the models."""
+    protocol; backends own the models. ``fleet.elastic=true`` attaches a
+    lifecycle manager, arming the ``{"op": "fleet"}`` scaling form."""
     router = router_from_config(cfg).start()
+    lifecycle = lifecycle_from_config(cfg, router)
 
     async def _route_announced() -> None:
         aloop = asyncio.get_running_loop()
@@ -183,6 +265,7 @@ def run_router(cfg: ExperimentConfig, logger=None) -> None:
                 router, cfg.fleet.host, cfg.fleet.port, ready,
                 conn_timeout_s=cfg.serve.conn_timeout_s,
                 max_line_bytes=cfg.serve.max_line_bytes,
+                lifecycle=lifecycle,
             )
         )
         await asyncio.wait({task, ready}, return_when=asyncio.FIRST_COMPLETED)
@@ -194,6 +277,7 @@ def run_router(cfg: ExperimentConfig, logger=None) -> None:
                     "routing": f"{cfg.fleet.host}:{ready.result()}",
                     "router_id": f"{socket.gethostname()}-{os.getpid()}",
                     "balance": router.balance,
+                    "elastic": lifecycle is not None,
                     "backends": {
                         b.host_id: {"addr": b.addr, "state": b.state.state}
                         for b in router.backends
@@ -210,6 +294,8 @@ def run_router(cfg: ExperimentConfig, logger=None) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if lifecycle is not None:
+            lifecycle.close()
         router.stop()
         if logger is not None:
             logger.telemetry.write_raw(

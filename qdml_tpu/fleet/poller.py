@@ -33,10 +33,14 @@ from qdml_tpu.fleet.router import FleetRouter
 
 
 class FleetPoller:
-    """In-process controller attachment to a running :class:`FleetRouter`."""
+    """In-process controller attachment to a running :class:`FleetRouter`.
+    ``lifecycle`` (a :class:`~qdml_tpu.fleet.lifecycle.BackendLifecycle`)
+    arms :meth:`fleet` — the backend-COUNT axis, distinct from
+    :meth:`scale`'s replica axis (docs/FLEET.md "elastic fleet")."""
 
-    def __init__(self, router: FleetRouter):
+    def __init__(self, router: FleetRouter, lifecycle=None):
         self.router = router
+        self.lifecycle = lifecycle
 
     def metrics(self) -> dict:
         """The aggregated fleet view (summed counters + per-backend rows) —
@@ -62,7 +66,27 @@ class FleetPoller:
         return rec
 
     def scale(self, n: int) -> dict:
+        """Replica axis: fleet-total replica target, router picks the host."""
         return self.router.scale_fleet(n)
+
+    def fleet(self, backends: int | None = None) -> dict:
+        """Backend-count axis: membership status, or (with ``backends``)
+        converge the serving member count through the lifecycle manager —
+        the same facts the front door's ``{"op": "fleet"}`` verb serves."""
+        if backends is None:
+            if self.lifecycle is not None:
+                return self.lifecycle.status()
+            return {
+                "backends": len(self.router.live_backends()),
+                "backends_draining": sum(
+                    1 for b in self.router.backends if b.draining
+                ),
+            }
+        if self.lifecycle is None:
+            raise RuntimeError(
+                "fleet_scale_unavailable: poller has no lifecycle manager"
+            )
+        return self.lifecycle.scale_to(int(backends))
 
     @staticmethod
     def remote(host: str, port: int, timeout_s: float = 30.0):

@@ -28,6 +28,17 @@ router serves is the backend verb fanned out or aggregated:
   against the polled per-host replica counts and grows the deepest-queue
   host / shrinks the shallowest-queue host one replica at a time (the
   autoscaler's "which host" decision, docs/CONTROL.md).
+- **membership** — elastic: :meth:`FleetRouter.add_backend` splices a
+  WARMED host into the consistent-hash ring (the lifecycle manager in
+  fleet/lifecycle.py verifies warm=true + zero request-path compiles
+  before ever calling it), and :meth:`FleetRouter.retire_backend` is
+  drain-then-remove: the victim's vnodes leave the ring first (fresh
+  requests stop hashing to it), in-flight forwards complete, then the
+  host leaves the table. Ring points are keyed on the STABLE backend
+  address, so a resize moves ONLY the added/removed host's arcs (~1/N of
+  the id space) and every surviving host keeps its keys — the property
+  that lets server-side dedup windows and in-flight retries survive a
+  membership change (pinned in tests/test_fleet_elastic.py).
 - **metrics / health** — aggregation: counters (completed, sheds, SLO
   n/met, per-scenario prediction counts and confidence SUMS, dispatch row
   ledgers, compile-cache counters) SUM exactly across hosts — the fleet
@@ -77,6 +88,20 @@ def _emit_event(name: str, **fields) -> None:
 
 def _hash_point(key: str) -> int:
     return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+def _ring_points(backends: list) -> tuple[list[int], list[int]]:
+    """(sorted ring points, parallel backend-index list) over the non-
+    draining members. Points are keyed on the stable address, so a host
+    contributes the SAME points in every rebuild — membership changes move
+    only the changed host's arcs (the bounded-key-movement property)."""
+    points = sorted(
+        (_hash_point(f"{b.addr}#{v}"), i)
+        for i, b in enumerate(backends)
+        if not b.draining
+        for v in range(_RING_VNODES)
+    )
+    return [p for p, _ in points], [i for _, i in points]
 
 
 def parse_backends(spec: str, default: tuple[str, int] | None = None) -> list[tuple[str, int]]:
@@ -221,6 +246,11 @@ class Backend:
         # until the backend has answered once
         self.host_id: str = self.addr
         self.listen: str | None = None
+        # draining flag (docs/FLEET.md "elastic fleet"): set by the router's
+        # retirement path AFTER the host's vnodes leave the ring — readers
+        # (poll rows, balancing, fan-outs) see it as a typed "draining"
+        # state; plain bool, replaced atomically, never mutated in place
+        self.draining: bool = False
         # health-poll cache (single-writer poll thread, newest-wins reads)
         self.queue_depth: int = 0
         self.replicas: int = 0
@@ -238,6 +268,9 @@ class Backend:
         self._latency = Histogram()
         self._forwarded = 0
         self._failed = 0
+        # forwards currently on the wire to this host — the retirement
+        # drain's "in-flight reaches zero" condition reads it
+        self._inflight = 0
         # connection pool (LIFO: reuse the warmest socket first)
         self._clients: list[ServeClient] = []
         self._clients_lock = threading.Lock()
@@ -274,6 +307,8 @@ class Backend:
         wire-latency and forward accounting. Transport failures propagate
         (the router's failover loop owns record_failure/record_success)."""
         client = self._borrow()
+        with self._mlock:
+            self._inflight += 1
         t0 = time.perf_counter()
         try:
             rep = client.call(
@@ -283,13 +318,20 @@ class Backend:
         except BaseException:
             with self._mlock:
                 self._failed += 1
+                self._inflight -= 1
             self._restore(client)
             raise
         with self._mlock:
             self._forwarded += 1
+            self._inflight -= 1
             self._latency.add(time.perf_counter() - t0)
         self._restore(client)
         return rep
+
+    def inflight(self) -> int:
+        """Forwards currently on the wire to this host (the drain gate)."""
+        with self._mlock:
+            return self._inflight
 
     def wire_metrics(self) -> tuple[Histogram, int, int]:
         """(latency histogram copy, forwarded, failed) under the lock — the
@@ -305,7 +347,7 @@ class Backend:
         age = None if not self.last_poll_ts else round(
             time.monotonic() - self.last_poll_ts, 4
         )
-        return {
+        row = {
             "host_id": self.host_id,
             "addr": self.addr,
             "listen": self.listen,
@@ -318,6 +360,12 @@ class Backend:
             "poll_age_s": age,
             **self.state.summary(),
         }
+        if self.draining:
+            # the typed retirement state (docs/FLEET.md "elastic fleet"):
+            # off the ring, finishing in-flight work — distinct from an
+            # ejection (which is involuntary and re-admits)
+            row["state"] = "draining"
+        return row
 
 
 class RouterDedup:
@@ -465,14 +513,19 @@ class FleetRouter:
         # Every router span is measured on the router's own clock around its
         # own send->reply exchange; backend clocks are never read.
         self.trace_sample = float(trace_sample)
+        # per-backend construction knobs, kept so an elastically ADDED host
+        # gets the same contract as the boot-time set
+        self._backend_opts = dict(
+            timeout_s=timeout_s, retries=retries,
+            eject_failures=eject_failures, eject_s=eject_s,
+            readmit_probes=readmit_probes, clock=clock,
+        )
+        self._seed = int(seed)
         self.backends = [
-            Backend(
-                h, p, timeout_s=timeout_s, retries=retries,
-                eject_failures=eject_failures, eject_s=eject_s,
-                readmit_probes=readmit_probes, seed=seed + i, clock=clock,
-            )
+            Backend(h, p, seed=seed + i, **self._backend_opts)
             for i, (h, p) in enumerate(backends)
         ]
+        self._next_backend_seq = len(self.backends)
         self.dedup = RouterDedup(dedup_ttl_s) if dedup_ttl_s > 0 else None
         # a re-attached retry must outwait the WHOLE failover sweep the
         # original forward may legitimately still be walking — budgeting for
@@ -481,14 +534,13 @@ class FleetRouter:
         self._dedup_wait_s = (self.failover + 1) * timeout_s * (retries + 1) + 5.0
         # consistent-hash ring: _RING_VNODES virtual points per backend,
         # keyed on the STABLE address (host_ids are learned later) — adding
-        # a host remaps only ~1/N of the id space
-        points = sorted(
-            (_hash_point(f"{b.addr}#{v}"), i)
-            for i, b in enumerate(self.backends)
-            for v in range(_RING_VNODES)
-        )
-        self._ring = [p for p, _ in points]
-        self._ring_idx = [i for _, i in points]
+        # or removing a host moves ONLY its own arcs (~1/N of the id space);
+        # every surviving host's points are bit-identical across rebuilds.
+        # Membership changes REPLACE ring + index + backend list together
+        # under _ring_lock; the lists themselves are never mutated in place,
+        # so a reader's snapshot is always internally consistent.
+        self._ring_lock = threading.Lock()
+        self._ring, self._ring_idx = _ring_points(self.backends)
         self._failovers = 0
         self._no_backend = 0
         self._counter_lock = threading.Lock()
@@ -543,38 +595,127 @@ class FleetRouter:
         """One health sweep over every backend: refresh the cached queue
         depth/replica count/identity, and feed the ejection state machine —
         a dead host ejects without traffic, and an ejected host's successful
-        probes re-admit it without traffic."""
-        for b in self.backends:
-            if not b.state.allow():
-                continue  # still inside its eject window: no probe yet
-            try:
-                rep = b.call({"op": "health"}, timeout_s=min(b.timeout_s, 2.0))
-                h = rep.get("health") or {}
-            except _FORWARD_ERRORS as e:
-                b.poll_ok = False
-                if b.state.record_failure():
-                    _emit_event(
-                        "backend_ejected", backend=b.host_id, addr=b.addr,
-                        reason=f"health_poll: {type(e).__name__}",
-                    )
-                continue
-            b.poll_ok = True
-            b.last_poll_ts = time.monotonic()
-            b.queue_depth = int(h.get("queue_depth") or 0)
-            b.replicas = int(h.get("replicas") or h.get("workers") or 1)
-            b.swap_epoch = int(h.get("swap_epoch") or 0)
-            if h.get("uptime_s") is not None:
-                b.uptime_s = float(h["uptime_s"])
-            if h.get("start_seq") is not None:
-                b.start_seq = int(h["start_seq"])
-            if h.get("host_id"):
-                b.host_id = str(h["host_id"])
-            if h.get("listen"):
-                b.listen = str(h["listen"])
-            if b.state.record_success():
+        probes re-admit it without traffic. Draining hosts stay in the sweep:
+        the monitor keeps seeing their typed state until retirement."""
+        for b in list(self.backends):
+            self._poll_backend(b)
+
+    def _poll_backend(self, b: Backend) -> None:
+        if not b.state.allow():
+            return  # still inside its eject window: no probe yet
+        try:
+            rep = b.call({"op": "health"}, timeout_s=min(b.timeout_s, 2.0))
+            h = rep.get("health") or {}
+        except _FORWARD_ERRORS as e:
+            b.poll_ok = False
+            if b.state.record_failure():
                 _emit_event(
-                    "backend_readmitted", backend=b.host_id, addr=b.addr
+                    "backend_ejected", backend=b.host_id, addr=b.addr,
+                    reason=f"health_poll: {type(e).__name__}",
                 )
+            return
+        b.poll_ok = True
+        b.last_poll_ts = time.monotonic()
+        b.queue_depth = int(h.get("queue_depth") or 0)
+        b.replicas = int(h.get("replicas") or h.get("workers") or 1)
+        b.swap_epoch = int(h.get("swap_epoch") or 0)
+        if h.get("uptime_s") is not None:
+            b.uptime_s = float(h["uptime_s"])
+        if h.get("start_seq") is not None:
+            b.start_seq = int(h["start_seq"])
+        if h.get("host_id"):
+            b.host_id = str(h["host_id"])
+        if h.get("listen"):
+            b.listen = str(h["listen"])
+        if b.state.record_success():
+            _emit_event(
+                "backend_readmitted", backend=b.host_id, addr=b.addr
+            )
+
+    # -- elastic membership (docs/FLEET.md "elastic fleet") ------------------
+
+    def add_backend(self, host: str, port: int) -> Backend:
+        """Splice one backend into the fleet: ring resize moving only the
+        NEW host's arcs. The caller owns the admission criteria — the
+        lifecycle manager (fleet/lifecycle.py) health-verifies warm=true and
+        zero request-path compiles BEFORE calling this; the router itself
+        only refuses duplicates. Emits ``backend_admitted``."""
+        addr = f"{host}:{int(port)}"
+        if any(b.addr == addr for b in self.backends):
+            raise ValueError(f"backend {addr} is already a fleet member")
+        with self._ring_lock:
+            b = Backend(
+                host, int(port),
+                seed=self._seed + self._next_backend_seq, **self._backend_opts,
+            )
+            self._next_backend_seq += 1
+            self.backends = self.backends + [b]
+            self._ring, self._ring_idx = _ring_points(self.backends)
+        # learn identity (host_id/listen) immediately so membership events
+        # and per-backend rows attribute to the stable id, not the address
+        self._poll_backend(b)
+        _emit_event("backend_admitted", backend=b.host_id, addr=b.addr)
+        return b
+
+    def _find_backend(self, key) -> Backend:
+        for b in self.backends:
+            if b is key or b.host_id == key or b.addr == key:
+                return b
+        raise KeyError(f"no fleet member {key!r}")
+
+    def begin_retire(self, key) -> Backend:
+        """Start drain-then-retire for one member (by Backend, host_id, or
+        address): its vnodes leave the ring NOW — fresh requests stop
+        hashing to it, surviving hosts keep every key they had — and the
+        host reports the typed ``draining`` state until removal. Refuses to
+        drain the last non-draining member."""
+        b = self._find_backend(key)
+        with self._ring_lock:
+            if b.draining:
+                return b
+            remaining = [
+                x for x in self.backends if not x.draining and x is not b
+            ]
+            if not remaining:
+                raise ValueError(
+                    f"cannot retire {b.host_id}: it is the last fleet member"
+                )
+            b.draining = True
+            self._ring, self._ring_idx = _ring_points(self.backends)
+        _emit_event("backend_draining", backend=b.host_id, addr=b.addr)
+        return b
+
+    def finish_retire(self, key) -> dict:
+        """Remove a drained member from the table and close its connection
+        pool. The router's dedup entries for replies it served stay pinned
+        for the TTL — a retry issued across the retirement re-attaches at
+        the ROUTER and never needs the departed host. Emits
+        ``backend_retired``."""
+        b = self._find_backend(key)
+        with self._ring_lock:
+            self.backends = [x for x in self.backends if x is not b]
+            self._ring, self._ring_idx = _ring_points(self.backends)
+        b.close()
+        _emit_event("backend_retired", backend=b.host_id, addr=b.addr)
+        return {"backend": b.host_id, "addr": b.addr,
+                "inflight_at_removal": b.inflight()}
+
+    def retire_backend(
+        self, key, wait_s: float = 30.0, poll_s: float = 0.05
+    ) -> dict:
+        """The blocking drain-then-remove composition: stop admitting (ring
+        resize), wait for the host's in-flight forwards to reach zero
+        (bounded by ``wait_s``), then remove it. Returns the drain record;
+        ``drained`` is False iff the wait timed out with forwards still on
+        the wire (the record reports how many — the dryrun gates on zero)."""
+        b = self.begin_retire(key)
+        deadline = time.monotonic() + float(wait_s)
+        while b.inflight() > 0 and time.monotonic() < deadline:
+            time.sleep(poll_s)
+        stranded = b.inflight()
+        rec = self.finish_retire(b)
+        rec.update(drained=stranded == 0, inflight_at_removal=stranded)
+        return rec
 
     # -- balancing ----------------------------------------------------------
 
@@ -582,23 +723,28 @@ class FleetRouter:
         """Backend preference order for one request id: the hash ring walked
         from the id's point (stable id -> host affinity, so retries land
         where the server-side dedup window holds), or the live backends by
-        ascending polled queue depth."""
+        ascending polled queue depth. Draining hosts are off the ring (and
+        filtered from the queue-depth order): a retiring backend receives no
+        fresh work while it finishes its in-flight forwards."""
+        with self._ring_lock:
+            ring, ring_idx, backends = self._ring, self._ring_idx, self.backends
         if self.balance == "least_queue":
-            order = sorted(
-                range(len(self.backends)),
-                key=lambda i: (self.backends[i].queue_depth, i),
-            )
-        else:
-            start = bisect_right(self._ring, _hash_point(str(rid)))
-            order, seen = [], set()
-            for k in range(len(self._ring)):
-                i = self._ring_idx[(start + k) % len(self._ring)]
-                if i not in seen:
-                    seen.add(i)
-                    order.append(i)
-                if len(order) == len(self.backends):
-                    break
-        return [self.backends[i] for i in order]
+            pool = [b for b in backends if not b.draining]
+            pool.sort(key=lambda b: b.queue_depth)
+            return pool
+        if not ring:
+            return []
+        start = bisect_right(ring, _hash_point(str(rid)))
+        members = len(ring) // _RING_VNODES
+        order, seen = [], set()
+        for k in range(len(ring)):
+            i = ring_idx[(start + k) % len(ring)]
+            if i not in seen:
+                seen.add(i)
+                order.append(i)
+            if len(order) == members:
+                break
+        return [backends[i] for i in order]
 
     # -- the request path ---------------------------------------------------
 
@@ -736,7 +882,10 @@ class FleetRouter:
     # -- fan-out / aggregated verbs -----------------------------------------
 
     def live_backends(self) -> list[Backend]:
-        return [b for b in self.backends if b.state.live()]
+        """Members that may receive fresh work: not ejected, not draining
+        (a retiring host still finishes in-flight forwards, but fan-outs
+        and scaling must not hand it anything new)."""
+        return [b for b in self.backends if b.state.live() and not b.draining]
 
     def swap_fanout(self, tags: dict | None = None) -> dict:
         """``{"op": "swap"}`` to every LIVE backend concurrently; all-or-
@@ -875,6 +1024,7 @@ class FleetRouter:
             "warm": True,
             "backends": len(self.backends),
             "backends_live": len(self.live_backends()),
+            "backends_draining": sum(1 for b in self.backends if b.draining),
             "queue_depth": sum(b.queue_depth for b in self.backends),
             "replicas": sum(b.replicas for b in self.backends),
             "swap_epoch": min(
@@ -1072,4 +1222,4 @@ class FleetRouter:
 
     @staticmethod
     def state_row(b: Backend) -> dict:
-        return {"state": b.state.state}
+        return {"state": "draining" if b.draining else b.state.state}

@@ -52,6 +52,7 @@ platform/distributed init, like ``report``.
 
 from __future__ import annotations
 
+import hashlib
 import heapq
 import json
 import math
@@ -451,6 +452,36 @@ def plan_backends(
     }
 
 
+def emit_target(plan_rec: dict) -> dict:
+    """The planner->autoscaler handoff record (``plan --emit-target``):
+    the answer (``backends_needed``) plus everything it was conditioned on
+    — target, workers, trace path — sealed under ``assumptions_sha``, a
+    sha256 over the canonical planning inputs AND the full sweep table.
+    The fleet autoscaler (control/fleet_scale.py) records the sha in every
+    ``fleet_scale_event`` it emits while obeying this target, so a
+    decision trail always says WHICH planning run it was obeying; a re-plan
+    against a different trace or target changes the sha even when the
+    answer count happens to match."""
+    basis = {
+        "trace": plan_rec["trace"],
+        "target_rps": plan_rec["target_rps"],
+        "p99_target_ms": plan_rec["p99_target_ms"],
+        "workers_per_backend": plan_rec["workers_per_backend"],
+        "sweep": plan_rec["sweep"],
+    }
+    sha = hashlib.sha256(
+        json.dumps(basis, sort_keys=True).encode()
+    ).hexdigest()
+    return {
+        "backends_needed": plan_rec["backends_needed"],
+        "target_rps": plan_rec["target_rps"],
+        "p99_target_ms": plan_rec["p99_target_ms"],
+        "workers_per_backend": plan_rec["workers_per_backend"],
+        "trace": plan_rec["trace"],
+        "assumptions_sha": sha,
+    }
+
+
 # ---------------------------------------------------------------------------
 # CLI: qdml-tpu plan
 # ---------------------------------------------------------------------------
@@ -468,7 +499,10 @@ def plan_main(argv: list[str]) -> int:
     [--json=out.json] [--seed=0]`` gates every window's self-replay
     inside the band (exit 0 iff all pass); ``qdml-tpu plan
     --trace=traced.jsonl --target-rps=X --p99-ms=Y [--max-backends=8]
-    [--workers=1]`` answers the capacity question. Host-side only."""
+    [--workers=1]`` answers the capacity question; add
+    ``--emit-target=target.json`` to also write the sealed
+    planner->autoscaler handoff record (:func:`emit_target`). Host-side
+    only."""
     traces = [p for p in (_arg(argv, "trace", "") or "").split(",") if p]
     if not traces:
         print("plan needs --trace=<window.jsonl>[,more.jsonl]")
@@ -497,4 +531,11 @@ def plan_main(argv: list[str]) -> int:
     if out_json:
         with open(out_json, "w") as fh:
             json.dump(rep, fh, indent=2)
+    target_json = _arg(argv, "emit-target", None)
+    if target_json:
+        # emitted even when backends_needed is None (the autoscaler's
+        # loader refuses the null — an unmeetable plan must fail LOUDLY
+        # at consumption, not silently vanish at emission)
+        with open(target_json, "w") as fh:
+            json.dump({"fleet_target": emit_target(rep)}, fh, indent=2)
     return 0 if rep["backends_needed"] is not None else 3

@@ -221,6 +221,19 @@ class MonitorScraper:
     def _derive_events(self, h: dict, t_s: float) -> list[dict]:
         evs: list[dict] = []
         rows = self._backend_rows(h)
+        # membership deltas (elastic fleet, docs/FLEET.md): a backend id
+        # appearing after the first scrape was admitted, one disappearing
+        # was retired — the timeline then correlates scale events with burn
+        # trajectories. The first scrape seeds silently (the boot-time set
+        # is not an admission), and per-backend diff state is dropped on
+        # retirement so a later same-id re-admission diffs fresh.
+        if self._prev_backends:
+            for bid in rows.keys() - self._prev_backends.keys():
+                evs.append({"event": "backend_admitted", "backend": bid,
+                            "state": rows[bid].get("state")})
+            for bid in self._prev_backends.keys() - rows.keys():
+                evs.append({"event": "backend_retired", "backend": bid})
+                del self._prev_backends[bid]
         for bid, row in rows.items():
             prev = self._prev_backends.get(bid)
             seq, up = row.get("start_seq"), row.get("uptime_s")

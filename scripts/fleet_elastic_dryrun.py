@@ -1,0 +1,634 @@
+"""Elastic fleet lifecycle dryrun over REAL backend serve processes (ISSUE 17).
+
+The multi-process proof of the spawn/warm/admit/drain/retire state machine
+(docs/FLEET.md "elastic fleet"): boot a 2-backend fleet of genuine
+``qdml-tpu serve`` processes behind a :class:`FleetRouter` + asyncio front
+door (with a :class:`BackendLifecycle` attached, so the ``{"op": "fleet"}``
+scaling form is armed), drive MMPP ("bursty") loadgen traffic through it,
+and prove the four elastic scenarios the tier claims. Per the repo's dryrun
+noise discipline, BEHAVIOR gates are absolute/invariant and latency %-rows
+are judged only against interleaved contemporaneous windows:
+
+- **scale-up under traffic**: a standby is verified warm (``health.warm``
+  + ZERO request-path compile counters over the live verbs) and admitted
+  mid-window; zero stranded futures, the admitted backend's compile delta
+  stays zero under the traffic it then serves, and the consistent-hash
+  audit shows BOUNDED key movement — every moved key moved TO the new
+  host, surviving assignments untouched;
+- **drain-then-retire under traffic**: the lifecycle-owned backend drains
+  (typed ``draining`` state, off the ring, in-flights complete) and exits
+  mid-window; zero stranded futures, and a dedup'd retry of an id the
+  victim served BEFORE retirement is answered AFTER it — identical reply,
+  router dedup hit, zero new dispatches fleet-wide — with the ring audit
+  showing assignments restored bit-exactly;
+- **kill-during-admission**: a standby killed between spawn and
+  verification is quarantined (never admitted); the serving fleet is
+  unaffected (zero stranded, membership unchanged);
+- **planner-target convergence**: ``plan --emit-target`` over this
+  harness's own traced baseline window emits ``backends_needed`` + its
+  ``assumptions_sha``; a :class:`FleetAutoscaler` pinned to that target
+  converges the fleet one admission/retirement per tick, every decision an
+  emitted ``fleet_scale_event`` carrying the sha — and the report
+  round-trip over the converged fleet's windows exits 0.
+
+Writes ``results/fleet_elastic/``: ``baseline[_tN].jsonl``,
+``{class}_fault.jsonl``, ``{class}_recovery_tN.jsonl`` /
+``{class}_base_tN.jsonl``, ``report_{class}.md``, ``fleet_target.json``,
+``fleet_scale_events.jsonl``, ``FLEET_ELASTIC.json``.
+
+Run: ``python scripts/fleet_elastic_dryrun.py [--n=240] [--rate=300]
+[--deadline-ms=500] [--seed=0]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+
+def _arg(argv, name, default):
+    return next((a.split("=", 1)[1] for a in argv if a.startswith(f"--{name}=")), default)
+
+
+def _free_port() -> int:
+    with socket.socket() as sk:
+        sk.bind(("127.0.0.1", 0))
+        return sk.getsockname()[1]
+
+
+def main(argv: list[str]) -> int:
+    n = int(_arg(argv, "n", "240"))
+    rate = float(_arg(argv, "rate", "300"))
+    deadline_ms = float(_arg(argv, "deadline-ms", "500"))
+    threshold = _arg(argv, "threshold", "50")  # %-rows: identical code, 2-core tail noise
+    seed = int(_arg(argv, "seed", "0"))
+    trials = int(_arg(argv, "trials", "3"))
+    force_cpu(2)
+
+    import asyncio
+    from concurrent.futures import Future
+
+    from qdml_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        ServeConfig,
+        TrainConfig,
+    )
+    from qdml_tpu.control.fleet_scale import FleetAutoscaler, load_planner_target
+    from qdml_tpu.fleet import FleetRouter, route_async, spawn_backend
+    from qdml_tpu.fleet.lifecycle import BackendLifecycle
+    from qdml_tpu.serve import ServeClient, make_request_samples, run_loadgen_socket
+    from qdml_tpu.telemetry import run_manifest
+    from qdml_tpu.telemetry.capacity import plan_main
+    from qdml_tpu.telemetry.report import report_main
+    from qdml_tpu.train.hdce import train_hdce
+    from qdml_tpu.train.qsc import train_classifier
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    out_dir = os.path.join("results", "fleet_elastic")
+    os.makedirs(out_dir, exist_ok=True)
+    scratch = tempfile.mkdtemp(prefix="fleet_elastic_")
+
+    cfg = ExperimentConfig(
+        name="fleet_elastic_dryrun",
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=8, workdir=scratch, probe_every=0),
+        serve=ServeConfig(
+            max_batch=16, buckets=(4, 16), max_wait_ms=2.0, max_queue=64,
+            batching="bucket", dedup_ttl_s=10.0, conn_timeout_s=5.0,
+            supervise=True,
+            arrival="bursty",  # the elastic scenarios run under MMPP traffic
+        ),
+    )
+    import dataclasses
+
+    workdir = os.path.join(scratch, f"Pn_{cfg.data.pilot_num}", cfg.name)
+    print("training fleet models (8-epoch HDCE + 8-epoch SC) ...", flush=True)
+    tlog = MetricsLogger(os.path.join(scratch, "train.jsonl"), echo=False,
+                         manifest=run_manifest(cfg))
+    try:
+        train_hdce(cfg, logger=tlog, workdir=workdir)
+        sc_cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, n_epochs=8)
+        )
+        train_classifier(sc_cfg, quantum=False, logger=tlog, workdir=workdir)
+    finally:
+        tlog.close()
+    samples = make_request_samples(cfg, n)
+
+    backend_overrides = [
+        "--name=fleet_elastic_dryrun",
+        "--data.n_ant=16", "--data.n_sub=8", "--data.n_beam=4",
+        "--data.data_len=64", "--model.features=8", "--train.batch_size=16",
+        f"--train.workdir={scratch}",
+        "--serve.max_batch=16", "--serve.buckets=(4,16)",
+        "--serve.max_wait_ms=2.0", "--serve.max_queue=64",
+        "--serve.batching=bucket", "--serve.dedup_ttl_s=10.0",
+        "--serve.conn_timeout_s=5.0", "--serve.supervise=true",
+    ]
+    boot_ports = [_free_port(), _free_port()]
+
+    def spawn_boot(i: int):
+        print(f"spawning boot backend {i} on :{boot_ports[i]} ...", flush=True)
+        b = spawn_backend(backend_overrides, port=boot_ports[i])
+        print(json.dumps({"backend": i, "port": b.port, "host_id": b.host_id,
+                          "compiles_after_warmup": b.banner[
+                              "compile_cache_after_warmup"]}), flush=True)
+        return b
+
+    boot = [spawn_boot(0), spawn_boot(1)]
+    router = FleetRouter(
+        [("127.0.0.1", p) for p in boot_ports],
+        balance="hash", timeout_s=2.0, retries=0,
+        eject_failures=2, eject_s=0.5, readmit_probes=1,
+        poll_interval_s=0.2, failover=2, seed=seed,
+        # the drain-spanning dedup pin retries its id AFTER a full fault
+        # window + drain-then-retire: the TTL must outlive that
+        dedup_ttl_s=300.0,
+        # every request traced: the planner consumes this harness's OWN
+        # baseline window (plan --emit-target needs phase decomposition)
+        trace_sample=1.0,
+    ).start()
+
+    # standbys PRE-SPAWNED outside the traffic windows: provisioning a real
+    # qdml-tpu serve process (interpreter + JAX + warmup) is tens of seconds
+    # of boring cold-start; the events that must be safe UNDER traffic are
+    # verification + ring splice (admission) and drain + exit (retirement),
+    # and those run mid-window through the lifecycle below
+    prepared: list = []
+
+    def spawn_fn(overrides, port=0, host="127.0.0.1", log_path=None,
+                 timeout_s=600.0):
+        if prepared:
+            return prepared.pop(0)
+        return spawn_backend(list(overrides), port=port, host=host,
+                             log_path=log_path, timeout_s=timeout_s)
+
+    lifecycle = BackendLifecycle(
+        router, spawn_overrides=backend_overrides, drain_wait_s=30.0,
+        log_dir=scratch, spawn_fn=spawn_fn,
+    )
+    esink = MetricsLogger(os.path.join(out_dir, "fleet_scale_events.jsonl"),
+                          echo=False, manifest=run_manifest(cfg))
+
+    aloop = asyncio.new_event_loop()
+    tloop = threading.Thread(target=aloop.run_forever, daemon=True)
+    tloop.start()
+    ready: Future = Future()
+    front_task = asyncio.run_coroutine_threadsafe(
+        route_async(router, "127.0.0.1", 0, ready,
+                    conn_timeout_s=5.0, max_line_bytes=1 << 20,
+                    lifecycle=lifecycle),
+        aloop,
+    )
+    front = ("127.0.0.1", ready.result(timeout=30.0))
+    print(json.dumps({"router_front": front[1], "elastic": True}), flush=True)
+
+    window_seq = [0]
+
+    def serve_window(tag: str, during=None):
+        side_err: list = []
+        side = None
+        if during is not None:
+            def _side():
+                try:
+                    during()
+                except Exception as e:  # lint: disable=broad-except(the injection side thread must report its failure into the headline, not die silently and fake a passing chaos run)
+                    side_err.append(f"{type(e).__name__}: {e}")
+            side = threading.Thread(target=_side, daemon=True)
+            side.start()
+        path = os.path.join(out_dir, f"{tag}.jsonl")
+        logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+        # one seed per WINDOW: a reused loadgen id would re-attach to the
+        # router's fleet-wide dedup from an earlier trial and measure cache
+        # hits, not serving (caught by the backend completed-counter audit)
+        window_seq[0] += 1
+        try:
+            summary = run_loadgen_socket(
+                cfg, front, rate=rate, n=n, seed=seed + 1000 * window_seq[0],
+                deadline_ms=deadline_ms, logger=logger, clients=8,
+                x=samples["x"],
+            )
+        finally:
+            logger.close()
+        if side is not None:
+            side.join(timeout=120.0)
+        if side_err:
+            summary["injection_error"] = side_err[0]
+        return summary, path
+
+    def _p99(s):
+        return ((s["latency_ms"] or {}).get("p99_ms")) or float("inf")
+
+    def backend_poll(port: int, verb: str = "metrics") -> dict | None:
+        try:
+            with ServeClient("127.0.0.1", port, timeout_s=5.0, retries=1) as c:
+                rep = c.metrics() if verb == "metrics" else c.health()
+                return rep.get(verb)
+        except Exception:  # lint: disable=broad-except(a dead backend is an expected poll outcome mid-chaos; the caller records None)
+            return None
+
+    def live_ports() -> list:
+        return [b.port for b in router.backends]
+
+    def per_port_completed() -> dict:
+        out = {}
+        for p in live_ports():
+            m = backend_poll(p)
+            out[p] = None if m is None else int(m.get("completed") or 0)
+        return out
+
+    def _rid_for_primary(port: int) -> str:
+        """A request id whose consistent-hash primary is the given backend
+        (the retirement-spanning pin must target the victim's id space)."""
+        k = 0
+        while True:
+            rid = f"pin-{seed}-{k}"
+            if router._candidates(rid)[0].port == port:
+                return rid
+            k += 1
+
+    def dedup_retry_pin(rid: str, rep1: dict) -> dict:
+        """QUIET-phase fleet-wide dedup pin: retry an already-served id —
+        identical reply, a router dedup hit, ZERO new dispatches on any
+        live backend (per-port counters bitwise unchanged)."""
+        before = per_port_completed()
+        hits0 = router.dedup.hits
+        with ServeClient(front[0], front[1], timeout_s=10.0, retries=1,
+                         backoff_s=0.05, seed=seed) as client:
+            rep2 = client.request(samples["x"][0], rid=rid)
+        after = per_port_completed()
+        ok = (
+            rep1.get("ok") is True and rep2.get("ok") is True
+            and rep1.get("h") == rep2.get("h")
+            and rep2.get("pred") == rep1.get("pred")
+            and router.dedup.hits == hits0 + 1
+            and all(after[p] == before[p] for p in after
+                    if before.get(p) is not None and after[p] is not None)
+        )
+        return {"ok": ok, "rid": rid, "dedup_hits": router.dedup.hits,
+                "completed_before": before, "completed_after": after}
+
+    #: the ring audit's probe ids — NEVER offered as traffic (the audit
+    #: reads routing assignments, it must not seed dedup entries)
+    audit_keys = [f"ring-audit-{i}" for i in range(3000)]
+
+    def ring_assignment() -> dict:
+        return {k: router._candidates(k)[0].addr for k in audit_keys}
+
+    headline: dict = {
+        "n": n, "rate": rate, "deadline_ms": deadline_ms, "seed": seed,
+        "arrival_process": "bursty",
+        "report_threshold_pct": float(threshold),
+        "note": (
+            "elastic-lifecycle wiring proof on the 2-core harness: behavior "
+            "gates (stranded futures, warm-verified admission, per-backend "
+            "compile deltas, bounded ring movement, retirement-spanning "
+            "dedup, quarantine-on-kill, planner convergence) are absolute/"
+            "invariant; %-threshold latency rows compare identical code "
+            "across interleaved contemporaneous windows at 50% (real "
+            "hardware re-runs arm the default 10%)"
+        ),
+        "boot_backends": {b.host_id: {"port": b.port} for b in boot},
+        "classes": {},
+    }
+    all_pass = True
+
+    def finish_class(kind: str, checks: dict, ok: bool) -> None:
+        nonlocal all_pass
+        checks["ok"] = ok
+        headline["classes"][kind] = checks
+        all_pass = all_pass and ok
+        print(json.dumps({kind: {"ok": ok}}), flush=True)
+
+    def recovery_report(kind: str) -> dict:
+        """Post-scenario steady state: best-of-N recovery vs interleaved
+        contemporaneous local baselines + the report round-trip."""
+        rec_summary = rec_path = lb_summary = lb_path = None
+        rec_trials = []
+        for trial in range(trials):
+            s, p = serve_window(f"{kind}_recovery_t{trial}")
+            rec_trials.append({
+                "trial": trial,
+                "stranded_futures": s["stranded_futures"],
+                "give_ups": s["give_ups"],
+                "hard_give_ups": s["give_ups"] - s["deadline_give_ups"],
+                "p99_ms": (s["latency_ms"] or {}).get("p99_ms"),
+                "slo": s["slo"],
+            })
+            if rec_summary is None or _p99(s) < _p99(rec_summary):
+                rec_summary, rec_path = s, p
+            sb, pb = serve_window(f"{kind}_base_t{trial}")
+            if lb_summary is None or _p99(sb) < _p99(lb_summary):
+                lb_summary, lb_path = sb, pb
+        report_md = os.path.join(out_dir, f"report_{kind}.md")
+        rc = report_main(
+            [f"--current={rec_path}", f"--baseline={lb_path}",
+             f"--threshold={threshold}", f"--out={report_md}"]
+        )
+        rec_att = (rec_summary["slo"] or {}).get("attainment")
+        lb_att = (lb_summary["slo"] or {}).get("attainment")
+        return {
+            "recovery_trials": rec_trials,
+            "stranded_futures_recovery": max(
+                t["stranded_futures"] for t in rec_trials
+            ),
+            "hard_give_ups_recovery": max(
+                t["hard_give_ups"] for t in rec_trials
+            ),
+            "slo_recovery": rec_summary["slo"],
+            "slo_local_baseline": lb_summary["slo"],
+            "slo_reattained": rec_att is not None
+            and (lb_att is None or rec_att >= lb_att - 0.05),
+            "report_exit": rc,
+        }
+
+    # ---------------- baseline: 2-backend fleet, best-of-N -------------------
+    base_summary = base_path = None
+    for trial in range(trials):
+        s, p = serve_window(f"baseline_t{trial}" if trial else "baseline")
+        if base_summary is None or _p99(s) < _p99(base_summary):
+            base_summary, base_path = s, p
+    both_served = all(
+        (v or {}).get("completed") for v in
+        (base_summary.get("server_metrics") or {}).get("per_backend", {}).values()
+    ) and len((base_summary.get("server_metrics") or {}).get("per_backend", {})) == 2
+    served_total = sum(v or 0 for v in per_port_completed().values())
+    finish_class("baseline", {
+        "completed": base_summary["completed"],
+        "stranded_futures": base_summary["stranded_futures"],
+        "slo": base_summary["slo"],
+        "both_backends_served": both_served,
+        "backend_completed_total": served_total,
+        "offered_total": trials * n,
+        "path": base_path,
+    }, (
+        base_summary["stranded_futures"] == 0 and both_served
+        and served_total >= trials * n - n // 10
+    ))
+
+    # ---------------- the fleet verb over the wire ----------------------------
+    with ServeClient(front[0], front[1], timeout_s=60.0) as c:
+        verb_status = c.fleet()
+        verb_noop = c.fleet(backends=lifecycle.fleet_size())  # converged no-op
+    finish_class("fleet_verb", {
+        "status_ok": verb_status.get("ok"),
+        "elastic": (verb_status.get("fleet") or {}).get("elastic"),
+        "backends": (verb_status.get("fleet") or {}).get("backends"),
+        "noop_scale_ok": verb_noop.get("ok"),
+        "noop_actions": len((verb_noop.get("fleet") or {}).get("actions", [])),
+    }, (
+        verb_status.get("ok") is True
+        and (verb_status.get("fleet") or {}).get("elastic") is True
+        and (verb_status.get("fleet") or {}).get("backends") == 2
+        and verb_noop.get("ok") is True
+        and (verb_noop.get("fleet") or {}).get("actions") == []
+    ))
+
+    # ---------------- scale-up under traffic ----------------------------------
+    print("provisioning standby for scale-up ...", flush=True)
+    prepared.append(spawn_backend(backend_overrides, port=0,
+                                  log_path=os.path.join(scratch, "standby1.log")))
+    ring_before = ring_assignment()
+    up_box: dict = {}
+
+    def inject_scale_up():
+        time.sleep((n // 3) / rate)  # mid-window: verify + ring splice
+        up_box["rec"] = lifecycle.scale_up()
+
+    s_up, _p = serve_window("scale_up_fault", during=inject_scale_up)
+    up_rec = up_box.get("rec") or {"ok": False, "error": "injection never ran"}
+    ring_after_up = ring_assignment()
+    moved = [k for k in audit_keys if ring_after_up[k] != ring_before[k]]
+    new_addr = up_rec.get("addr")
+    moved_to_new = all(ring_after_up[k] == new_addr for k in moved)
+    moved_frac = len(moved) / len(audit_keys)
+    new_port = int(new_addr.rsplit(":", 1)[1]) if new_addr else None
+    new_compiles = (backend_poll(new_port) or {}).get(
+        "compile_cache_after_warmup"
+    ) if new_port else None
+    up_checks = {
+        "stranded_futures_fault": s_up["stranded_futures"],
+        "admission": up_rec,
+        "fleet_after": lifecycle.fleet_size(),
+        "ring_moved_fraction": round(moved_frac, 4),
+        "ring_moved_only_to_new_host": moved_to_new,
+        "new_backend_compiles_after_traffic": new_compiles,
+        "injection_error": s_up.get("injection_error"),
+    }
+    up_checks.update(recovery_report("scale_up"))
+    finish_class("scale_up", up_checks, (
+        s_up["stranded_futures"] == 0
+        and up_rec.get("ok") is True and up_rec.get("stage") == "admitted"
+        and (up_rec.get("verified") or {}).get("warm") is True
+        and lifecycle.fleet_size() == 3
+        and moved and moved_to_new and 0.05 < moved_frac < 0.60
+        and isinstance(new_compiles, dict)
+        and all(v == 0 for v in new_compiles.values())
+        and s_up.get("injection_error") is None
+        and up_checks["stranded_futures_recovery"] == 0
+        and up_checks["hard_give_ups_recovery"] == 0
+        and up_checks["slo_reattained"] and up_checks["report_exit"] == 0
+    ))
+
+    # ---------------- drain-then-retire under traffic -------------------------
+    # pin an id whose primary IS the retiring backend, served BEFORE the
+    # retirement: the post-retirement retry must be answered by the router's
+    # fleet-wide dedup, not re-dispatched
+    pin_rid = _rid_for_primary(new_port)
+    with ServeClient(front[0], front[1], timeout_s=10.0, retries=1,
+                     seed=seed) as _c:
+        pin_rep1 = _c.request(samples["x"][0], rid=pin_rid)
+    down_box: dict = {}
+
+    def inject_scale_down():
+        time.sleep((n // 3) / rate)  # mid-window: drain + exit
+        down_box["rec"] = lifecycle.scale_down()
+
+    s_down, _p = serve_window("drain_retire_fault", during=inject_scale_down)
+    down_rec = down_box.get("rec") or {"ok": False, "error": "injection never ran"}
+    ring_after_down = ring_assignment()
+    pin = dedup_retry_pin(pin_rid, pin_rep1)
+    down_checks = {
+        "stranded_futures_fault": s_down["stranded_futures"],
+        "retirement": down_rec,
+        "fleet_after": lifecycle.fleet_size(),
+        "ring_restored_exactly": ring_after_down == ring_before,
+        "dedup_across_retirement": pin,
+        "injection_error": s_down.get("injection_error"),
+    }
+    down_checks.update(recovery_report("drain_retire"))
+    finish_class("drain_retire", down_checks, (
+        s_down["stranded_futures"] == 0
+        and down_rec.get("ok") is True and down_rec.get("stage") == "retired"
+        and down_rec.get("addr") == new_addr
+        and down_rec.get("drained") is True
+        and down_rec.get("terminated") is True
+        and lifecycle.fleet_size() == 2
+        and ring_after_down == ring_before
+        and pin["ok"]
+        and s_down.get("injection_error") is None
+        and down_checks["stranded_futures_recovery"] == 0
+        and down_checks["hard_give_ups_recovery"] == 0
+        and down_checks["slo_reattained"] and down_checks["report_exit"] == 0
+    ))
+
+    # ---------------- kill-during-admission -----------------------------------
+    print("provisioning standby for kill-during-admission ...", flush=True)
+    standby2 = spawn_backend(backend_overrides, port=0,
+                             log_path=os.path.join(scratch, "standby2.log"))
+    prepared.append(standby2)
+
+    from qdml_tpu.fleet.lifecycle import verify_warm
+
+    def killing_verify(host, port, timeout_s=10.0):
+        standby2.kill()  # SIGKILL between spawn and verification
+        return verify_warm(host, port, timeout_s=timeout_s)
+
+    lc_kill = BackendLifecycle(
+        router, spawn_overrides=backend_overrides, spawn_fn=spawn_fn,
+        verify_fn=killing_verify,
+    )
+    kill_box: dict = {}
+
+    def inject_kill_admission():
+        time.sleep((n // 3) / rate)
+        kill_box["rec"] = lc_kill.scale_up()
+
+    s_kill, _p = serve_window("admission_kill_fault", during=inject_kill_admission)
+    kill_rec = kill_box.get("rec") or {"ok": False, "error": "injection never ran"}
+    kill_addr = kill_rec.get("addr")
+    kill_lc_state = (lc_kill.status()["lifecycle"].get(kill_addr) or {}).get("state")
+    finish_class("admission_kill", {
+        "stranded_futures_fault": s_kill["stranded_futures"],
+        "quarantine": kill_rec,
+        "lifecycle_state": kill_lc_state,
+        "fleet_after": lifecycle.fleet_size(),
+        "live_backends": len(router.live_backends()),
+        "standby_alive": standby2.alive(),
+        "injection_error": s_kill.get("injection_error"),
+    }, (
+        s_kill["stranded_futures"] == 0
+        and kill_rec.get("ok") is False
+        and kill_rec.get("stage") == "quarantined"
+        and kill_lc_state == "quarantined"
+        and lifecycle.fleet_size() == 2
+        and len(router.live_backends()) == 2
+        and not standby2.alive()
+        and s_kill.get("injection_error") is None
+    ))
+
+    # ---------------- planner-target convergence ------------------------------
+    target_path = os.path.join(out_dir, "fleet_target.json")
+    plan_rc = plan_main([
+        f"--trace={base_path}", f"--target-rps={rate}",
+        f"--p99-ms={deadline_ms}", "--max-backends=3",
+        f"--emit-target={target_path}",
+    ])
+    tgt = None
+    scale_events: list = []
+    converged = False
+    desired = None
+    if plan_rc == 0:
+        tgt = load_planner_target(target_path)
+        desired = max(1, min(3, int(tgt["backends_needed"])))
+        # displace the fleet off the target so convergence has work to do
+        # (a no-op "convergence" would prove nothing)
+        if lifecycle.fleet_size() == desired:
+            lifecycle.scale_to(desired + 1 if desired < 3 else desired - 1)
+        scaler = FleetAutoscaler(
+            lifecycle.scale_to, min_backends=1, max_backends=3,
+            cooldown_ticks=0, sink=esink.telemetry,
+        )
+        scaler.set_planner_target(tgt)
+        slo_att = (base_summary["slo"] or {}).get("attainment") or 1.0
+        for _ in range(6):
+            ev = scaler.observe(
+                0.0, lifecycle.fleet_size(), slo_attainment=slo_att
+            )
+            if ev is not None:
+                scale_events.append(ev)
+            if lifecycle.fleet_size() == desired:
+                converged = True
+                break
+    plan_checks = {
+        "plan_exit": plan_rc,
+        "target": tgt,
+        "desired_clamped": desired,
+        "displaced_then_converged": converged,
+        "fleet_after": lifecycle.fleet_size(),
+        "scale_events": [
+            {k: e.get(k) for k in
+             ("direction", "backends", "backends_before", "planner_sha")}
+            for e in scale_events
+        ],
+        "events_carry_planner_sha": bool(scale_events) and all(
+            e.get("planner_sha") == (tgt or {}).get("assumptions_sha")
+            for e in scale_events
+        ),
+        "scale_results_ok": all(
+            (e.get("result") or {}).get("ok") for e in scale_events
+        ),
+    }
+    plan_checks.update(recovery_report("planner_target"))
+    finish_class("planner_target", plan_checks, (
+        plan_rc == 0 and tgt is not None
+        and isinstance(tgt.get("backends_needed"), int)
+        and len(tgt.get("assumptions_sha") or "") == 64
+        and converged and lifecycle.fleet_size() == desired
+        and len(scale_events) >= 1
+        and plan_checks["events_carry_planner_sha"]
+        and plan_checks["scale_results_ok"]
+        and plan_checks["stranded_futures_recovery"] == 0
+        and plan_checks["hard_give_ups_recovery"] == 0
+        and plan_checks["slo_reattained"] and plan_checks["report_exit"] == 0
+    ))
+
+    # ---------------- per-backend compile gate (absolute, always-armed) ------
+    compile_gate = {}
+    for b in router.backends:
+        m = backend_poll(b.port)
+        compile_gate[b.host_id] = None if m is None else m.get(
+            "compile_cache_after_warmup"
+        )
+    headline["compile_cache_per_backend"] = compile_gate
+    compiles_ok = bool(compile_gate) and all(
+        isinstance(v, dict) and all(c == 0 for c in v.values())
+        for v in compile_gate.values()
+    )
+    finish_class("request_path_compiles", {"per_backend": compile_gate}, compiles_ok)
+
+    headline["lifecycle_status"] = lifecycle.status()
+
+    # ---------------- teardown + headline ------------------------------------
+    front_task.cancel()
+    aloop.call_soon_threadsafe(aloop.stop)
+    tloop.join(timeout=10.0)
+    router.stop()
+    lifecycle.close()
+    lc_kill.close()
+    for b in boot:
+        b.terminate()
+    for p in prepared:
+        p.kill()
+    esink.close()
+    headline["all_pass"] = all_pass
+    with open(os.path.join(out_dir, "FLEET_ELASTIC.json"), "w") as fh:
+        json.dump(headline, fh, indent=2, default=str)
+    print(json.dumps({"all_pass": all_pass}))
+    return 0 if all_pass else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -20,10 +20,11 @@
 # accounting; edit ROADMAP.md first if that line ever needs to change).
 cd "$(dirname "$0")/.." || exit 2
 python -m qdml_tpu.cli lint --baseline || exit 5
-# One parameterized pass over both committed chaos-style artifact sets
-# (results/chaos_dryrun, results/fleet_router — docs/RESILIENCE.md,
-# docs/FLEET.md): every recovery window re-arms the invariant rows.
-for spec in "chaos_dryrun:CHAOS_DRYRUN.json" "fleet_router:FLEET_ROUTER.json"; do
+# One parameterized pass over the committed chaos-style artifact sets
+# (results/chaos_dryrun, results/fleet_router, results/fleet_elastic —
+# docs/RESILIENCE.md, docs/FLEET.md): every recovery window re-arms the
+# invariant rows.
+for spec in "chaos_dryrun:CHAOS_DRYRUN.json" "fleet_router:FLEET_ROUTER.json" "fleet_elastic:FLEET_ELASTIC.json"; do
   dir="results/${spec%%:*}"; headline="$dir/${spec#*:}"
   [ -d "$dir" ] || continue
   for f in "$dir"/*_recovery_t0.jsonl; do
@@ -47,6 +48,34 @@ sys.exit(1 if bad else 0)
   python -c "import json, sys; d = json.load(open('$headline')); sys.exit(0 if d.get('all_pass') else 1)" \
     || { echo "committed dryrun is not all_pass: $headline"; exit 6; }
 done
+# Elastic fleet dryrun (docs/FLEET.md "elastic fleet",
+# results/fleet_elastic): beyond the generic invariant pass above, re-check
+# the headline's absolute elastic facts — warm-verified admission with
+# bounded ring movement (every moved key moved TO the new host, assignments
+# restored bit-exactly after retirement), the retirement-spanning dedup pin,
+# quarantine on kill-during-admission, planner-target convergence with the
+# sealed assumptions sha, and zero per-backend request-path compile deltas.
+if [ -f results/fleet_elastic/FLEET_ELASTIC.json ]; then
+  python -c "
+import json, sys
+d = json.load(open('results/fleet_elastic/FLEET_ELASTIC.json'))
+c = d.get('classes') or {}
+up, down = c.get('scale_up') or {}, c.get('drain_retire') or {}
+pt = c.get('planner_target') or {}
+zero = lambda m: isinstance(m, dict) and all(v == 0 for v in m.values())
+comp = d.get('compile_cache_per_backend') or {}
+ok = (d.get('all_pass')
+      and up.get('ring_moved_only_to_new_host') is True
+      and 0 < (up.get('ring_moved_fraction') or 0) < 0.6
+      and down.get('ring_restored_exactly') is True
+      and (down.get('dedup_across_retirement') or {}).get('ok') is True
+      and (c.get('admission_kill') or {}).get('lifecycle_state') == 'quarantined'
+      and len(((pt.get('target') or {}).get('assumptions_sha') or '')) == 64
+      and pt.get('events_carry_planner_sha') is True
+      and comp and all(zero(v) for v in comp.values()))
+sys.exit(0 if ok else 1)
+" || { echo "fleet-elastic headline failed (ring/dedup/quarantine/planner/compile)"; exit 6; }
+fi
 # Trace dryrun (docs/TELEMETRY.md, results/trace_dryrun): re-arm the
 # zero-stranded gate over every committed traced window (same invariant-rows
 # rule as above — %-threshold phase/latency rows are the dryrun's own

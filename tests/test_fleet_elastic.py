@@ -1,0 +1,747 @@
+"""Elastic fleet lifecycle (qdml_tpu/fleet/lifecycle.py + router membership
++ control/fleet_scale.py, docs/FLEET.md "elastic fleet").
+
+All host-side — no engine, no warmup: ring-resize properties run on the
+router's pure hash machinery, the lifecycle state machine runs on injected
+spawn/verify fakes, admission verification runs against a minimal protocol
+stub, and the autoscaler runs on scripted signals. The real
+separate-process topology (spawn -> banner -> verify -> admit under MMPP
+traffic) is the committed dryrun's job (scripts/fleet_elastic_dryrun.py ->
+results/fleet_elastic/).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket as socket_mod
+import threading
+from concurrent.futures import Future
+
+import pytest
+
+from qdml_tpu.control.fleet_scale import (
+    FleetAutoscaler,
+    load_planner_target,
+)
+from qdml_tpu.fleet import route_async
+from qdml_tpu.fleet.lifecycle import (
+    AdmissionFailed,
+    BackendLifecycle,
+    verify_warm,
+)
+from qdml_tpu.fleet.poller import FleetPoller
+from qdml_tpu.fleet.router import Backend, FleetRouter
+from qdml_tpu.serve.client import ServeClient
+from qdml_tpu.telemetry.capacity import emit_target
+
+
+def _router(n: int, base_port: int = 45800, **kw) -> FleetRouter:
+    """Router over n unconnected local addresses (never .start()ed: the
+    ring/membership machinery under test is pure; polls against these ports
+    fail fast with connection-refused when a test path reaches one)."""
+    opts = dict(timeout_s=0.2, retries=0, poll_interval_s=30.0,
+                dedup_ttl_s=30.0)
+    opts.update(kw)
+    return FleetRouter(
+        [("127.0.0.1", base_port + i) for i in range(n)], **opts
+    )
+
+
+def _primaries(router: FleetRouter, keys) -> dict:
+    return {k: router._candidates(k)[0].addr for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring resize: bounded key movement
+# ---------------------------------------------------------------------------
+
+
+def test_ring_add_moves_only_new_hosts_share():
+    """Adding one host moves ONLY keys that now land on it (~1/(N+1) of
+    the id space, vnode variance bounded) — every surviving assignment is
+    untouched, the property that keeps server-side dedup windows valid
+    across a scale-up."""
+    r = _router(4)
+    keys = [f"req-{i}" for i in range(3000)]
+    before = _primaries(r, keys)
+    b = r.add_backend("127.0.0.1", 45990)
+    after = _primaries(r, keys)
+    moved = [k for k in keys if after[k] != before[k]]
+    assert moved, "a new host must take ownership of some arcs"
+    # every moved key moved TO the new host; nothing shuffled between
+    # surviving hosts
+    assert all(after[k] == b.addr for k in moved)
+    frac = len(moved) / len(keys)
+    assert 0.05 < frac < 0.45, f"moved share {frac} outside the vnode bound"
+
+
+def test_ring_remove_restores_prior_assignment_exactly():
+    """Retiring the added host hands its keys back bit-exactly: surviving
+    hosts' vnode points are keyed on their stable addresses, so the rebuilt
+    ring is identical to the pre-add ring."""
+    r = _router(3)
+    keys = [f"k-{i}" for i in range(2000)]
+    before = _primaries(r, keys)
+    b = r.add_backend("127.0.0.1", 45991)
+    r.begin_retire(b)
+    # draining: off the ring immediately, still a member until removal
+    assert _primaries(r, keys) == before
+    assert r.health()["backends_draining"] == 1
+    rec = r.finish_retire(b)
+    assert rec["addr"] == b.addr
+    assert _primaries(r, keys) == before
+    assert len(r.backends) == 3
+
+
+def test_ring_retire_original_member_moves_only_its_keys():
+    r = _router(4)
+    keys = [f"id-{i}" for i in range(3000)]
+    before = _primaries(r, keys)
+    victim = r.backends[1]
+    r.begin_retire(victim.addr)
+    after = _primaries(r, keys)
+    owned = [k for k in keys if before[k] == victim.addr]
+    assert owned, "victim owned some arcs"
+    # only the victim's keys moved; everyone else's stayed put
+    for k in keys:
+        if before[k] == victim.addr:
+            assert after[k] != victim.addr
+        else:
+            assert after[k] == before[k]
+
+
+def test_draining_state_is_typed_and_guarded():
+    r = _router(2)
+    victim = r.backends[0]
+    b = r.begin_retire(victim.addr)
+    assert b is victim and victim.draining
+    assert r.begin_retire(victim.addr) is victim  # idempotent
+    assert victim.poll_row()["state"] == "draining"
+    assert FleetRouter.state_row(victim) == {"state": "draining"}
+    assert victim not in r.live_backends()
+    # the last non-draining member is not retirable
+    with pytest.raises(ValueError):
+        r.begin_retire(r.backends[1].addr)
+    with pytest.raises(KeyError):
+        r.begin_retire("nobody:1")
+
+
+def _ok_call(calls):
+    def fake_call(self, msg, timeout_s=None, idempotent=True):
+        calls.append((self.addr, msg.get("op") or "infer", msg.get("id")))
+        return {"id": msg.get("id"), "ok": True, "pred": "s0", "h": [0.0]}
+    return fake_call
+
+
+def test_retry_before_resize_dedup_hits_after(monkeypatch):
+    """A retry issued AFTER its original backend retired re-attaches at the
+    router's dedup table — identical reply, zero new forwards: membership
+    changes do not break the idempotent-retry contract."""
+    calls: list = []
+    monkeypatch.setattr(Backend, "call", _ok_call(calls))
+    r = _router(2)
+    rep1 = r.request({"id": "rid-keep", "x": [1.0]})
+    assert rep1["ok"]
+    forwards = [c for c in calls if c[1] == "infer"]
+    assert len(forwards) == 1
+    served_by = forwards[0][0]
+    rec = r.retire_backend(served_by, wait_s=1.0)
+    assert rec["drained"] and rec["inflight_at_removal"] == 0
+    assert len(r.backends) == 1
+    rep2 = r.request({"id": "rid-keep", "x": [1.0]})
+    assert rep2 == rep1
+    assert len([c for c in calls if c[1] == "infer"]) == 1
+    assert r.dedup.hits == 1
+
+
+# ---------------------------------------------------------------------------
+# lifecycle state machine: spawn -> warming -> admitted / quarantined,
+# drain -> retired (injected spawn/verify fakes)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, host, port, host_id):
+        self.host, self.port, self.host_id = host, port, host_id
+        self.killed = False
+        self.terminated = False
+        self._alive = True
+
+    def alive(self):
+        return self._alive
+
+    def kill(self):
+        self.killed = True
+        self._alive = False
+
+    def terminate(self, timeout_s: float = 10.0):
+        self.terminated = True
+        self._alive = False
+
+
+def _fake_spawner(procs, base_port=46100):
+    state = {"n": 0}
+
+    def spawn(overrides, port=0, host="127.0.0.1", log_path=None,
+              timeout_s=600.0, env=None, python=None):
+        state["n"] += 1
+        p = _FakeProc(host, base_port + state["n"], f"spawned-{state['n']}")
+        procs.append(p)
+        return p
+
+    return spawn
+
+
+def _lifecycle(router, procs, verify=None, **kw):
+    return BackendLifecycle(
+        router,
+        spawn_fn=_fake_spawner(procs),
+        verify_fn=verify or (lambda h, p, timeout_s=10.0: {"warm": True}),
+        drain_wait_s=1.0,
+        **kw,
+    )
+
+
+def test_scale_up_admits_only_after_verification(monkeypatch):
+    monkeypatch.setattr(Backend, "call", _ok_call([]))
+    r = _router(1)
+    procs: list = []
+    verified: list = []
+
+    def verify(host, port, timeout_s=10.0):
+        # admission order pin: at verification time the router must NOT yet
+        # know the standby — verify-then-admit, never admit-then-verify
+        assert all(b.port != port for b in r.backends)
+        verified.append(port)
+        return {"warm": True, "compile_cache_after_warmup": {}}
+
+    lc = _lifecycle(r, procs, verify=verify)
+    rec = lc.scale_up()
+    assert rec["ok"] and rec["stage"] == "admitted"
+    assert verified == [procs[0].port]
+    assert len(r.backends) == 2 and lc.fleet_size() == 2
+    st = lc.status()
+    assert st["lifecycle"][rec["addr"]]["state"] == "admitted"
+    assert rec["addr"] in st["owned"]
+
+
+def test_cold_backend_is_quarantined_never_admitted(monkeypatch):
+    monkeypatch.setattr(Backend, "call", _ok_call([]))
+    r = _router(1)
+    procs: list = []
+
+    def verify(host, port, timeout_s=10.0):
+        raise AdmissionFailed(f"{host}:{port} reports warm=False")
+
+    lc = _lifecycle(r, procs, verify=verify)
+    rec = lc.scale_up()
+    assert not rec["ok"] and rec["stage"] == "quarantined"
+    assert "warm=False" in rec["reason"]
+    assert len(r.backends) == 1  # the serving fleet never saw it
+    assert procs[0].killed
+    assert lc.status()["lifecycle"][rec["addr"]]["state"] == "quarantined"
+    assert rec["addr"] not in lc.status()["owned"]
+
+
+def test_kill_during_admission_quarantines_standby(monkeypatch):
+    """A standby dying mid-verification (transport error) is the same
+    quarantine path: killed, recorded, fleet untouched."""
+    monkeypatch.setattr(Backend, "call", _ok_call([]))
+    r = _router(2)
+    procs: list = []
+
+    def verify(host, port, timeout_s=10.0):
+        procs[-1]._alive = False  # the process died under us
+        raise ConnectionResetError("peer vanished mid-verify")
+
+    lc = _lifecycle(r, procs, verify=verify)
+    rec = lc.scale_up()
+    assert not rec["ok"] and rec["stage"] == "quarantined"
+    assert len(r.backends) == 2
+    assert not procs[0].killed  # already dead: no second kill
+
+
+def test_scale_down_drains_and_terminates_only_owned(monkeypatch):
+    monkeypatch.setattr(Backend, "call", _ok_call([]))
+    r = _router(1)
+    procs: list = []
+    lc = _lifecycle(r, procs)
+    lc.scale_up()
+    assert lc.fleet_size() == 2
+    rec = lc.scale_down()
+    # LIFO victim: the lifecycle-owned admission goes first, terminated
+    assert rec["ok"] and rec["stage"] == "retired"
+    assert rec["addr"] == f"{procs[0].host}:{procs[0].port}"
+    assert rec["terminated"] and procs[0].terminated
+    assert rec["drained"]
+    assert lc.fleet_size() == 1
+    # shrinking again would touch the boot-time backend: it is drained out
+    # of the ring but NOT terminated (its supervisor owns the process) —
+    # and here it is the last member, so the router refuses outright
+    with pytest.raises(ValueError):
+        lc.scale_down()
+
+
+def test_scale_to_converges_and_aborts_on_failed_admission(monkeypatch):
+    monkeypatch.setattr(Backend, "call", _ok_call([]))
+    r = _router(1)
+    procs: list = []
+    gate = {"fail": False}
+
+    def verify(host, port, timeout_s=10.0):
+        if gate["fail"]:
+            raise AdmissionFailed("cold standby")
+        return {"warm": True}
+
+    lc = _lifecycle(r, procs, verify=verify)
+    rec = lc.scale_to(3)
+    assert rec["ok"] and rec["backends"] == 3 and rec["backends_before"] == 1
+    assert [a["stage"] for a in rec["actions"]] == ["admitted", "admitted"]
+    # a failed admission aborts the grow loop (no blind tight-loop retry)
+    gate["fail"] = True
+    rec = lc.scale_to(5)
+    assert not rec["ok"] and rec["backends"] == 3
+    assert rec["actions"][-1]["stage"] == "quarantined"
+    assert len(rec["actions"]) == 1
+    gate["fail"] = False
+    rec = lc.scale_to(1)
+    assert rec["ok"] and rec["backends"] == 1
+    assert all(p.terminated for p in procs[:2])
+    with pytest.raises(ValueError):
+        lc.scale_to(0)
+
+
+# ---------------------------------------------------------------------------
+# admission verification over the live verbs (protocol stub)
+# ---------------------------------------------------------------------------
+
+
+def _stub_server(replies: dict) -> int:
+    """Minimal serve-protocol stub: one connection, answers health/metrics
+    from the given payload dicts."""
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def run():
+        conn, _ = srv.accept()
+        fh = conn.makefile("rw", encoding="utf-8", newline="\n")
+        for line in fh:
+            msg = json.loads(line)
+            rep = {"id": msg.get("id"), "ok": True, **replies[msg["op"]]}
+            fh.write(json.dumps(rep) + "\n")
+            fh.flush()
+        conn.close()
+        srv.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+def test_verify_warm_accepts_warm_zero_compile_backend():
+    port = _stub_server({
+        "health": {"health": {"warm": True, "host_id": "b-ok", "replicas": 1}},
+        "metrics": {"metrics": {
+            "compile_cache_after_warmup": {"bucket_4": 0, "bucket_8": 0},
+        }},
+    })
+    facts = verify_warm("127.0.0.1", port, timeout_s=5.0)
+    assert facts["warm"] and facts["host_id"] == "b-ok"
+
+
+def test_verify_warm_rejects_cold_and_compiling_backends():
+    port = _stub_server({
+        "health": {"health": {"warm": False}},
+        "metrics": {"metrics": {}},
+    })
+    with pytest.raises(AdmissionFailed, match="warm=False"):
+        verify_warm("127.0.0.1", port, timeout_s=5.0)
+    port = _stub_server({
+        "health": {"health": {"warm": True}},
+        "metrics": {"metrics": {
+            "compile_cache_after_warmup": {"bucket_4": 2},
+        }},
+    })
+    with pytest.raises(AdmissionFailed, match="request-path compiles"):
+        verify_warm("127.0.0.1", port, timeout_s=5.0)
+    port = _stub_server({
+        "health": {"health": {"warm": True}},
+        "metrics": {"metrics": {}},  # no compile ledger at all
+    })
+    with pytest.raises(AdmissionFailed, match="no compile_cache"):
+        verify_warm("127.0.0.1", port, timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# fleet-tier autoscaler: hysteresis, guards, planner targets
+# ---------------------------------------------------------------------------
+
+
+def _scaler(calls, **kw):
+    opts = dict(min_backends=1, max_backends=3, queue_high=10.0,
+                queue_low=1.0, debounce=2, cooldown_ticks=2)
+    opts.update(kw)
+    return FleetAutoscaler(
+        lambda n: calls.append(n) or {"ok": True, "backends": n}, **opts
+    )
+
+
+def test_fleet_autoscaler_debounce_cooldown_and_bounds():
+    calls: list = []
+    a = _scaler(calls)
+    assert a.observe(50.0, 1) is None  # streak 1 of 2
+    ev = a.observe(50.0, 1)
+    assert ev["direction"] == "up" and ev["backends"] == 2 and calls == [2]
+    # cooldown eats the next two ticks even under sustained pressure
+    assert a.observe(50.0, 2) is None
+    assert a.observe(50.0, 2) is None
+    assert a.observe(50.0, 2) is None  # streak restarts post-cooldown
+    ev = a.observe(50.0, 2)
+    assert ev["direction"] == "up" and calls == [2, 3]
+    # at max_backends: no further up, streaks at the bound fire nothing
+    for _ in range(8):
+        assert a.observe(50.0, 3) is None
+    assert calls == [2, 3]
+
+
+def test_fleet_autoscaler_slo_and_burn_guard_scale_down():
+    calls: list = []
+    a = _scaler(calls, cooldown_ticks=0)
+    # low queue but SLO burning: the low streak never accumulates
+    for _ in range(6):
+        assert a.observe(0.0, 3, slo_attainment=0.9) is None
+    # low queue, healthy SLO, but burn alert firing: still refused
+    for _ in range(6):
+        assert a.observe(0.0, 3, slo_attainment=1.0, burn_alert=True) is None
+    assert calls == []
+    assert a.observe(0.0, 3, slo_attainment=1.0) is None
+    ev = a.observe(0.0, 3, slo_attainment=1.0)
+    assert ev["direction"] == "down" and calls == [2]
+    # re-anchoring: an operator's manual fleet change is respected
+    ev = None
+    for _ in range(3):
+        ev = a.observe(50.0, 1) or ev
+    assert ev["backends"] == 2 and calls[-1] == 2
+
+
+def test_fleet_autoscaler_planner_target_converges_stepwise():
+    calls: list = []
+    a = _scaler(calls, cooldown_ticks=1)
+    a.set_planner_target({"backends_needed": 3, "assumptions_sha": "sha-abc"})
+    ev = a.observe(0.0, 1)  # planner mode: no watermark debounce
+    assert ev["direction"] == "up" and ev["planner_sha"] == "sha-abc"
+    assert calls == [2]
+    assert a.observe(0.0, 2) is None  # cooldown spaces the steps
+    ev = a.observe(0.0, 2)
+    assert ev["backends"] == 3 and calls == [2, 3]
+    assert a.observe(0.0, 3) is None  # converged: nothing to do
+    assert a.observe(0.0, 3) is None
+    # planner scale-down still rides the guards
+    a.set_planner_target({"backends_needed": 1, "assumptions_sha": "sha-abc"})
+    assert a.observe(0.0, 3, burn_alert=True) is None
+    assert a.observe(0.0, 3, slo_attainment=0.5) is None
+    ev = a.observe(0.0, 3, slo_attainment=1.0)
+    assert ev["direction"] == "down" and calls[-1] == 2
+    # a planner target beyond max_backends clamps to the bound
+    a.set_planner_target({"backends_needed": 99, "assumptions_sha": "s2"})
+    a.observe(0.0, 3)  # burn the cooldown tick
+    for _ in range(4):
+        ev = a.observe(0.0, 3) or ev
+    assert a.state()["target"] <= 3
+    a.set_planner_target(None)
+    assert a.state()["planner"] is None
+
+
+def test_fleet_autoscaler_dry_run_and_validation():
+    calls: list = []
+    a = _scaler(calls, dry_run=True, cooldown_ticks=0)
+    a.observe(50.0, 1)
+    ev = a.observe(50.0, 1)
+    assert ev["dry_run"] and ev["result"] is None and calls == []
+    with pytest.raises(ValueError):
+        FleetAutoscaler(lambda n: None, min_backends=3, max_backends=2)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(lambda n: None, queue_high=1.0, queue_low=5.0)
+
+
+# ---------------------------------------------------------------------------
+# planner-target handoff: emit_target <-> load_planner_target round-trip
+# ---------------------------------------------------------------------------
+
+
+_PLAN_REC = {
+    "trace": "w.jsonl",
+    "target_rps": 100.0,
+    "p99_target_ms": 50.0,
+    "workers_per_backend": 1,
+    "sweep": [{"backends": 1, "predicted_p99_ms": 80.0, "meets_target": False},
+              {"backends": 2, "predicted_p99_ms": 30.0, "meets_target": True}],
+    "backends_needed": 2,
+}
+
+
+def test_emit_target_roundtrip_and_sha_seals_assumptions(tmp_path):
+    tgt = emit_target(_PLAN_REC)
+    assert tgt["backends_needed"] == 2 and len(tgt["assumptions_sha"]) == 64
+    p = tmp_path / "target.json"
+    p.write_text(json.dumps({"fleet_target": tgt}))
+    loaded = load_planner_target(str(p))
+    assert loaded == tgt
+    # the sha is deterministic and moves with ANY planning input
+    assert emit_target(dict(_PLAN_REC))["assumptions_sha"] == tgt["assumptions_sha"]
+    retargeted = emit_target({**_PLAN_REC, "target_rps": 200.0})
+    assert retargeted["assumptions_sha"] != tgt["assumptions_sha"]
+    # a null answer (plan unmeetable) refuses LOUDLY at consumption
+    p.write_text(json.dumps(
+        {"fleet_target": emit_target({**_PLAN_REC, "backends_needed": None})}
+    ))
+    with pytest.raises(ValueError, match="no actionable backends_needed"):
+        load_planner_target(str(p))
+
+
+def _phase(p50):
+    return {"n": 500, "mean_ms": p50, "p50_ms": p50, "p95_ms": p50 * 1.2,
+            "p99_ms": p50 * 1.4, "max_ms": p50 * 1.6}
+
+
+def test_plan_main_emit_target_cli_roundtrip(tmp_path, capsys):
+    """``plan --emit-target`` writes the exact record the autoscaler's
+    loader consumes — the full CLI round-trip the closed loop rides."""
+    from qdml_tpu.telemetry.capacity import plan_main
+
+    summary = {
+        "kind": "serve_summary", "n_requests": 2000, "rps": 100.0,
+        "offered_rps": 101.0,
+        "arrival": {"process": "poisson", "burstiness": 1.0},
+        "latency_ms": {"mean_ms": 21.0, "p50_ms": 21.0, "p95_ms": 29.0,
+                       "p99_ms": 32.0, "max_ms": 42.0},
+        "phases": {"batch_wait": _phase(4.0), "queue_wait": _phase(1.0),
+                   "compute": _phase(10.0), "fetch": _phase(2.0),
+                   "wire": _phase(3.0), "pick": _phase(0.5)},
+        "trace": {"reconciliation": {"mean_unattributed_ms": 0.5}},
+    }
+    w = tmp_path / "traced.jsonl"
+    w.write_text(json.dumps(summary) + "\n")
+    out = tmp_path / "target.json"
+    rc = plan_main([
+        f"--trace={w}", "--target-rps=40", "--p99-ms=200",
+        "--max-backends=4", f"--emit-target={out}",
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    tgt = load_planner_target(str(out))
+    assert isinstance(tgt["backends_needed"], int)
+    assert tgt["trace"] == str(w) and tgt["target_rps"] == 40.0
+    assert len(tgt["assumptions_sha"]) == 64
+
+
+# ---------------------------------------------------------------------------
+# the {"op": "fleet"} wire verb + poller attachments
+# ---------------------------------------------------------------------------
+
+
+class _FakeLifecycle:
+    """scale_to semantics without processes: converges up to max_ok."""
+
+    def __init__(self, router, max_ok=3):
+        self.router = router
+        self.max_ok = max_ok
+
+    def status(self):
+        return {"backends": len(self.router.backends), "lifecycle": {}}
+
+    def scale_to(self, n):
+        got = min(int(n), self.max_ok)
+        return {"backends_before": len(self.router.backends), "backends": got,
+                "target": int(n), "ok": got == int(n), "actions": []}
+
+
+@pytest.fixture()
+def front(monkeypatch):
+    """Two route_async front doors over fake-call routers: one lifecycle-
+    less, one with a fake lifecycle manager."""
+    monkeypatch.setattr(Backend, "call", _ok_call([]))
+    aloop = asyncio.new_event_loop()
+    t = threading.Thread(target=aloop.run_forever, daemon=True)
+    t.start()
+    routers, ports, tasks = [], [], []
+    for lc_factory in (lambda r: None, lambda r: _FakeLifecycle(r)):
+        r = _router(2)
+        ready: Future = Future()
+        task = asyncio.run_coroutine_threadsafe(
+            route_async(r, "127.0.0.1", 0, ready, lifecycle=lc_factory(r)),
+            aloop,
+        )
+        ports.append(ready.result(timeout=10.0))
+        routers.append(r)
+        tasks.append(task)
+    yield routers, ports
+    for task in tasks:
+        task.cancel()
+    aloop.call_soon_threadsafe(aloop.stop)
+    t.join(timeout=5.0)
+
+
+def test_fleet_verb_status_form_always_answers(front):
+    routers, (plain_port, elastic_port) = front
+    with ServeClient("127.0.0.1", plain_port, timeout_s=5.0, retries=0) as c:
+        rep = c.fleet()
+        assert rep["ok"] and rep["fleet"]["elastic"] is False
+        assert rep["fleet"]["backends"] == 2
+    with ServeClient("127.0.0.1", elastic_port, timeout_s=5.0, retries=0) as c:
+        rep = c.fleet()
+        assert rep["ok"] and rep["fleet"]["elastic"] is True
+
+
+def test_fleet_verb_scaling_form_typed_replies(front):
+    routers, (plain_port, elastic_port) = front
+    with ServeClient("127.0.0.1", plain_port, timeout_s=5.0, retries=0) as c:
+        rep = c.fleet(backends=3)
+        assert not rep["ok"]
+        assert rep["reason"].startswith("fleet_scale_unavailable")
+    with ServeClient("127.0.0.1", elastic_port, timeout_s=5.0, retries=0) as c:
+        rep = c.fleet(backends=3)
+        assert rep["ok"] and rep["fleet"]["backends"] == 3
+        rep = c.fleet(backends=9)  # beyond the fake's convergence ceiling
+        assert not rep["ok"]
+        assert rep["reason"].startswith("fleet_scale_failed")
+        rep = c.fleet(backends=0)  # still a replica-axis-free verb: typed
+        assert rep["ok"] is False or rep["fleet"]["target"] == 0
+
+
+def test_socket_poller_speaks_fleet_verb(front):
+    from qdml_tpu.control.loop import SocketPoller
+
+    routers, (plain_port, elastic_port) = front
+    p = SocketPoller("127.0.0.1", elastic_port, timeout_s=5.0)
+    assert p.fleet()["elastic"] is True
+    assert p.fleet(3)["backends"] == 3
+    with pytest.raises(RuntimeError, match="fleet_scale_failed"):
+        p.fleet(9)
+    p_plain = SocketPoller("127.0.0.1", plain_port, timeout_s=5.0)
+    with pytest.raises(RuntimeError, match="fleet_scale_unavailable"):
+        p_plain.fleet(3)
+
+
+def test_fleet_poller_fleet_axis(monkeypatch):
+    monkeypatch.setattr(Backend, "call", _ok_call([]))
+    r = _router(2)
+    bare = FleetPoller(r)
+    assert bare.fleet()["backends"] == 2
+    with pytest.raises(RuntimeError, match="fleet_scale_unavailable"):
+        bare.fleet(3)
+    armed = FleetPoller(r, lifecycle=_FakeLifecycle(r))
+    assert armed.fleet(3)["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# monitor: membership-derived events
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+class _MemberPoller:
+    def __init__(self, bids):
+        self.bids = list(bids)
+
+    def health(self):
+        return {
+            "warm": True, "quarantined": [], "swap_epoch": 0,
+            "per_backend": {
+                bid: {"start_seq": 1, "uptime_s": 5.0, "poll_ok": True,
+                      "state": "closed"}
+                for bid in self.bids
+            },
+        }
+
+    def metrics(self):
+        return {"completed": 0, "shed": {}, "faults": {}, "restarts": 0}
+
+
+def test_monitor_derives_membership_events():
+    from qdml_tpu.telemetry.timeseries import MonitorScraper
+
+    clk = _Clock()
+    p = _MemberPoller(["b0", "b1"])
+    s = MonitorScraper(p, interval_s=1.0, clock=clk)
+    s.scrape_once()  # first scrape seeds silently: boot set != admissions
+    assert not any(e["event"] == "backend_admitted" for e in s.events)
+    clk.t += 1.0
+    p.bids.append("b2")
+    s.scrape_once()
+    admitted = [e for e in s.events if e["event"] == "backend_admitted"]
+    assert [e["backend"] for e in admitted] == ["b2"]
+    clk.t += 1.0
+    p.bids.remove("b0")
+    s.scrape_once()
+    retired = [e for e in s.events if e["event"] == "backend_retired"]
+    assert [e["backend"] for e in retired] == ["b0"]
+    assert "b0" not in s._prev_backends  # diff state dropped on retirement
+    clk.t += 1.0
+    p.bids.append("b0")  # same id re-admitted later: diffs fresh
+    s.scrape_once()
+    admitted = [e for e in s.events if e["event"] == "backend_admitted"]
+    assert [e["backend"] for e in admitted] == ["b2", "b0"]
+    restarts = [e for e in s.events if e["event"] == "backend_restart"]
+    assert restarts == []  # the re-admission is not a restart
+
+
+# ---------------------------------------------------------------------------
+# graftlint: the new lifecycle/ring mutable state is lock-disciplined
+# ---------------------------------------------------------------------------
+
+
+def test_lock_map_covers_lifecycle_and_ring_state():
+    import ast
+
+    from qdml_tpu.analysis.engine import ModuleContext
+    from qdml_tpu.analysis.rules import rule_serve_lock_discipline
+
+    lifecycle_src = (
+        "import threading\n"
+        "class BackendLifecycle:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._members = {}\n"
+        "        self._procs = {}\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            return dict(self._members), list(self._procs)\n"
+        "    def racy_members(self):\n"
+        "        return self._members.get('x')\n"
+        "    def racy_procs(self):\n"
+        "        return list(self._procs)\n"
+    )
+    path = "qdml_tpu/fleet/lifecycle.py"
+    ctx = ModuleContext(path, path, lifecycle_src, ast.parse(lifecycle_src))
+    assert {f.line for f in rule_serve_lock_discipline(ctx)} == {11, 13}
+
+    ring_src = (
+        "import threading\n"
+        "class FleetRouter:\n"
+        "    def __init__(self):\n"
+        "        self._ring_lock = threading.Lock()\n"
+        "        self._ring = []\n"
+        "        self._ring_idx = []\n"
+        "    def snapshot(self):\n"
+        "        with self._ring_lock:\n"
+        "            return self._ring, self._ring_idx\n"
+        "    def racy(self):\n"
+        "        return len(self._ring)\n"
+    )
+    path = "qdml_tpu/fleet/router.py"
+    ctx = ModuleContext(path, path, ring_src, ast.parse(ring_src))
+    assert {f.line for f in rule_serve_lock_discipline(ctx)} == {11}
+    # the real modules are clean (also pinned by the repo-wide lint gate)
+    other = ModuleContext("other/f.py", "other/f.py", ring_src,
+                          ast.parse(ring_src))
+    assert rule_serve_lock_discipline(other) == []
