@@ -152,6 +152,18 @@ LOCK_MAP: dict[str, dict[str, dict[str, str]]] = {
         # the post-deploy rollback watch window
         "Deployer": {"_watch": "_lock"},
     },
+    # event-spine ring state (docs/TELEMETRY.md "event spine"): publishers
+    # are request workers, supervisors and poll threads while tails come
+    # from the asyncio verb handlers — an unlocked append/evict pair could
+    # tear seq/dropped accounting and make loss silent, the one thing the
+    # spine exists to prevent
+    "qdml_tpu/telemetry/events.py": {
+        "EventBus": {
+            "_ring": "_lock",
+            "_seq": "_lock",
+            "_dropped": "_lock",
+        },
+    },
 }
 
 # (file, ClassName.method) host-side hot paths audited for device->host

@@ -69,12 +69,24 @@ The reference has no CLI at all — hardcoded ``__main__`` blocks
                                   [--interval=S] [--out=FILE.jsonl]
                                   [--slo-target=0.99] [--threshold=8]
                                   # flight deck (docs/TELEMETRY.md): scrape
-                                  # health/metrics (NEVER inference), window
-                                  # cumulative counters into rates, multi-
-                                  # window SLO error-budget burn alerting;
-                                  # monitor --render --current=F.jsonl
+                                  # health/metrics/events (NEVER inference),
+                                  # window cumulative counters into rates,
+                                  # multi-window SLO error-budget burn
+                                  # alerting; monitor --render --current=F
                                   # [--events=stack.jsonl] renders the
-                                  # correlated event timeline
+                                  # correlated event timeline; --attach
+                                  # closes the hands-off loop: each window
+                                  # ticks a fleet autoscaler acting through
+                                  # {"op": "fleet"}, with reconnect-backoff
+                                  # and typed give-ups (docs/CONTROL.md)
+    python -m qdml_tpu.cli events --addr=HOST:PORT [--follow]
+                                  [--interval=S] [--limit=N]
+                                  [--min-severity=debug] [--kinds=a,b]
+                                  # event-spine tail (docs/TELEMETRY.md
+                                  # "event spine"): the unified envelope
+                                  # stream of a RUNNING serve/route process
+                                  # — cursor-resumable, restart-surviving,
+                                  # explicit loss ledger; --follow streams
     python -m qdml_tpu.cli plan   --trace=W.jsonl[,..] (--validate |
                                   --target-rps=X --p99-ms=Y
                                   [--emit-target=T.json])
@@ -128,8 +140,8 @@ _COMMANDS = (
     "loadgen",
     "control",
     "route",
-)  # "report"/"lint"/"monitor"/"plan" dispatch before config parsing
-# (host-side: no jax, no workdir)
+)  # "report"/"lint"/"monitor"/"events"/"plan" dispatch before config
+# parsing (host-side: no jax, no workdir)
 
 _PASSTHROUGH = (  # command args, not config overrides
     "--out=",
@@ -216,11 +228,19 @@ def main(argv: list[str] | None = None) -> int:
         return lint_main(argv[1:])
     if argv[0] == "monitor":
         # Host-side scraper: attaches to a RUNNING serve/route address over
-        # the cheap health/metrics verbs only — no jax, no config parsing,
-        # never an inference request (docs/TELEMETRY.md "flight deck").
+        # the cheap health/metrics/events verbs only — no jax, no config
+        # parsing, never an inference request (docs/TELEMETRY.md "flight
+        # deck"; --attach drives the hands-off fleet loop, docs/CONTROL.md).
         from qdml_tpu.telemetry.timeseries import monitor_main
 
         return monitor_main(argv[1:])
+    if argv[0] == "events":
+        # Host-side event-spine tail: cursor-polls a RUNNING serve/route
+        # address's {"op": "events"} verb — no jax, no config parsing
+        # (docs/TELEMETRY.md "event spine").
+        from qdml_tpu.telemetry.events import events_main
+
+        return events_main(argv[1:])
     if argv[0] == "plan":
         # Host-side capacity planner over COMMITTED trace windows: exit
         # code is the planner-validation gate (docs/TELEMETRY.md).

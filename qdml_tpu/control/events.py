@@ -10,14 +10,19 @@ here.
 
 from __future__ import annotations
 
+from qdml_tpu.telemetry.events import publish
 from qdml_tpu.telemetry.spans import get_sink
 
 
 def emit_record(sink, name: str, **payload) -> dict:
     """Emit one ``counters`` record named ``name`` to ``sink`` (or the
     process-global sink when ``sink`` is None); returns the payload either
-    way, so callers can use the emitted record as their return value."""
+    way, so callers can use the emitted record as their return value.
+    Every record also lands on the process-global event spine
+    (telemetry/events.py) — the sink is the durable JSONL, the bus feeds
+    the live ``{"op": "events"}`` tail."""
     target = sink if sink is not None else get_sink()
     if target is not None and getattr(target, "active", False):
         target.emit("counters", name=name, **payload)
+    publish(name, tier="control", **payload)
     return payload

@@ -12,7 +12,13 @@ fleet-tier additions:
   (telemetry/burnrate.py), scale-DOWN is refused outright: retiring
   capacity during an SLO-budget burn converts an incident into an outage.
   A burn alert alone never spawns either (it may be one stuck host the
-  router is already ejecting — queue depth is the honest grow signal).
+  router is already ejecting, and the tiny-model serving tier recovers by
+  failover faster than queue depth can build). The one exception is a burn
+  WITH a provably short-handed fleet: when ``backends_live`` has fallen
+  below the provisioned membership while an alert fires, the fleet is
+  demonstrably down a host AND paging for it — that pair is the honest
+  grow signal, and the spawn decision carries the alert's episode id so
+  the event stream records which page drove it.
 - **planner targets** — a ``plan --emit-target`` JSON
   (telemetry/capacity.py) pins the desired backend count directly: the
   policy converges to the planned count one cooldown-spaced step at a
@@ -95,6 +101,7 @@ class FleetAutoscaler:
         self._low_streak = 0
         self._cooldown = 0
         self._planner: dict | None = None
+        self._decisions = 0
 
     def set_planner_target(self, target: dict | None) -> None:
         """Pin (or clear) a ``plan --emit-target`` record: the policy then
@@ -112,13 +119,27 @@ class FleetAutoscaler:
         backends: int,
         slo_attainment: float | None = None,
         burn_alert: bool = False,
+        alert_episode: str | None = None,
+        backends_live: int | None = None,
     ) -> dict | None:
         """One policy tick over the monitor's windowed signals. Returns the
         emitted ``fleet_scale_event`` payload when a decision fired, else
-        None. ``backends`` is the OBSERVED serving count — the policy
-        re-anchors to it each tick, so an operator's manual fleet-scale is
-        respected, exactly like the replica scaler."""
+        None. ``backends`` is the OBSERVED provisioned membership — the
+        policy re-anchors to it each tick, so an operator's manual
+        fleet-scale is respected, exactly like the replica scaler.
+        ``backends_live`` is the router's live (non-ejected) count when the
+        caller has it: a firing burn alert combined with
+        ``backends_live < backends`` counts as grow pressure (the fleet is
+        provably short-handed AND paging), rides the same debounce, and the
+        decision carries ``alert_episode`` — the burn alert's episode id —
+        so the event stream answers "which alert drove this scale-up" by
+        join, not by timestamp proximity."""
         slo_ok = slo_attainment is None or slo_attainment >= SLO_FLOOR
+        short_handed = (
+            burn_alert
+            and backends_live is not None
+            and int(backends_live) < max(1, int(backends))
+        )
         with self._lock:
             self._target = max(1, int(backends))
             if self._cooldown > 0:
@@ -134,7 +155,7 @@ class FleetAutoscaler:
                 elif desired < self._target and slo_ok and not burn_alert:
                     direction = "down"
             else:
-                if queue_depth > self.queue_high:
+                if queue_depth > self.queue_high or short_handed:
                     self._high_streak += 1
                     self._low_streak = 0
                 elif queue_depth < self.queue_low and slo_ok and not burn_alert:
@@ -158,12 +179,18 @@ class FleetAutoscaler:
             self._target = new_target
             self._high_streak = self._low_streak = 0
             self._cooldown = self.cooldown_ticks
+            self._decisions += 1
+            decision = f"scale#{self._decisions}"
         rec = None if self.dry_run else self._scale_fn(new_target)
         return emit_record(
             self._sink, "fleet_scale_event",
             action="fleet_scale", direction=direction, backends=new_target,
-            backends_before=int(backends), queue_depth=float(queue_depth),
+            backends_before=int(backends),
+            backends_live=None if backends_live is None else int(backends_live),
+            queue_depth=float(queue_depth),
             slo_attainment=slo_attainment, burn_alert=bool(burn_alert),
+            alert_episode=alert_episode if burn_alert else None,
+            decision=decision,
             planner_sha=(planner or {}).get("assumptions_sha"),
             dry_run=self.dry_run, result=rec,
         )

@@ -121,6 +121,15 @@ class SocketPoller:
         what the continuous monitor scrapes between metrics polls."""
         return self._verb({"op": "health"})["health"]
 
+    def events(self, cursor: dict | None = None, limit: int = 512) -> dict:
+        """The event-spine tail (docs/TELEMETRY.md "event spine"): the
+        monitor's third sanctioned verb. Pass the previous reply's cursor
+        back to resume with no gaps and no duplicates."""
+        msg: dict = {"op": "events", "limit": int(limit)}
+        if cursor is not None:
+            msg["cursor"] = cursor
+        return self._verb(msg)["events"]
+
     def swap(self, tags: dict) -> dict:
         return self._verb({"op": "swap", "tags": tags})["swap"]
 

@@ -108,6 +108,21 @@ async def _handle_front(
                     # loop, like the serve tier's histogram merge
                     view = await aloop.run_in_executor(None, router.live_metrics)
                     rep = {"id": msg.get("id"), "ok": True, "metrics": view}
+                elif op == "events":
+                    # aggregated event-spine tail (docs/TELEMETRY.md "event
+                    # spine"): the router's own events plus every live
+                    # backend's, per-source cursors passed back verbatim.
+                    # Off the event loop — it round-trips every backend.
+                    cur = msg.get("cursor")
+                    if cur is not None and not isinstance(cur, dict):
+                        raise ValueError(
+                            f"events cursor must be an object, got {cur!r}"
+                        )
+                    lim = int(msg.get("limit") or 512)
+                    view = await aloop.run_in_executor(
+                        None, router.live_events, cur, lim
+                    )
+                    rep = {"id": msg.get("id"), "ok": True, "events": view}
                 elif op == "swap":
                     tags = msg.get("tags")
                     if tags is not None and not (

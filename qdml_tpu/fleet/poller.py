@@ -53,6 +53,12 @@ class FleetPoller:
         the router's ``{"op": "health"}`` verb serves."""
         return self.router.health()
 
+    def events(self, cursor: dict | None = None, limit: int = 512) -> dict:
+        """The aggregated event-spine tail (router's own + every live
+        backend's, per-source cursors) — the same payload the router's
+        ``{"op": "events"}`` verb serves."""
+        return self.router.live_events(cursor, limit=limit)
+
     def swap(self, tags: dict) -> dict:
         rec = self.router.swap_fanout(tags)
         if not rec["ok"]:

@@ -68,6 +68,8 @@ from typing import Callable
 from qdml_tpu.serve.breaker import CLOSED, HALF_OPEN, OPEN
 from qdml_tpu.serve.client import ServeClient, ServeClientError
 from qdml_tpu.telemetry import Histogram
+from qdml_tpu.telemetry.events import ensure_bus
+from qdml_tpu.telemetry.events import publish as publish_event
 from qdml_tpu.telemetry.spans import get_sink
 from qdml_tpu.telemetry.tracing import trace_sampled
 
@@ -79,11 +81,15 @@ _RING_VNODES = 64  # virtual nodes per backend on the consistent-hash ring
 
 
 def _emit_event(name: str, **fields) -> None:
-    """Structured fleet event (backend_ejected / backend_readmitted) into the
-    run's telemetry stream, if one is active."""
+    """Structured fleet event (backend_ejected / backend_readmitted /
+    fleet_lifecycle / router_swap) into the run's telemetry stream, if one
+    is active — and onto the process-global event spine always, so the
+    front door's ``{"op": "events"}`` tail sees the router tier's own
+    events alongside the per-backend ones it aggregates."""
     sink = get_sink()
     if sink is not None and getattr(sink, "active", False):
         sink.emit("counters", name=name, **fields)
+    publish_event(name, tier="router", **fields)
 
 
 def _hash_point(key: str) -> int:
@@ -1035,6 +1041,56 @@ class FleetRouter:
             "router": self.router_summary(),
             "per_backend": rows,
         }
+
+    def live_events(self, cursor: dict | None = None, limit: int = 512) -> dict:
+        """The front ``{"op": "events"}`` payload: the router process's own
+        spine tail plus every live backend's, aggregated.
+
+        ``cursor`` is the previous reply's ``cursor`` block passed back
+        verbatim — per-source ``{"start_seq", "seq"}`` pairs keyed
+        ``"router"`` / backend host_id, so each source's tail survives ITS
+        OWN restarts independently (an epoch-mismatched pair restarts that
+        source from its buffer head; the others are untouched). Events
+        concatenate per source in seq order — per-backend ordering is
+        preserved, cross-backend order is by source, not wall clock (the
+        envelopes carry ``ts`` for a reader that wants a merged timeline).
+        ``dropped``/``lost`` sum the per-source loss ledgers: loss anywhere
+        in the fleet is visible at the front door."""
+        cursor = cursor if isinstance(cursor, dict) else {}
+        events: list[dict] = []
+        cursors: dict[str, dict] = {}
+        dropped = lost = 0
+
+        def fold(source: str, tail: dict) -> None:
+            nonlocal dropped, lost
+            for e in tail.get("events") or []:
+                events.append({**e, "source": source})
+            cursors[source] = {"start_seq": tail.get("start_seq"),
+                               "seq": tail.get("next_seq")}
+            dropped += int(tail.get("dropped") or 0)
+            lost += int(tail.get("lost") or 0)
+
+        fold("router", ensure_bus().tail(cursor.get("router"), limit=limit))
+        for b in self.backends:
+            if not b.state.live():
+                continue
+            try:
+                rep = b.call({
+                    "op": "events", "cursor": cursor.get(b.host_id),
+                    "limit": int(limit),
+                })
+                tail = rep.get("events") or {}
+            except _FORWARD_ERRORS as e:
+                if b.state.record_failure():
+                    _emit_event(
+                        "backend_ejected", backend=b.host_id, addr=b.addr,
+                        reason=f"events: {type(e).__name__}",
+                    )
+                continue
+            b.state.record_success()
+            fold(b.host_id, tail)
+        return {"fleet": True, "events": events, "cursor": cursors,
+                "dropped": dropped, "lost": lost}
 
     def live_metrics(self) -> dict:
         """The front ``{"op": "metrics"}`` payload: every live backend's

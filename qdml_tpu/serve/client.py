@@ -250,6 +250,26 @@ class ServeClient:
     def metrics(self, timeout_s: float | None = None) -> dict:
         return self.call({"op": "metrics"}, timeout_s=timeout_s)
 
+    def events(
+        self,
+        cursor: dict | None = None,
+        limit: int | None = None,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """Event-spine tail (docs/TELEMETRY.md "event spine"): everything
+        the endpoint published since ``cursor`` (None = from the buffer
+        head), plus the explicit loss ledger. Resume by passing the reply's
+        cursor back — ``{"start_seq", "seq"}`` against a serve host, the
+        per-source ``cursor`` block verbatim against a router. Idempotent
+        (a pure read): retries are safe, the cursor only advances when the
+        CALLER passes the new one back."""
+        msg: dict = {"op": "events"}
+        if cursor is not None:
+            msg["cursor"] = cursor
+        if limit is not None:
+            msg["limit"] = int(limit)
+        return self.call(msg, timeout_s=timeout_s)
+
     def swap(self, tags: dict | None = None, timeout_s: float | None = None) -> dict:
         # NOT idempotent in the retry sense: a swap that timed out may have
         # landed — the caller must re-inspect (health.swap_epoch) rather

@@ -75,16 +75,21 @@ from qdml_tpu.serve.types import (
     Prediction,
     Request,
 )
+from qdml_tpu.telemetry.events import ensure_bus
+from qdml_tpu.telemetry.events import publish as publish_event
 from qdml_tpu.telemetry.spans import get_sink
 from qdml_tpu.telemetry.tracing import TraceContext, trace_sampled
 
 
 def _emit_event(name: str, **fields) -> None:
     """Structured fleet event (replica_restarted / replica_quarantined /
-    supervisor_error) into the run's telemetry stream, if one is active."""
+    supervisor_error) into the run's telemetry stream, if one is active —
+    and onto the process-global event spine always (the ``{"op": "events"}``
+    tail works sink or no sink)."""
     sink = get_sink()
     if sink is not None and getattr(sink, "active", False):
         sink.emit("counters", name=name, **fields)
+    publish_event(name, tier="serve", **fields)
 
 
 class ExitCoordinator:
@@ -1082,6 +1087,28 @@ async def _handle(
                 if ident is not None:
                     metrics_view.update(ident)  # same identity block as health
                 reply = {"id": msg.get("id"), "ok": True, "metrics": metrics_view}
+                writer.write((json.dumps(reply) + "\n").encode())
+                await writer.drain()
+                continue
+            if isinstance(msg, dict) and msg.get("op") == "events":
+                # event-spine tail verb (docs/TELEMETRY.md "event spine"):
+                # everything this process published since the caller's
+                # cursor, with the explicit loss ledger. Cheap by
+                # construction (bounded ring copy under one lock), so it
+                # answers inline like health — the monitor's third verb.
+                try:
+                    cur = msg.get("cursor")
+                    if cur is not None and not isinstance(cur, dict):
+                        raise ValueError(
+                            f"events cursor must be an object, got {cur!r}"
+                        )
+                    tail = ensure_bus().tail(
+                        cur, limit=int(msg.get("limit") or 512)
+                    )
+                    reply = {"id": msg.get("id"), "ok": True, "events": tail}
+                except (TypeError, ValueError) as e:
+                    reply = {"id": msg.get("id"), "ok": False,
+                             "reason": f"bad_request: {e}"}
                 writer.write((json.dumps(reply) + "\n").encode())
                 await writer.drain()
                 continue

@@ -23,7 +23,11 @@ metrics artifact is ever orphaned from its provenance again:
   multi-window SLO error-budget burn-rate alerting with an event-correlated
   timeline;
 - :mod:`qdml_tpu.telemetry.capacity` — the ``qdml-tpu plan`` trace-replay
-  capacity planner, validated against committed dryrun windows.
+  capacity planner, validated against committed dryrun windows;
+- :mod:`qdml_tpu.telemetry.events` — the event spine: every subsystem's
+  structured events on one process-global :class:`EventBus` (common
+  envelope, bounded ring, explicit drop counter), tailed live over the
+  wire via the ``{"op": "events"}`` verb / ``qdml-tpu events``.
 
 The long-standing ``MetricsLogger`` (``qdml_tpu.utils.metrics``), ``StepTimer``
 and ``trace()`` (``qdml_tpu.utils.profiling``) are thin facades over this
@@ -48,6 +52,13 @@ from qdml_tpu.telemetry.manifest import (  # noqa: F401
     config_hash,
     effective_knobs,
     run_manifest,
+)
+from qdml_tpu.telemetry.events import (  # noqa: F401
+    EventBus,
+    ensure_bus,
+    get_bus,
+    install_bus,
+    publish,
 )
 from qdml_tpu.telemetry.spans import (  # noqa: F401
     get_sink,

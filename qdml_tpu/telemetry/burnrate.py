@@ -148,6 +148,12 @@ class BurnRateRule:
         return {
             "signal": self.signal,
             "state": state,
+            # alert-episode id: one per fire, shared by the resolve that
+            # closes it. The event spine hoists it as the ``episode``
+            # correlation key, and the hands-off autoscaler stamps it onto
+            # the scale decision it triggers — "which alert caused this
+            # scale-up" is a join on this id, not a timestamp guess.
+            "episode": f"{self.signal}#{self.fired_count}",
             "t_s": round(t, 4),
             "fast_burn": round(fast, 3),
             "slow_burn": round(slow, 3),
@@ -233,6 +239,16 @@ class BurnAlerter:
             for sig, r in self.rules.items()
             if r.peak_fast > 0 or r.peak_slow > 0
         }
+
+    def firing(self) -> list[dict]:
+        """Currently-latched alerts as ``[{"signal", "episode"}]`` — the
+        open episode ids the hands-off attachment stamps onto any scale
+        decision made while they burn (telemetry/attach.py)."""
+        return [
+            {"signal": sig, "episode": f"{sig}#{r.fired_count}"}
+            for sig, r in self.rules.items()
+            if r.firing
+        ]
 
 
 # ---------------------------------------------------------------------------

@@ -1132,6 +1132,73 @@ def build_report_data(
                      "current": planner.get("max_p99_ratio"),
                      "delta_pct": None}
                 )
+        # Event-spine loss ledger (telemetry/events.py): a monitor that
+        # tailed the spine commits event_drops = ring evictions + cursor
+        # lost. Zero means the committed stream saw EVERY envelope the
+        # fleet published — any loss voids the correlation evidence below,
+        # so this arms whenever the summary carries the counter.
+        drops = cur_mon.get("event_drops")
+        if drops is not None:
+            d_ok = int(drops) == 0
+            gates.append(
+                {"metric": "monitor.event_drops", "kind": "monitor",
+                 "baseline": 0, "current": int(drops), "delta_pct": None,
+                 "status": "ok" if d_ok else "regression"}
+            )
+            spine = cur_mon.get("spine") or {}
+            lines.append(
+                f"- event spine: {spine.get('events', 0)} envelope(s) "
+                f"tailed, loss ledger {int(drops)} "
+                f"(ring {spine.get('ring_dropped', 0)} / cursor "
+                f"{spine.get('cursor_lost', 0)}) "
+                + ("ok" if d_ok else "**REGRESSION**")
+            )
+            if not d_ok:
+                monitor_failed = True
+                regressions.append(
+                    {"metric": "monitor.event_drops", "baseline": 0,
+                     "current": int(drops), "delta_pct": None}
+                )
+        # Hands-off loop (telemetry/attach.py): the attachment must never
+        # have given up, every decision made under a burn alert must carry
+        # the alert-episode id (the by-id join between monitor_alert and
+        # fleet_scale_event), and when the dryrun EXPECTS an alert-driven
+        # scale-up (expect.scale_up_correlated) at least one up-decision
+        # must actually be stamped with an episode.
+        hands = cur_mon.get("handsoff")
+        if isinstance(hands, dict):
+            scale_events = hands.get("scale_events") or []
+            uncorrelated = [
+                e for e in scale_events
+                if e.get("burn_alert") and not e.get("alert_episode")
+            ]
+            corr_ups = [
+                e for e in scale_events
+                if e.get("direction") == "up" and e.get("alert_episode")
+            ]
+            h_ok = hands.get("give_up") is None and not uncorrelated
+            if expect.get("scale_up_correlated") and not corr_ups:
+                h_ok = False
+            gates.append(
+                {"metric": "monitor.handsoff", "kind": "monitor",
+                 "baseline": None, "current": len(scale_events),
+                 "delta_pct": None,
+                 "status": "ok" if h_ok else "regression"}
+            )
+            lines.append(
+                f"- hands-off loop: {hands.get('ticks', 0)} tick(s), "
+                f"{len(scale_events)} scale decision(s) "
+                f"({len(corr_ups)} alert-correlated up), "
+                f"{hands.get('reattaches', 0)} reattach(es), give-up "
+                f"{'none' if hands.get('give_up') is None else hands['give_up'].get('reason')} "
+                + ("ok" if h_ok else "**REGRESSION**")
+            )
+            if not h_ok:
+                monitor_failed = True
+                regressions.append(
+                    {"metric": "monitor.handsoff", "baseline": None,
+                     "current": len(scale_events), "delta_pct": None}
+                )
 
     # Roofline section: achieved-vs-roofline fraction per train sub-bench
     # (bench.py details.*.roofline.fraction — telemetry/cost.py). The sign is

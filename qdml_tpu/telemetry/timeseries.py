@@ -6,11 +6,13 @@ tier aggregates exact counters — but both are consumed once, at end of
 run. This module watches a LIVE serve/route address continuously:
 
 - **scrape discipline**: only the cheap observability verbs, ever —
-  ``{"op": "health"}`` (1 Hz contract, no histogram merges) and
-  ``{"op": "metrics"}`` (exact merged counters). The monitor never sends
-  an inference request, so an attached monitor provably leaves the request
-  path alone (the dryrun pins an all-zero request-path compile delta and a
-  backend counter audit, scripts/monitor_dryrun.py);
+  ``{"op": "health"}`` (1 Hz contract, no histogram merges),
+  ``{"op": "metrics"}`` (exact merged counters), and — when event tailing
+  is on — ``{"op": "events"}`` (the cursor tail over the event spine,
+  telemetry/events.py). The monitor never sends an inference request, so
+  an attached monitor provably leaves the request path alone (the dryruns
+  pin an all-zero request-path compile delta and a backend counter audit,
+  scripts/monitor_dryrun.py, scripts/live_fleet_dryrun.py);
 - **windowing**: cumulative counters are DIFFERENCED between consecutive
   scrapes into fixed-width windows (the PR-10 snapshot-differencing
   pattern the FleetController uses), through :func:`counter_delta` — the
@@ -41,6 +43,8 @@ import json
 import threading
 import time
 from collections import deque
+
+from qdml_tpu.telemetry.events import publish as publish_event
 
 
 def counter_delta(prev, cur) -> tuple[float, bool]:
@@ -167,6 +171,7 @@ class MonitorScraper:
         alerter=None,
         ring: int = 512,
         clock=time.monotonic,
+        tail_events: bool = False,
     ):
         self.poller = poller
         self.sink = sink
@@ -188,12 +193,29 @@ class MonitorScraper:
         self._prev_breaker_states: dict[str, str] = {}
         self._prev_swap_epoch: int | None = None
         self._prev_quarantined = 0
+        # event-spine tail state (telemetry/events.py): the cursor is the
+        # poller's verbatim reply cursor — per-source ``(start_seq, seq)``
+        # pairs from a router, one pair from a single host — so resume after
+        # a reconnect (or a backend restart) has no gaps and no duplicates.
+        # The loss ledger is the report's always-armed zero-loss gate:
+        # event_drops tracks the endpoints' cumulative ring evictions,
+        # events_lost the evictions that lapped THIS cursor specifically.
+        self.tail_events = bool(tail_events)
+        self.events_cursor: dict | None = None
+        self.events_seen = 0
+        self.event_drops = 0
+        self.events_lost = 0
 
     # -- emission ------------------------------------------------------------
 
     def _emit(self, kind: str, **payload) -> dict:
         if self.sink is not None and getattr(self.sink, "active", True):
             self.sink.emit(kind, **payload)
+        if kind != "spine_event":
+            # monitor records join the event spine too — but a tailed
+            # envelope must NOT be re-published: a monitor co-resident with
+            # its router would echo the spine into itself forever
+            publish_event(kind, tier="monitor", **payload)
         return payload
 
     def mark(self, tag: str) -> None:
@@ -392,15 +414,59 @@ class MonitorScraper:
             ),
             "queue_depth": int(h.get("queue_depth") or 0),
             "replicas": replicas,
+            "backends": h.get("backends"),
             "backends_live": h.get("backends_live"),
             "swap_epoch": h.get("swap_epoch"),
             "resets": resets or None,
             "burn": burn or None,
             "alerts": [a["signal"] for a in fired] or None,
         }
+        if self.tail_events:
+            spine = self.scrape_events()
+            rec["spine"] = {
+                "events": len(spine),
+                "event_drops": self.event_drops,
+                "events_lost": self.events_lost,
+            }
         self.ring.add(rec)
         self._emit("monitor_timeseries", **rec)
         return rec
+
+    def scrape_events(self) -> list[dict]:
+        """Tail the endpoint's event spine from the last seen cursor — the
+        third and last sanctioned scrape verb (``{"op": "events"}``). Each
+        received envelope re-emits into the monitor stream as a
+        ``spine_event`` record (nested under ``ev`` — envelopes carry their
+        own ``kind``/``ts``), and the reply's loss ledger folds into
+        ``event_drops``/``events_lost``. A poller without an ``events``
+        verb downgrades to the two-verb scrape silently."""
+        if not hasattr(self.poller, "events"):
+            return []
+        try:
+            t = self.poller.events(self.events_cursor)
+        except Exception as e:  # lint: disable=broad-except(the events tail must survive its target restarting mid-scrape exactly like health/metrics: the failed poll is the observation, and the kept cursor resumes the tail on reconnect)
+            self.scrape_errors += 1
+            ev = {"event": "scrape_error", "verb": "events",
+                  "t_s": self._rel(self.clock()),
+                  "error": f"{type(e).__name__}: {e}"}
+            self.events.add(ev)
+            self._emit("monitor_event", **ev)
+            return []
+        evs = t.get("events") or []
+        if "cursor" in t:
+            # aggregated router reply: per-source cursors, passed back
+            # verbatim next poll (each survives its own backend's restarts
+            # through the start_seq epoch)
+            self.events_cursor = t["cursor"]
+        else:
+            self.events_cursor = {"start_seq": t.get("start_seq"),
+                                  "seq": t.get("next_seq")}
+        self.event_drops = max(self.event_drops, int(t.get("dropped") or 0))
+        self.events_lost += int(t.get("lost") or 0)
+        self.events_seen += len(evs)
+        for e in evs:
+            self._emit("spine_event", ev=e)
+        return evs
 
     def feed_external(self, signal: str, errors: float, total: float) -> None:
         """Client-side ledgers (stranded futures, give-ups) into the same
@@ -415,14 +481,33 @@ class MonitorScraper:
 
     def run(self, duration_s: float, stop: threading.Event | None = None) -> int:
         """Scrape every ``interval_s`` for ``duration_s`` (or until
-        ``stop``); returns the number of windows taken."""
+        ``stop``); returns the number of windows taken.
+
+        Scrapes anchor to an ABSOLUTE monotonic grid (``next_t +=
+        interval``): the old sleep-after-each-scrape schedule accumulated
+        every scrape's latency as skew, so a week-long attachment drifted
+        its window boundaries by hours. A scrape that overruns its slot
+        emits an honest ``late_scrape`` event (how late, how many slots it
+        blew through) and realigns to the next FUTURE slot — no burst of
+        catch-up scrapes, and no silent pretense the cadence held."""
         stop = stop or threading.Event()
-        end = self.clock() + float(duration_s)
+        start = self.clock()
+        end = start + float(duration_s)
+        next_t = start
         while self.clock() < end and not stop.is_set():
-            t0 = self.clock()
             self.scrape_once()
-            lag = self.interval_s - (self.clock() - t0)
-            if lag > 0 and stop.wait(lag):
+            next_t += self.interval_s
+            now = self.clock()
+            if now > next_t:
+                ev = {"event": "late_scrape", "t_s": self._rel(now),
+                      "late_s": round(now - next_t, 4),
+                      "slots_skipped": int((now - next_t) // self.interval_s),
+                      "mark": self._mark}
+                self.events.add(ev)
+                self._emit("monitor_event", **ev)
+                while next_t <= now:
+                    next_t += self.interval_s
+            elif stop.wait(next_t - now):
                 break
         return self.seq
 
@@ -451,6 +536,14 @@ class MonitorScraper:
                        "by_mark": by_mark, "by_signal": by_signal},
             "peak_burn": None if self.alerter is None else self.alerter.peaks(),
         }
+        if self.tail_events:
+            # the spine loss ledger the always-armed event_drops report
+            # gate reads: endpoint ring evictions + evictions past this
+            # cursor — "zero event loss" means BOTH stayed zero
+            out["event_drops"] = self.event_drops + self.events_lost
+            out["spine"] = {"events": self.events_seen,
+                            "ring_dropped": self.event_drops,
+                            "cursor_lost": self.events_lost}
         if extra:
             out.update(extra)
         return out
@@ -480,7 +573,17 @@ def monitor_main(argv: list[str]) -> int:
     scrape, alert, summarize; or ``qdml-tpu monitor --render
     --current=monitor.jsonl [--events=a.jsonl,b.jsonl] [--out=timeline.md]``
     to render the committed stream as the markdown timeline dashboard.
-    Host-side only: no jax, no config, no inference."""
+
+    ``--attach`` turns the scrape into the HANDS-OFF loop (docs/CONTROL.md,
+    telemetry/attach.py): every finished window also ticks a
+    :class:`FleetAutoscaler` acting through the endpoint's ``{"op":
+    "fleet"}`` verb, the event spine is tailed per window, and a front-door
+    restart reconnects with backoff (``monitor_reattach``; typed give-up
+    exit 3 after ``--max-reconnects``, never a traceback). Knobs:
+    ``--min-backends/--max-backends/--queue-high/--queue-low/
+    --scale-debounce/--cooldown/--max-reconnects/--dry-run``, plus
+    ``--target=plan.json`` to pin a planner target.
+    Host-side only: no jax, no config, no inference on the scrape path."""
     from qdml_tpu.telemetry.burnrate import BurnAlerter, render_timeline
 
     if any(a == "--render" for a in argv):
@@ -539,14 +642,50 @@ def monitor_main(argv: list[str]) -> int:
         out_path, echo=False,
         manifest=run_manifest(argv=["monitor"] + list(argv), include_jax=False),
     )
+    attach = any(a == "--attach" for a in argv)
     scraper = MonitorScraper(
         SocketPoller(host, int(port), timeout_s=max(5.0, interval * 4)),
         sink=logger.telemetry, interval_s=interval, alerter=alerter,
+        tail_events=attach,
     )
+    give_up = None
     try:
-        scraper.run(duration)
-        summary = scraper.finish()
+        if attach:
+            from qdml_tpu.control.fleet_scale import (
+                FleetAutoscaler, load_planner_target,
+            )
+            from qdml_tpu.telemetry.attach import MonitorAttachment
+
+            # the actuator is a SEPARATE poller: the scrape path stays on
+            # the three read verbs, the fleet verb is the acting path
+            actuator = SocketPoller(
+                host, int(port), timeout_s=max(5.0, interval * 4)
+            )
+            autoscaler = FleetAutoscaler(
+                lambda n: actuator.fleet(backends=n),
+                min_backends=int(_arg(argv, "min-backends", "1")),
+                max_backends=int(_arg(argv, "max-backends", "4")),
+                queue_high=float(_arg(argv, "queue-high", "32")),
+                queue_low=float(_arg(argv, "queue-low", "2")),
+                debounce=int(_arg(argv, "scale-debounce", "2")),
+                cooldown_ticks=int(_arg(argv, "cooldown", "5")),
+                sink=logger.telemetry,
+                dry_run=any(a == "--dry-run" for a in argv),
+            )
+            target = _arg(argv, "target", None)
+            if target:
+                autoscaler.set_planner_target(load_planner_target(target))
+            attachment = MonitorAttachment(
+                scraper, autoscaler,
+                max_reconnects=int(_arg(argv, "max-reconnects", "8")),
+            )
+            attachment.run(duration)
+            give_up = attachment.give_up
+            summary = scraper.finish(extra={"handsoff": attachment.summary()})
+        else:
+            scraper.run(duration)
+            summary = scraper.finish()
     finally:
         logger.close()
     print(json.dumps({"monitor": summary}, default=str))
-    return 0
+    return 3 if give_up else 0

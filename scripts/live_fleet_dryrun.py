@@ -1,0 +1,586 @@
+"""Live observability loop dryrun over REAL backend serve processes (ISSUE 18).
+
+The hands-off proof of the event spine + monitor attachment
+(docs/CONTROL.md "hands-off loop", docs/TELEMETRY.md "event spine"): boot
+a 2-backend fleet of genuine ``qdml-tpu serve`` processes behind a
+:class:`FleetRouter` + asyncio front door with a :class:`BackendLifecycle`
+attached, pre-spawn a warm standby, and attach a :class:`MonitorScraper`
+THROUGH a :class:`MonitorAttachment` at the front door — scraping over the
+three sanctioned read verbs (health / metrics / events, audited) and
+acting through a SEPARATE ``{"op": "fleet"}`` actuator. Then injure the
+fleet and let the loop run itself:
+
+- **burn-alert-driven scale-up**: a SIGSTOP'd backend pages the burn-rate
+  alerter AND drops the router's live count below the provisioned
+  membership; the attachment's autoscaler ticks see burn + short-handed
+  fleet (these ms-latency models fail over faster than instantaneous
+  queue depth can ever build, so the live-count deficit is the honest
+  corroborating signal) and scale UP — the emitted ``fleet_scale_event``
+  carries the ``alert_episode`` id, joining it to the ``monitor_alert``
+  BY ID in the committed event stream — and the lifecycle warm-admits the
+  prepared standby with ZERO request-path compiles, mid-traffic (a surge
+  window, started only AFTER the page so the causality is not a race,
+  keeps the survivor under realistic load through the admission), no
+  human in the loop;
+- **drain on recovery**: the stalled backend resumes, the alert resolves,
+  queue depth collapses — the same loop scales back DOWN
+  (drain-then-retire) without ever being told to;
+- **zero event loss**: the monitor tails the front door's aggregated
+  event spine every window with a resumable per-source cursor; the
+  committed ``monitor_summary`` carries ``event_drops == 0`` (ring
+  evictions + cursor-lapped evictions, both zero) and the report's
+  always-armed gate re-arms it forever;
+- **quiet segments silent**: no alert fires during the healthy baseline
+  (the ``expect`` block makes the report re-check this from the
+  committed stream);
+- **report round-trip exit 0** with the new monitoring gates (event
+  spine loss ledger + hands-off correlation) green.
+
+Writes ``results/live_fleet/``: ``monitor.jsonl`` (the attachment stream,
+spine envelopes included), ``baseline_t0/stall_t0/surge_t0/recovery_t0
+.jsonl`` (traffic windows), ``report_live_fleet.md``, ``LIVE_FLEET.json``.
+
+Run: ``python scripts/live_fleet_dryrun.py [--n=240] [--rate=60]
+[--surge-rate=300] [--deadline-ms=500] [--seed=0]``
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qdml_tpu.utils.platform import force_cpu  # noqa: E402
+
+
+def _arg(argv, name, default):
+    return next((a.split("=", 1)[1] for a in argv if a.startswith(f"--{name}=")), default)
+
+
+def _free_port() -> int:
+    with socket.socket() as sk:
+        sk.bind(("127.0.0.1", 0))
+        return sk.getsockname()[1]
+
+
+class VerbAuditPoller:
+    """The monitor's poller, pinned: ONLY the three observability verbs
+    exist on this object — a scraper reaching for request/swap/scale/fleet
+    would AttributeError into its scrape_error path, and the audit set
+    proves what it actually used. Acting happens on a SEPARATE actuator."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls: set = set()
+
+    def health(self):
+        self.calls.add("health")
+        return self._inner.health()
+
+    def metrics(self):
+        self.calls.add("metrics")
+        return self._inner.metrics()
+
+    def events(self, cursor=None, limit=512):
+        self.calls.add("events")
+        return self._inner.events(cursor, limit=limit)
+
+
+def main(argv: list[str]) -> int:
+    n = int(_arg(argv, "n", "240"))
+    rate = float(_arg(argv, "rate", "60"))
+    surge_rate = float(_arg(argv, "surge-rate", "300"))
+    deadline_ms = float(_arg(argv, "deadline-ms", "500"))
+    threshold = _arg(argv, "threshold", "50")
+    seed = int(_arg(argv, "seed", "0"))
+    force_cpu(2)
+
+    import asyncio
+    import dataclasses
+    from concurrent.futures import Future
+
+    from qdml_tpu.config import (
+        ControlConfig,
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        ServeConfig,
+        TrainConfig,
+    )
+    from qdml_tpu.control.fleet_scale import FleetAutoscaler
+    from qdml_tpu.control.loop import SocketPoller
+    from qdml_tpu.fleet import FleetRouter, route_async, spawn_backend
+    from qdml_tpu.fleet.lifecycle import BackendLifecycle
+    from qdml_tpu.serve import ServeClient, make_request_samples, run_loadgen_socket
+    from qdml_tpu.telemetry import run_manifest, set_sink
+    from qdml_tpu.telemetry.attach import MonitorAttachment
+    from qdml_tpu.telemetry.burnrate import BurnAlerter, BurnRateRule
+    from qdml_tpu.telemetry.report import report_main
+    from qdml_tpu.telemetry.timeseries import MonitorScraper
+    from qdml_tpu.train.hdce import train_hdce
+    from qdml_tpu.train.qsc import train_classifier
+    from qdml_tpu.utils.metrics import MetricsLogger
+
+    out_dir = os.path.join("results", "live_fleet")
+    os.makedirs(out_dir, exist_ok=True)
+    for stale in glob.glob(os.path.join(out_dir, "*.jsonl")):
+        os.remove(stale)  # telemetry streams APPEND: a prior run's records
+        # would smuggle its alerts/decisions into this run's gates
+    scratch = tempfile.mkdtemp(prefix="live_fleet_")
+
+    cfg = ExperimentConfig(
+        name="live_fleet_dryrun",
+        data=DataConfig(n_ant=16, n_sub=8, n_beam=4, data_len=64),
+        model=ModelConfig(features=8),
+        train=TrainConfig(batch_size=16, n_epochs=8, workdir=scratch, probe_every=0),
+        serve=ServeConfig(
+            max_batch=16, buckets=(4, 16), max_wait_ms=2.0, max_queue=64,
+            batching="bucket", dedup_ttl_s=10.0, conn_timeout_s=5.0,
+            supervise=True,
+        ),
+        control=ControlConfig(min_window=6, autoscale=False),
+    )
+    workdir = os.path.join(scratch, f"Pn_{cfg.data.pilot_num}", cfg.name)
+    print("training fleet models (8-epoch HDCE + 8-epoch SC) ...", flush=True)
+    tlog = MetricsLogger(os.path.join(scratch, "train.jsonl"), echo=False,
+                         manifest=run_manifest(cfg))
+    try:
+        train_hdce(cfg, logger=tlog, workdir=workdir)
+        sc_cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, n_epochs=8)
+        )
+        train_classifier(sc_cfg, quantum=False, logger=tlog, workdir=workdir)
+    finally:
+        tlog.close()
+    samples = make_request_samples(cfg, int(n * 2))
+
+    backend_overrides = [
+        "--name=live_fleet_dryrun",
+        "--data.n_ant=16", "--data.n_sub=8", "--data.n_beam=4",
+        "--data.data_len=64", "--model.features=8", "--train.batch_size=16",
+        f"--train.workdir={scratch}",
+        "--serve.max_batch=16", "--serve.buckets=(4,16)",
+        "--serve.max_wait_ms=2.0", "--serve.max_queue=64",
+        "--serve.batching=bucket", "--serve.dedup_ttl_s=10.0",
+        "--serve.conn_timeout_s=5.0", "--serve.supervise=true",
+    ]
+    boot_ports = [_free_port(), _free_port()]
+
+    def spawn_boot(i: int):
+        print(f"spawning boot backend {i} on :{boot_ports[i]} ...", flush=True)
+        b = spawn_backend(backend_overrides, port=boot_ports[i])
+        print(json.dumps({"backend": i, "port": b.port, "host_id": b.host_id,
+                          "compiles_after_warmup": b.banner[
+                              "compile_cache_after_warmup"]}), flush=True)
+        return b
+
+    boot = [spawn_boot(0), spawn_boot(1)]
+    router = FleetRouter(
+        [("127.0.0.1", p) for p in boot_ports],
+        balance="hash", timeout_s=1.0, retries=0,
+        eject_failures=2, eject_s=0.5, readmit_probes=1,
+        poll_interval_s=0.2, failover=2, seed=seed,
+        dedup_ttl_s=120.0,
+    ).start()
+
+    # the standby is PRE-SPAWNED outside the traffic windows (provisioning
+    # is boring cold-start); what must happen hands-off UNDER traffic is
+    # the autoscaler's decision + verification + ring splice, and that runs
+    # mid-window through the attachment below
+    prepared: list = []
+
+    def spawn_fn(overrides, port=0, host="127.0.0.1", log_path=None,
+                 timeout_s=600.0):
+        if prepared:
+            return prepared.pop(0)
+        return spawn_backend(list(overrides), port=port, host=host,
+                             log_path=log_path, timeout_s=timeout_s)
+
+    lifecycle = BackendLifecycle(
+        router, spawn_overrides=backend_overrides, drain_wait_s=30.0,
+        log_dir=scratch, spawn_fn=spawn_fn,
+    )
+
+    aloop = asyncio.new_event_loop()
+    tloop = threading.Thread(target=aloop.run_forever, daemon=True)
+    tloop.start()
+    ready: Future = Future()
+    front_task = asyncio.run_coroutine_threadsafe(
+        route_async(router, "127.0.0.1", 0, ready,
+                    conn_timeout_s=5.0, max_line_bytes=1 << 20,
+                    lifecycle=lifecycle),
+        aloop,
+    )
+    front = ("127.0.0.1", ready.result(timeout=30.0))
+    print(json.dumps({"router_front": front[1], "elastic": True}), flush=True)
+
+    print("provisioning warm standby ...", flush=True)
+    prepared.append(spawn_backend(backend_overrides, port=0,
+                                  log_path=os.path.join(scratch, "standby.log")))
+
+    # -------- attach the live loop (3 read verbs + separate actuator) -----
+    mon_path = os.path.join(out_dir, "monitor.jsonl")
+    mlog = MetricsLogger(mon_path, echo=False, manifest=run_manifest(cfg))
+    # the stack's structured events (router ejections, control scale
+    # decisions) reach the monitor stream TWICE on purpose: once through
+    # the process-global sink (durable record) and once as tailed
+    # ``spine_event`` envelopes (the live-tail proof with correlation keys)
+    set_sink(mlog.telemetry)
+    audit = VerbAuditPoller(SocketPoller(front[0], front[1], timeout_s=5.0))
+    alerter = BurnAlerter.for_run(duration_s=30.0, interval_s=0.4,
+                                  slo_target=0.99, threshold=8.0, debounce=2)
+    # harness-scaled router rule (same geometry as monitor_dryrun): the
+    # fast-ejecting router caps what a short stall can burn, so the pair
+    # runs tighter/lower than the production-shaped default
+    alerter.rules["router"] = BurnRateRule(
+        "router", budget=0.02, fast_s=1.2, slow_s=3.6,
+        threshold=3.0, debounce=2,
+    )
+    scraper = MonitorScraper(audit, sink=mlog.telemetry, interval_s=0.4,
+                             alerter=alerter, tail_events=True)
+    # the acting path: a SEPARATE poller, fleet verb only — the autoscaler
+    # converges membership through the front door exactly like a remote
+    # ``qdml-tpu monitor --attach`` would
+    actuator = SocketPoller(front[0], front[1], timeout_s=120.0)
+    # queue_high sits ABOVE what the 8-client baseline loadgen can ever
+    # pile up (in-flight caps queue depth at ~clients) and well BELOW the
+    # 32-client surge's overload plateau — the grow signal is the surge
+    # hitting a half-fleet, never healthy-traffic jitter
+    autoscaler = FleetAutoscaler(
+        lambda k: actuator.fleet(backends=k),
+        min_backends=2, max_backends=3,
+        queue_high=10.0, queue_low=2.0, debounce=2, cooldown_ticks=6,
+        sink=mlog.telemetry,
+    )
+    attachment = MonitorAttachment(scraper, autoscaler, max_reconnects=8)
+    stop_mon = threading.Event()
+    scraper.mark("baseline_t0")
+    mon_thread = threading.Thread(
+        target=attachment.run, args=(600.0,), kwargs={"stop": stop_mon},
+        daemon=True,
+    )
+    mon_thread.start()
+
+    window_seq = [0]
+
+    def serve_window(tag: str, n_win: int, w_rate: float, during=None,
+                     clients: int = 8):
+        side_err: list = []
+        side = None
+        if during is not None:
+            def _side():
+                try:
+                    during()
+                except Exception as e:  # lint: disable=broad-except(the injection side thread must report its failure into the headline, not die silently and fake a passing run)
+                    side_err.append(f"{type(e).__name__}: {e}")
+            side = threading.Thread(target=_side, daemon=True)
+            side.start()
+        path = os.path.join(out_dir, f"{tag}.jsonl")
+        logger = MetricsLogger(path, echo=False, manifest=run_manifest(cfg))
+        window_seq[0] += 1  # fresh loadgen ids per window (dedup discipline)
+        try:
+            summary = run_loadgen_socket(
+                cfg, front, rate=w_rate, n=n_win,
+                seed=seed + 1000 * window_seq[0],
+                deadline_ms=deadline_ms, logger=logger, clients=clients,
+                x=samples["x"],
+            )
+        finally:
+            logger.close()
+        if side is not None:
+            side.join(timeout=120.0)
+        if side_err:
+            summary["injection_error"] = side_err[0]
+        return summary, path
+
+    def backend_poll(port: int) -> dict | None:
+        try:
+            with ServeClient("127.0.0.1", port, timeout_s=5.0, retries=1) as c:
+                return c.metrics().get("metrics")
+        except Exception:  # lint: disable=broad-except(a dead/stalled backend is an expected poll outcome here; the caller records None)
+            return None
+
+    headline: dict = {
+        "n": n, "rate": rate, "surge_rate": surge_rate,
+        "deadline_ms": deadline_ms, "seed": seed,
+        "monitor": {"interval_s": scraper.interval_s,
+                    "verbs": "health/metrics/events (audited), fleet on a "
+                             "separate actuator"},
+        "autoscaler": {"min_backends": 2, "max_backends": 3,
+                       "queue_high": 10.0, "queue_low": 2.0,
+                       "debounce": 2, "cooldown_ticks": 6},
+        "boot_backends": {b.host_id: {"port": b.port} for b in boot},
+        "classes": {},
+    }
+    all_pass = True
+
+    def finish_class(kind: str, checks: dict, ok: bool) -> None:
+        nonlocal all_pass
+        checks["ok"] = ok
+        headline["classes"][kind] = checks
+        all_pass = all_pass and ok
+        print(json.dumps({kind: {"ok": ok}}), flush=True)
+
+    # -------- baseline segment: healthy fleet, quiet loop -----------------
+    base_summary, base_path = serve_window("baseline_t0", n, rate)
+    time.sleep(1.2)  # stream drains; any late window still carries this mark
+    finish_class("baseline", {
+        "completed": base_summary["completed"],
+        "stranded_futures": base_summary["stranded_futures"],
+        "slo": base_summary["slo"],
+        "decisions_during_baseline": len(attachment.decisions),
+        "path": base_path,
+    }, (
+        base_summary["stranded_futures"] == 0
+        and base_summary["completed"] > 0
+        and len(attachment.decisions) == 0
+    ))
+
+    # -------- injected stall -> page -> surge -> hands-off scale-up -------
+    scraper.mark("stall_t0")
+    surge_box: dict = {}
+
+    def inject_stall_then_surge():
+        time.sleep(1.0)
+        boot[1].stall()  # SIGSTOP: forwards to it time out and fail over
+        # wait for the PAGE before offering the surge: the scale-up is
+        # driven by burn + the live-count deficit, and holding the surge
+        # until the alert burns keeps the decision<->episode correlation
+        # causal, not a race
+        t_end = time.monotonic() + 10.0
+        while time.monotonic() < t_end and not alerter.firing():
+            time.sleep(0.1)
+        surge_box["paged_before_surge"] = bool(alerter.firing())
+        s, p = serve_window("surge_t0", int(n * 2), surge_rate, clients=32)
+        surge_box["summary"], surge_box["path"] = s, p
+        # hold the stall until the loop has decided (or timeout honestly)
+        t_end = time.monotonic() + 10.0
+        while time.monotonic() < t_end and not attachment.decisions:
+            time.sleep(0.1)
+        boot[1].resume()
+
+    stall_summary, stall_path = serve_window(
+        "stall_t0", int(n * 2), rate, during=inject_stall_then_surge
+    )
+    time.sleep(2.0)  # late burn transitions still attribute to stall_t0
+
+    # the loop (not this harness) admitted the standby: wait until it has
+    # DECIDED up (the admission itself is synchronous inside the decision).
+    # The fleet may already be back at 2 by the time we look — the loop
+    # drains on its own once the alert resolves, and a loop fast enough to
+    # finish the whole arc before the harness checks is the point, not a
+    # failure; the scale-up proof is the admitted scale#N record.
+    deadline = time.monotonic() + 30.0
+    while (time.monotonic() < deadline
+           and not any(d.get("direction") == "up"
+                       for d in attachment.decisions)):
+        time.sleep(0.2)
+    surge_summary = surge_box.get("summary") or {}
+    fired = [a for a in scraper.alerts if a.get("state") == "firing"]
+    fired_marks = sorted({a.get("mark") for a in fired})
+    episodes = {a.get("episode") for a in fired if a.get("episode")}
+    ups = [d for d in attachment.decisions if d.get("direction") == "up"]
+    up_correlated = [
+        d for d in ups
+        if d.get("burn_alert") and d.get("alert_episode") in episodes
+    ]
+    up_results_ok = all(
+        isinstance(d.get("result"), dict)
+        and all(a.get("stage") == "admitted"
+                for a in d["result"].get("actions") or [{}])
+        for d in ups
+    )
+    finish_class("handsoff_scale_up", {
+        "fired_marks": fired_marks,
+        "episodes": sorted(episodes),
+        "paged_before_surge": surge_box.get("paged_before_surge"),
+        "up_decisions": [
+            {k: d.get(k) for k in ("direction", "backends", "decision",
+                                   "burn_alert", "alert_episode")}
+            for d in ups
+        ],
+        "up_results_ok": up_results_ok,
+        "fleet_after": lifecycle.fleet_size(),
+        "stall_window": {
+            "completed": stall_summary["completed"],
+            "stranded_futures": stall_summary["stranded_futures"],
+        },
+        "surge_window": {
+            "completed": surge_summary.get("completed"),
+            "stranded_futures": surge_summary.get("stranded_futures"),
+        },
+        "injection_error": stall_summary.get("injection_error"),
+    }, (
+        "stall_t0" in fired_marks
+        and "baseline_t0" not in fired_marks
+        and surge_box.get("paged_before_surge") is True
+        and len(ups) >= 1 and len(up_correlated) >= 1
+        and up_results_ok
+        and max((d.get("backends") or 0) for d in ups) == 3
+        and lifecycle.fleet_size() in (2, 3)
+        and stall_summary["stranded_futures"] == 0
+        and surge_summary.get("stranded_futures") == 0
+        and stall_summary.get("injection_error") is None
+    ))
+
+    # -------- recovery: alert resolves, the loop drains back down ---------
+    # router re-admits the resumed backend before the recovery window
+    # (wait for the CURRENT membership, however large the loop grew it)
+    deadline = time.monotonic() + 30.0
+    while (time.monotonic() < deadline
+           and len(router.live_backends()) < lifecycle.fleet_size()):
+        router.poll_once()
+        time.sleep(0.1)
+    scraper.mark("recovery_t0")
+    rec_summary, rec_path = serve_window("recovery_t0", n, rate)
+    # idle drain-down: the attachment keeps ticking; once the alert has
+    # resolved and the queue sits under the low watermark the loop retires
+    # the extra backend on its own
+    deadline = time.monotonic() + 45.0
+    while time.monotonic() < deadline and lifecycle.fleet_size() > 2:
+        time.sleep(0.3)
+    downs = [d for d in attachment.decisions if d.get("direction") == "down"]
+    resolved = [a for a in scraper.alerts if a.get("state") == "resolved"]
+    finish_class("handsoff_drain", {
+        "down_decisions": [
+            {k: d.get(k) for k in ("direction", "backends", "decision",
+                                   "burn_alert", "alert_episode")}
+            for d in downs
+        ],
+        "alerts_resolved": len(resolved),
+        "fleet_after": lifecycle.fleet_size(),
+        "recovery_window": {
+            "completed": rec_summary["completed"],
+            "stranded_futures": rec_summary["stranded_futures"],
+        },
+    }, (
+        len(downs) >= 1
+        and all(not d.get("burn_alert") for d in downs)
+        and len(resolved) >= 1
+        and lifecycle.fleet_size() == 2
+        and rec_summary["stranded_futures"] == 0
+    ))
+    time.sleep(1.2)
+    stop_mon.set()
+    mon_thread.join(timeout=15.0)
+
+    # -------- event spine: zero loss + by-id join in the tailed stream ----
+    spine_ok = (
+        scraper.events_seen > 0
+        and scraper.event_drops == 0
+        and scraper.events_lost == 0
+    )
+    finish_class("event_spine_zero_loss", {
+        "events_seen": scraper.events_seen,
+        "ring_dropped": scraper.event_drops,
+        "cursor_lost": scraper.events_lost,
+        "give_up": attachment.give_up,
+        "reattaches": attachment.reattaches,
+    }, spine_ok and attachment.give_up is None)
+
+    # -------- scrape discipline: verbs + per-backend compile deltas -------
+    verbs = sorted(audit.calls)
+    compile_gate = {}
+    for b in router.backends:
+        m = backend_poll(b.port)
+        compile_gate[b.host_id] = None if m is None else m.get(
+            "compile_cache_after_warmup")
+    compiles_ok = len(compile_gate) == 2 and all(
+        isinstance(v, dict) and all(c == 0 for c in v.values())
+        for v in compile_gate.values()
+    )
+    finish_class("scrape_verbs_and_compiles", {
+        "verbs_used": verbs,
+        "per_backend_compiles": compile_gate,
+        "scrape_errors": scraper.scrape_errors,
+    }, verbs == ["events", "health", "metrics"] and compiles_ok)
+
+    # -------- summary + report round-trip ---------------------------------
+    expect = {"fired": ["stall_t0"], "quiet": ["baseline_t0"],
+              "scale_up_correlated": True}
+    scraper.finish(extra={"expect": expect,
+                          "handsoff": attachment.summary()})
+    set_sink(None)
+    mlog.close()
+
+    # the committed monitor stream must carry the by-id join: a firing
+    # monitor_alert envelope AND a fleet_scale_event envelope tailed off
+    # the SPINE (kind=spine_event) sharing one episode id
+    alert_eps: set = set()
+    scale_eps: set = set()
+    with open(mon_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if obj.get("kind") != "spine_event":
+                continue
+            env = obj.get("ev") or {}
+            if env.get("kind") == "monitor_alert" and env.get("episode") \
+                    and (env.get("data") or {}).get("state") == "firing":
+                alert_eps.add(env["episode"])
+            if env.get("kind") == "fleet_scale_event" and env.get("episode"):
+                scale_eps.add(env["episode"])
+    joined = sorted(alert_eps & scale_eps)
+    finish_class("spine_correlation", {
+        "alert_episodes_on_spine": sorted(alert_eps),
+        "scale_episodes_on_spine": sorted(scale_eps),
+        "joined_episodes": joined,
+    }, len(joined) >= 1)
+
+    # round-trip (repo self-vs-self pattern): committed baseline + monitor
+    # stream against the baseline itself must exit 0 WITH the new gates
+    # armed — a nonzero loss ledger or an uncorrelated scale-up flips it
+    report_md = os.path.join(out_dir, "report_live_fleet.md")
+    report_json = os.path.join(out_dir, "report_live_fleet.json")
+    rc = report_main([
+        f"--current={base_path},{mon_path}", f"--baseline={base_path}",
+        f"--threshold={threshold}", f"--out={report_md}",
+        f"--json={report_json}",
+    ])
+    with open(report_md) as fh:
+        monitor_lines = [ln.strip() for ln in fh if "alert expectation" in ln
+                         or "event spine" in ln or "hands-off loop" in ln]
+    with open(report_json) as fh:
+        gate_json = json.load(fh)
+    gate_rows = {g["metric"]: g["status"] for g in gate_json.get("gates", [])
+                 if g.get("kind") == "monitor"}
+    finish_class("report_roundtrip", {
+        "exit": rc,
+        "monitor_gate_lines": monitor_lines,
+        "monitor_gate_rows": gate_rows,
+    }, (
+        rc == 0
+        and not gate_json.get("monitor_failed")
+        and gate_rows.get("monitor.event_drops") == "ok"
+        and gate_rows.get("monitor.handsoff") == "ok"
+        and len(monitor_lines) >= 4
+    ))
+
+    # -------- teardown + headline ----------------------------------------
+    front_task.cancel()
+    aloop.call_soon_threadsafe(aloop.stop)
+    tloop.join(timeout=10.0)
+    router.stop()
+    lifecycle.close()
+    for b in boot:
+        b.terminate()
+    for p in prepared:
+        p.kill()
+    headline["all_pass"] = all_pass
+    with open(os.path.join(out_dir, "LIVE_FLEET.json"), "w") as fh:
+        json.dump(headline, fh, indent=2, default=str)
+    print(json.dumps({"all_pass": all_pass}))
+    return 0 if all_pass else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -150,4 +150,50 @@ sys.exit(0 if ok else 1)
     --validate > /dev/null \
     || { echo "planner self-replay validation failed"; exit 6; }
 fi
+# Live fleet dryrun (docs/TELEMETRY.md "event spine" + docs/CONTROL.md
+# "hands-off loop", results/live_fleet): re-run the report over the
+# committed monitor stream with the always-armed event-loss and hands-off
+# gates, then re-check the headline's absolute facts — all_pass, the
+# events/health/metrics-only scrape discipline, zero per-backend
+# request-path compile deltas through the mid-traffic warm admission, a
+# zero loss ledger on the event spine, and the burn-alert-correlated
+# scale-up (every up decision carries the episode id of the alert that
+# drove it — the correlation is a join, not timestamp proximity).
+if [ -d results/live_fleet ]; then
+  rm -f /tmp/_t1_live.json
+  python -m qdml_tpu.cli report \
+    --current=results/live_fleet/baseline_t0.jsonl,results/live_fleet/monitor.jsonl \
+    --baseline=results/live_fleet/baseline_t0.jsonl \
+    --json=/tmp/_t1_live.json > /dev/null || true  # rc judged on the JSON rows below
+  python -c "
+import json, sys
+d = json.load(open('/tmp/_t1_live.json'))
+invariant_kinds = ('resilience', 'breaker', 'dispatch', 'batching', 'monitor')
+gates = {g.get('metric'): g.get('status') for g in d.get('gates', [])}
+bad = (d.get('stranded_failed') or d.get('monitor_failed')
+       or gates.get('monitor.event_drops') != 'ok'
+       or gates.get('monitor.handsoff') != 'ok'
+       or any(g.get('status') == 'regression' and g.get('kind') in invariant_kinds
+              for g in d.get('gates', [])))
+sys.exit(1 if bad else 0)
+" || { echo "live-fleet invariant gate failed (event loss / hands-off)"; exit 6; }
+  python -c "
+import json, sys
+d = json.load(open('results/live_fleet/LIVE_FLEET.json'))
+c = d.get('classes') or {}
+sv = c.get('scrape_verbs_and_compiles') or {}
+spine = c.get('event_spine_zero_loss') or {}
+ups = (c.get('handsoff_scale_up') or {}).get('up_decisions') or []
+zero = lambda m: isinstance(m, dict) and all(v == 0 for v in m.values())
+comp = sv.get('per_backend_compiles') or {}
+ok = (d.get('all_pass')
+      and sv.get('verbs_used') == ['events', 'health', 'metrics']
+      and comp and all(zero(v) for v in comp.values())
+      and spine.get('ring_dropped') == 0 and spine.get('cursor_lost') == 0
+      and spine.get('give_up') is None
+      and ups and all(u.get('burn_alert') and u.get('alert_episode')
+                      for u in ups))
+sys.exit(0 if ok else 1)
+" || { echo "live-fleet headline failed (all_pass / verbs / zero-compile / spine / correlation)"; exit 6; }
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

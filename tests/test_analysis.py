@@ -302,6 +302,52 @@ def test_lock_discipline_covers_engine_swap_state():
     }
 
 
+def test_lock_discipline_covers_event_bus_ring_state():
+    """The event spine's ring/cursor state: seq allocation, the deque and
+    the drop counter move together under _lock — an unlocked publish could
+    tear seq/dropped accounting and make loss silent; the locked twins are
+    clean, and the real module passes its own rule."""
+    from qdml_tpu.analysis.rules import rule_serve_lock_discipline
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+        class EventBus:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ring = []           # __init__ is exempt
+                self._seq = 0
+                self._dropped = 0
+
+            def publish_locked(self, env):
+                with self._lock:
+                    self._seq += 1
+                    self._ring.append(env)
+                    if len(self._ring) > 4:
+                        self._ring.pop(0)
+                        self._dropped += 1
+                    return self._seq
+
+            def publish_racy(self, env):
+                self._seq += 1            # unlocked seq allocation
+                self._ring.append(env)    # unlocked append
+                return self._dropped      # unlocked drop-counter read
+        """
+    )
+    findings = rule_serve_lock_discipline(
+        _ctx(src, "qdml_tpu/telemetry/events.py")
+    )
+    assert {f.context for f in findings} == {"EventBus.publish_racy"}
+    engine = LintEngine(REPO)
+    real, err = engine.lint_file("qdml_tpu/telemetry/events.py")
+    assert err is None
+    assert not [
+        f for f in real
+        if f.rule == "serve-lock-discipline" and not f.suppressed
+    ]
+
+
 # ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------

@@ -1,0 +1,565 @@
+"""Unified event spine + live attachment (qdml_tpu/telemetry/events.py,
+attach.py; docs/TELEMETRY.md "event spine", docs/CONTROL.md "hands-off
+loop"): envelope construction, cursor-tail semantics (resume with no gaps
+and no duplicates, explicit loss on overflow, epoch-mismatch restart),
+router aggregation ordering, the scraper's events verb, and the
+attachment's reconnect/give-up discipline.
+
+All host-side — no engine, no sockets: buses are constructed directly and
+router aggregation runs over faked backends, property-style over scripted
+pollers. The live end-to-end path is scripts/live_fleet_dryrun.py's
+committed run."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from qdml_tpu.control.fleet_scale import FleetAutoscaler
+from qdml_tpu.telemetry.attach import MonitorAttachment
+from qdml_tpu.telemetry.events import (
+    EventBus,
+    classify,
+    ensure_bus,
+    install_bus,
+    normalize_tail,
+)
+from qdml_tpu.telemetry.timeseries import MonitorScraper
+
+
+@pytest.fixture(autouse=True)
+def _fresh_bus():
+    """Isolate the process-global bus per test: library emitters publish to
+    whatever is installed, and a shared bus would leak one test's events
+    into another's cursors."""
+    install_bus(EventBus(capacity=4096))
+    yield
+    install_bus(None)
+
+
+# ---------------------------------------------------------------------------
+# Envelope + severity vocabulary
+# ---------------------------------------------------------------------------
+
+
+def test_envelope_hoists_correlation_keys_and_keeps_payload_intact():
+    bus = EventBus(clock=lambda: 123.5)
+    env = bus.publish(
+        "fleet_scale_event", tier="control",
+        direction="up", backends=3, alert_episode="router#1",
+        decision="scale#1", assumptions_sha="a" * 64,
+    )
+    assert env["seq"] == 1 and env["ts"] == 123.5
+    assert env["tier"] == "control" and env["kind"] == "fleet_scale_event"
+    # hoisted correlation keys (alias forms included)...
+    assert env["episode"] == "router#1"
+    assert env["decision"] == "scale#1"
+    assert env["planner_sha"] == "a" * 64
+    # ...while the payload survives untouched under data
+    assert env["data"]["alert_episode"] == "router#1"
+    assert env["data"]["backends"] == 3
+
+
+def test_severity_vocabulary():
+    assert classify("replica_quarantined") == "critical"
+    assert classify("replica_restarted") == "warning"
+    assert classify("monitor_timeseries") == "debug"
+    assert classify("some_future_kind") == "info"
+    # monitor_alert is state-dependent: firing pages, resolved informs
+    assert classify("monitor_alert", {"state": "firing"}) == "critical"
+    assert classify("monitor_alert", {"state": "resolved"}) == "info"
+    # publisher override always wins
+    bus = EventBus()
+    assert bus.publish("replica_quarantined", severity="info")["severity"] == "info"
+
+
+# ---------------------------------------------------------------------------
+# Cursor-tail semantics: no gaps, no duplicates, explicit loss
+# ---------------------------------------------------------------------------
+
+
+def test_tail_resume_has_no_gaps_and_no_duplicates():
+    bus = EventBus(capacity=64)
+    cursor = None
+    seen: list[int] = []
+    for batch in range(5):
+        for i in range(7):
+            bus.publish("k", i=batch * 7 + i)
+        t = bus.tail(cursor)
+        cursor = {"start_seq": t["start_seq"], "seq": t["next_seq"]}
+        seen.extend(e["seq"] for e in t["events"])
+        assert t["lost"] == 0 and t["dropped"] == 0
+    assert seen == list(range(1, 36))
+    # a re-poll with the same cursor and nothing new is empty, not a replay
+    t = bus.tail(cursor)
+    assert t["events"] == [] and t["next_seq"] == 35
+
+
+def test_tail_property_random_interleaving_of_publish_and_poll():
+    """Property-style: any interleaving of publishes and cursor polls over
+    a ring that never overflows yields every seq exactly once, in order."""
+    rng = random.Random(7)
+    bus = EventBus(capacity=10_000)
+    cursor = None
+    published = 0
+    seen: list[int] = []
+    for _ in range(200):
+        if rng.random() < 0.7:
+            published += 1
+            bus.publish("k", n=published)
+        else:
+            t = bus.tail(cursor, limit=rng.randint(1, 50))
+            cursor = {"start_seq": t["start_seq"], "seq": t["next_seq"]}
+            seen.extend(e["seq"] for e in t["events"])
+    while len(seen) < published:  # drain (limit may have capped a poll)
+        t = bus.tail(cursor)
+        if not t["events"]:
+            break
+        cursor = {"start_seq": t["start_seq"], "seq": t["next_seq"]}
+        seen.extend(e["seq"] for e in t["events"])
+    assert seen == list(range(1, published + 1))
+
+
+def test_overflow_increments_drop_counter_and_tail_reports_loss():
+    bus = EventBus(capacity=4)
+    for i in range(10):
+        bus.publish("k", i=i)
+    t = bus.tail(None)
+    # 6 evictions, and a from-the-head reader lost exactly those 6
+    assert t["dropped"] == 6 and t["lost"] == 6
+    assert [e["seq"] for e in t["events"]] == [7, 8, 9, 10]
+    # a cursor that kept up reads loss-free from here on (the cumulative
+    # drop counter still ticks for the ring eviction the publish caused)
+    cursor = {"start_seq": t["start_seq"], "seq": t["next_seq"]}
+    bus.publish("k", i=10)
+    t2 = bus.tail(cursor)
+    assert t2["lost"] == 0 and t2["dropped"] == 7
+    assert [e["seq"] for e in t2["events"]] == [11]
+    # ...but a cursor the ring lapped sees cursor-relative loss
+    lapped = {"start_seq": t["start_seq"], "seq": 2}
+    t3 = bus.tail(lapped)
+    assert t3["lost"] == (11 - 4) - 2  # oldest-1 - since
+
+
+def test_epoch_mismatch_restarts_from_head_with_honest_loss():
+    bus = EventBus(capacity=8)
+    for i in range(3):
+        bus.publish("k", i=i)
+    stale = {"start_seq": bus.start_seq - 999, "seq": 3}
+    t = bus.tail(stale)
+    # the dead process's cursor must NOT skip the new process's first seqs
+    assert [e["seq"] for e in t["events"]] == [1, 2, 3]
+    assert t["lost"] == 0
+
+
+def test_normalize_tail_handles_both_shapes():
+    single = {"start_seq": 5, "next_seq": 9, "dropped": 1, "lost": 0,
+              "events": [{"seq": 9}]}
+    evs, cur, dropped, lost = normalize_tail(single)
+    assert evs == [{"seq": 9}] and cur == {"start_seq": 5, "seq": 9}
+    assert dropped == 1 and lost == 0
+    agg = {"fleet": True, "events": [], "dropped": 0, "lost": 2,
+           "cursor": {"router": {"start_seq": 1, "seq": 4}}}
+    evs, cur, dropped, lost = normalize_tail(agg)
+    assert cur == {"router": {"start_seq": 1, "seq": 4}} and lost == 2
+
+
+def test_bus_publish_is_thread_safe_and_loss_is_never_silent():
+    bus = EventBus(capacity=128)
+
+    def pump(tag):
+        for i in range(500):
+            bus.publish("k", tag=tag, i=i)
+
+    threads = [threading.Thread(target=pump, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = bus.snapshot()
+    # every publish got a unique seq; evictions are all counted
+    assert snap["seq"] == 2000
+    assert snap["size"] + snap["dropped"] == 2000
+
+
+# ---------------------------------------------------------------------------
+# Router aggregation over faked backends
+# ---------------------------------------------------------------------------
+
+
+class _FakeState:
+    def __init__(self, live=True):
+        self._live = live
+        self.failures = 0
+        self.successes = 0
+
+    def live(self):
+        return self._live
+
+    def record_failure(self):
+        self.failures += 1
+        return False
+
+    def record_success(self):
+        self.successes += 1
+        return False
+
+
+class _FakeBackend:
+    """A backend whose {"op": "events"} verb answers from its own bus."""
+
+    def __init__(self, host_id, bus=None, dead=False):
+        self.host_id = host_id
+        self.addr = f"127.0.0.1:{host_id}"
+        self.bus = bus or EventBus()
+        self.dead = dead
+        self.state = _FakeState()
+
+    def call(self, msg):
+        assert msg["op"] == "events"
+        if self.dead:
+            raise ConnectionError("down")
+        return {"ok": True,
+                "events": self.bus.tail(msg.get("cursor"),
+                                        limit=msg.get("limit") or 512)}
+
+
+def _router_with(backends):
+    from qdml_tpu.fleet.router import FleetRouter
+
+    r = FleetRouter([("127.0.0.1", 1)], poll_interval_s=3600.0)
+    r.backends = backends  # never started: live_events only walks this list
+    return r
+
+
+def test_router_aggregation_preserves_per_backend_ordering():
+    b0, b1 = _FakeBackend("b0"), _FakeBackend("b1")
+    for i in range(4):
+        b0.bus.publish("a", i=i)
+        b1.bus.publish("b", i=i)
+    ensure_bus().publish("router_event", x=1)
+    router = _router_with([b0, b1])
+    view = router.live_events(None)
+    assert view["fleet"] is True and view["dropped"] == 0
+    # per-source cursors for every folded source
+    assert set(view["cursor"]) == {"router", "b0", "b1"}
+    # within each source the seqs are strictly increasing (ordering
+    # preserved); every event is stamped with its source
+    for src in ("router", "b0", "b1"):
+        seqs = [e["seq"] for e in view["events"] if e["source"] == src]
+        assert seqs == sorted(seqs) and len(seqs) >= 1
+    # resume through the aggregated cursor: only new events come back
+    b0.bus.publish("a", i=99)
+    view2 = router.live_events(view["cursor"])
+    assert [(e["source"], e["data"]["i"]) for e in view2["events"]] == [("b0", 99)]
+
+
+def test_router_aggregation_sums_loss_and_survives_dead_backend():
+    b0 = _FakeBackend("b0", bus=EventBus(capacity=2))
+    dead = _FakeBackend("b9", dead=True)
+    for i in range(5):
+        b0.bus.publish("a", i=i)
+    router = _router_with([b0, dead])
+    view = router.live_events(None)
+    # b0's evictions surface at the front door; the dead backend is skipped
+    # with a recorded failure, not an exception
+    assert view["dropped"] == 3 and view["lost"] == 3
+    assert dead.state.failures == 1 and "b9" not in view["cursor"]
+
+
+def test_router_per_backend_cursor_survives_that_backends_restart_only():
+    b0, b1 = _FakeBackend("b0"), _FakeBackend("b1")
+    b0.bus.publish("a", i=0)
+    b1.bus.publish("b", i=0)
+    router = _router_with([b0, b1])
+    view = router.live_events(None)
+    # b1 restarts: new bus, new epoch
+    b1.bus = EventBus()
+    b1.bus.publish("b", i=1)
+    b0.bus.publish("a", i=1)
+    view2 = router.live_events(view["cursor"])
+    got = {(e["source"], e["data"]["i"]) for e in view2["events"]}
+    # b0 resumed (only the new event); b1's mismatched epoch restarted that
+    # source from ITS buffer head without disturbing b0's cursor
+    assert got == {("b0", 1), ("b1", 1)}
+
+
+# ---------------------------------------------------------------------------
+# The scraper's events verb (cursor keeping, loss ledger, echo guard)
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class _Sink:
+    active = True
+
+    def __init__(self):
+        self.records = []
+
+    def emit(self, kind, **payload):
+        self.records.append({"kind": kind, **payload})
+
+
+class _EventsPoller:
+    """Scripted three-verb poller backed by a real bus."""
+
+    def __init__(self, bus):
+        self.bus = bus
+        self.fail_events = False
+
+    def health(self):
+        return {"warm": True, "replicas": 1, "queue_depth": 0,
+                "quarantined": [], "swap_epoch": 0, "uptime_s": 5.0,
+                "start_seq": 1}
+
+    def metrics(self):
+        return {"completed": 0, "shed": {}, "faults": {}, "restarts": 0,
+                "slo": {"n": 0, "met": 0},
+                "breaker": {"state": "closed", "fast_fails": 0, "admitted": 0}}
+
+    def events(self, cursor=None, limit=512):
+        if self.fail_events:
+            raise ConnectionResetError("front door restarting")
+        return self.bus.tail(cursor, limit=limit)
+
+
+def test_scraper_tails_spine_with_resumable_cursor_and_loss_ledger():
+    clk, sink = _Clock(), _Sink()
+    bus = EventBus(capacity=4)
+    p = _EventsPoller(bus)
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=clk,
+                       tail_events=True)
+    bus.publish("replica_restarted", replica=0)
+    s.scrape_once()
+    assert s.events_seen == 1 and s.event_drops == 0
+    # overflow between scrapes: the ledger carries the evictions
+    for i in range(9):
+        bus.publish("k", i=i)
+    clk.t += 1.0
+    rec = s.scrape_once()
+    assert s.event_drops > 0 or s.events_lost > 0
+    assert rec["spine"]["events"] == 4  # ring kept only the newest 4
+    # tailed envelopes land in the stream nested under ev (envelopes carry
+    # their own kind/ts and must not clobber the record's)
+    spine_recs = [r for r in sink.records if r["kind"] == "spine_event"]
+    assert spine_recs and all("ev" in r for r in spine_recs)
+    # summary folds the ledger the report's zero-loss gate reads
+    out = s.summary()
+    assert out["event_drops"] == s.event_drops + s.events_lost
+    assert out["spine"]["events"] == s.events_seen
+
+
+def test_scraper_does_not_republish_tailed_envelopes_echo_guard():
+    """A monitor co-resident with its router tails the same process-global
+    bus it publishes to: tailed envelopes must NOT be re-published or the
+    spine would echo into itself forever."""
+    clk, sink = _Clock(), _Sink()
+    bus = ensure_bus()  # the scraper's own publishes land here too
+    p = _EventsPoller(bus)
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=clk,
+                       tail_events=True)
+    for step in range(4):
+        clk.t += 1.0
+        s.scrape_once()
+    assert not any(
+        e["kind"] == "spine_event"
+        for e in bus.tail(None, limit=10_000)["events"]
+    )
+
+
+def test_scraper_events_failure_is_a_typed_scrape_error_and_cursor_survives():
+    clk, sink = _Clock(), _Sink()
+    bus = EventBus()
+    p = _EventsPoller(bus)
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=clk,
+                       tail_events=True)
+    bus.publish("k", i=0)
+    s.scrape_once()
+    cursor = dict(s.events_cursor)
+    bus.publish("k", i=1)  # published DURING the outage
+    p.fail_events = True
+    clk.t += 1.0
+    s.scrape_once()  # health/metrics fine, events verb down
+    assert s.scrape_errors == 1 and s.events_cursor == cursor
+    evs = [r for r in sink.records if r["kind"] == "monitor_event"
+           and r.get("event") == "scrape_error"]
+    assert evs and evs[0]["verb"] == "events"
+    # recovery: the kept cursor resumes with no gaps and no duplicates
+    p.fail_events = False
+    clk.t += 1.0
+    s.scrape_once()
+    assert s.events_seen == 2 and s.events_lost == 0
+
+
+def test_scraper_without_events_verb_downgrades_silently():
+    class _TwoVerb:
+        def health(self):
+            return {"warm": True, "replicas": 1, "queue_depth": 0,
+                    "quarantined": [], "swap_epoch": 0}
+
+        def metrics(self):
+            return {"completed": 0, "shed": {}, "faults": {}, "restarts": 0,
+                    "slo": {"n": 0, "met": 0},
+                    "breaker": {"state": "closed", "fast_fails": 0,
+                                "admitted": 0}}
+
+    s = MonitorScraper(_TwoVerb(), sink=_Sink(), interval_s=1.0,
+                       clock=_Clock(), tail_events=True)
+    rec = s.scrape_once()
+    assert rec["spine"]["events"] == 0 and s.scrape_errors == 0
+
+
+# ---------------------------------------------------------------------------
+# The attachment: policy ticks, correlation, reconnect, typed give-up
+# ---------------------------------------------------------------------------
+
+
+class _FiringAlerter:
+    def __init__(self):
+        self.open = []
+
+    def firing(self):
+        return list(self.open)
+
+
+def test_attachment_tick_stamps_alert_episode_onto_scale_decision():
+    sink = _Sink()
+    scaled = []
+    auto = FleetAutoscaler(
+        lambda k: scaled.append(k) or {"ok": True, "actions": []},
+        min_backends=2, max_backends=3, queue_high=5.0, queue_low=1.0,
+        debounce=2, cooldown_ticks=0, sink=sink,
+    )
+    p = _EventsPoller(EventBus())
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=_Clock())
+    s.alerter = _FiringAlerter()
+    att = MonitorAttachment(s, auto)
+    # two high-queue windows while the router alert burns -> scale up,
+    # stamped with the open episode id and a decision id
+    s.alerter.open = [{"signal": "router", "episode": "router#1"}]
+    assert att.tick({"queue_depth": 20, "backends": 2}) is None  # debounce 1/2
+    d = att.tick({"queue_depth": 20, "backends": 2})
+    assert d is not None and d["direction"] == "up" and scaled == [3]
+    assert d["burn_alert"] is True and d["alert_episode"] == "router#1"
+    assert d["decision"] == "scale#1"
+    assert att.summary()["scale_events"][0]["alert_episode"] == "router#1"
+    # quiet queue but alert still burning: scale-DOWN is refused
+    for _ in range(5):
+        att.tick({"queue_depth": 0, "backends": 3})
+    assert len(att.decisions) == 1
+    # alert resolves -> the loop drains back down, uncorrelated
+    s.alerter.open = []
+    att.tick({"queue_depth": 0, "backends": 3})
+    d = att.tick({"queue_depth": 0, "backends": 3})
+    assert d["direction"] == "down" and d["alert_episode"] is None
+
+
+def test_attachment_short_handed_burn_grows_without_queue_pressure():
+    # ms-latency tiers fail over faster than instantaneous queue depth can
+    # build: the grow signal under a stall is burn + backends_live below
+    # membership, never the burn alone
+    sink = _Sink()
+    scaled = []
+    auto = FleetAutoscaler(
+        lambda k: scaled.append(k) or {"ok": True, "actions": []},
+        min_backends=2, max_backends=3, queue_high=10.0, queue_low=1.0,
+        debounce=2, cooldown_ticks=0, sink=sink,
+    )
+    p = _EventsPoller(EventBus())
+    s = MonitorScraper(p, sink=sink, interval_s=1.0, clock=_Clock())
+    s.alerter = _FiringAlerter()
+    att = MonitorAttachment(s, auto)
+    # burn firing but the fleet is at full live strength: no grow
+    s.alerter.open = [{"signal": "router", "episode": "router#1"}]
+    for _ in range(4):
+        assert att.tick({"queue_depth": 0, "backends": 2,
+                         "backends_live": 2}) is None
+    # the stalled host drops out of the live set: burn + deficit -> up,
+    # correlated to the open episode, live count recorded on the event
+    assert att.tick({"queue_depth": 0, "backends": 2,
+                     "backends_live": 1}) is None  # debounce 1/2
+    d = att.tick({"queue_depth": 0, "backends": 2, "backends_live": 1})
+    assert d is not None and d["direction"] == "up" and scaled == [3]
+    assert d["alert_episode"] == "router#1" and d["backends_live"] == 1
+    # a deficit WITHOUT a burn alert stays the router's problem: no grow
+    s.alerter.open = []
+    auto2 = FleetAutoscaler(
+        lambda k: scaled.append(k), min_backends=2, max_backends=3,
+        queue_high=10.0, queue_low=-1.0, debounce=2, cooldown_ticks=0,
+        sink=sink,
+    )
+    att2 = MonitorAttachment(s, auto2)
+    for _ in range(4):
+        assert att2.tick({"queue_depth": 0, "backends": 2,
+                          "backends_live": 1}) is None
+
+
+def test_attachment_reconnects_with_cursor_resume_and_reattach_event():
+    sink = _Sink()
+    bus = EventBus()
+    p = _EventsPoller(bus)
+    fail_all = {"on": False}
+    real_health = p.health
+    p.health = lambda: (_ for _ in ()).throw(ConnectionError("down")) \
+        if fail_all["on"] else real_health()
+    auto = FleetAutoscaler(lambda k: {"ok": True}, min_backends=1,
+                           max_backends=2, queue_high=1e9, queue_low=-1.0,
+                           sink=sink)
+    s = MonitorScraper(p, sink=sink, interval_s=0.01, tail_events=True)
+    att = MonitorAttachment(s, auto, reconnect_backoff_s=0.01,
+                            reconnect_max_s=0.02, max_reconnects=50)
+    bus.publish("k", i=0)
+    stop = threading.Event()
+    t = threading.Thread(target=att.run, args=(3.0, stop), daemon=True)
+    t.start()
+    time.sleep(0.15)
+    fail_all["on"] = True
+    bus.publish("k", i=1)  # published during the outage
+    time.sleep(0.15)
+    fail_all["on"] = False
+    time.sleep(0.15)
+    stop.set()
+    t.join(timeout=5.0)
+    assert att.reattaches >= 1 and att.give_up is None
+    reatt = [r for r in sink.records if r.get("event") == "monitor_reattach"]
+    assert reatt and reatt[0]["after_attempts"] >= 1
+    # the outage-spanning cursor resumed: both events seen exactly once
+    seen = [r["ev"]["data"]["i"] for r in sink.records
+            if r["kind"] == "spine_event" and r["ev"]["kind"] == "k"]
+    assert seen == [0, 1]
+
+
+def test_attachment_gives_up_typed_after_max_reconnects():
+    sink = _Sink()
+
+    class _AlwaysDown:
+        def health(self):
+            raise ConnectionRefusedError("gone")
+
+        def metrics(self):  # pragma: no cover - never reached
+            return {}
+
+    auto = FleetAutoscaler(lambda k: {"ok": True}, min_backends=1,
+                           max_backends=2, sink=sink)
+    s = MonitorScraper(_AlwaysDown(), sink=sink, interval_s=0.01)
+    att = MonitorAttachment(s, auto, reconnect_backoff_s=0.005,
+                            reconnect_max_s=0.01, max_reconnects=3)
+    ticks = att.run(5.0)  # returns LONG before the duration: typed give-up
+    assert ticks == 0
+    assert att.give_up is not None
+    assert att.give_up["reason"] == "reconnect_exhausted"
+    assert att.give_up["attempts"] == 3
+    give = [r for r in sink.records
+            if r.get("event") == "monitor_attach_giveup"]
+    assert give, "the give-up must be an emitted event, not just state"
+    assert att.summary()["give_up"]["reason"] == "reconnect_exhausted"
