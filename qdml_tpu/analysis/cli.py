@@ -5,7 +5,8 @@ workdir (dispatched before the CLI's config layer, exactly like ``report``).
 
     qdml-tpu lint [--paths=P1,P2,...] [--baseline[=FILE]] [--write-baseline]
                   [--json=FILE] [--durations=FILE] [--threshold=SECS]
-                  [--allow=FILE] [--list-rules]
+                  [--allow=FILE] [--list-rules] [--changed-only]
+                  [--lockgraph[=DIR]] [--lockgraph-check[=DIR]]
 
 Exit codes: 0 clean (every finding fixed, suppressed with a reason, or
 baselined), 1 new findings, 2 usage/parse errors.
@@ -18,6 +19,13 @@ baselined), 1 new findings, 2 usage/parse errors.
   ``pytest --durations=0`` report (``-`` reads stdin).
 - ``--json=FILE`` writes the machine-readable gate record that
   ``qdml-tpu report --lint=FILE`` consumes.
+- ``--changed-only`` restricts the REPORT to git-touched files (staged +
+  unstaged + untracked) for fast pre-commit runs; the scan still covers the
+  full path set so the whole-program concurrency pass sees every caller.
+- ``--lockgraph[=DIR]`` writes the static lock-order graph artifact
+  (default ``results/lockgraph/``: JSON + DOT + markdown hierarchy);
+  ``--lockgraph-check`` instead verifies the committed artifact matches a
+  regenerated one (the tier-1 freshness gate) and exits 1 on staleness.
 """
 
 from __future__ import annotations
@@ -43,6 +51,34 @@ EXIT_USAGE = 2
 def repo_root() -> str:
     """The repo the package lives in (qdml_tpu/analysis/cli.py -> repo)."""
     return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def changed_files(root: str) -> list[str]:
+    """Repo-relative .py files git considers touched: staged, unstaged, and
+    untracked (`git status --porcelain` — renames report their new name)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+            check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return []
+    files: list[str] = []
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:  # rename: "R  old -> new"
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        if path.endswith(".py"):
+            files.append(path)
+    return sorted(set(files))
 
 
 def _format_text(result: LintResult, baseline_path: str | None) -> str:
@@ -85,6 +121,9 @@ def lint_main(argv: list[str]) -> int:
     durations: str | None = None
     threshold = 5.0
     allow: str | None = None
+    changed_only = False
+    lockgraph_dir: str | None = None
+    lockgraph_check: str | None = None
     root = repo_root()
     for arg in argv:
         if arg.startswith("--paths="):
@@ -107,11 +146,24 @@ def lint_main(argv: list[str]) -> int:
                 return EXIT_USAGE
         elif arg.startswith("--allow="):
             allow = arg.split("=", 1)[1]
+        elif arg == "--changed-only":
+            changed_only = True
+        elif arg == "--lockgraph":
+            lockgraph_dir = os.path.join(root, "results", "lockgraph")
+        elif arg.startswith("--lockgraph="):
+            lockgraph_dir = arg.split("=", 1)[1]
+        elif arg == "--lockgraph-check":
+            lockgraph_check = os.path.join(root, "results", "lockgraph")
+        elif arg.startswith("--lockgraph-check="):
+            lockgraph_check = arg.split("=", 1)[1]
         elif arg == "--list-rules":
+            from qdml_tpu.analysis.concurrency import CONCURRENCY_RULES
             from qdml_tpu.analysis.rules import RULES
             from qdml_tpu.analysis.slowmarkers import RULE_ID
 
             for rule_id, (_fn, doc) in sorted(RULES.items()):
+                print(f"{rule_id:26s} {doc}")
+            for rule_id, doc in sorted(CONCURRENCY_RULES.items()):
                 print(f"{rule_id:26s} {doc}")
             print(f"{RULE_ID:26s} >5s tests must be @pytest.mark.slow (needs --durations)")
             return EXIT_OK
@@ -157,9 +209,35 @@ def lint_main(argv: list[str]) -> int:
                 "add the missing (reason)s instead"
             )
         return EXIT_OK
-    result = engine.run(paths, baseline=previous, extra_findings=extra)
+    restrict: list[str] | None = None
+    if changed_only:
+        restrict = changed_files(root)
+        if not restrict and not (lockgraph_dir or lockgraph_check):
+            print("qdml-tpu lint: OK — --changed-only and no touched .py files")
+            return EXIT_OK
+    result = engine.run(
+        paths, baseline=previous, extra_findings=extra, restrict_to=restrict
+    )
     print(_format_text(result, baseline_path))
     rc = EXIT_OK if result.ok else EXIT_FINDINGS
+    if (lockgraph_dir or lockgraph_check) and engine.model is not None:
+        from qdml_tpu.analysis import concurrency
+
+        if lockgraph_dir:
+            graph = concurrency.write_lockgraph(engine.model, lockgraph_dir)
+            print(
+                f"lint: wrote lock graph to {lockgraph_dir} "
+                f"({len(graph['nodes'])} locks, {len(graph['edges'])} edges, "
+                f"{len(graph['cycles'])} cycles)"
+            )
+        if lockgraph_check:
+            problems = concurrency.check_lockgraph(engine.model, lockgraph_check)
+            for p in problems:
+                print(f"lint: {p}")
+            if problems:
+                rc = EXIT_FINDINGS
+            else:
+                print(f"lint: lock graph {lockgraph_check} is fresh")
     if json_out:
         payload = result.to_json()
         payload["exit_code"] = rc

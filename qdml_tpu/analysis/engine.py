@@ -443,8 +443,18 @@ class LintEngine:
 
             rules = all_rules()
         self.rules = rules
+        # the concurrency model from the last whole_program run() —
+        # consumed by the CLI's --lockgraph rendering/freshness check
+        self.model = None
 
-    def lint_file(self, relpath: str) -> tuple[list[Finding], str | None]:
+    def lint_file(
+        self, relpath: str, pre: Iterable[Finding] = ()
+    ) -> tuple[list[Finding], str | None]:
+        """Run the per-module rules over one file. ``pre`` carries findings a
+        whole-program pass (analysis/concurrency.py) already produced for
+        this path — merged BEFORE suppression processing so an inline
+        ``# lint: disable=...`` works on them and a stale one is flagged
+        dead-suppression like any other."""
         abspath = os.path.join(self.root, relpath)
         try:
             with open(abspath, encoding="utf-8") as fh:
@@ -465,6 +475,11 @@ class LintEngine:
                     continue
                 seen_lines.add((f.rule, f.line))
                 findings.append(f)
+        for f in pre:
+            if (f.rule, f.line) in seen_lines:
+                continue
+            seen_lines.add((f.rule, f.line))
+            findings.append(f)
         # apply per-line suppressions; reason-less ones become findings
         for f in findings:
             sup = ctx.suppressions.get(f.line, {})
@@ -522,12 +537,29 @@ class LintEngine:
         paths: Iterable[str],
         baseline: dict[str, dict] | None = None,
         extra_findings: Iterable[Finding] = (),
+        whole_program: bool = True,
+        restrict_to: Iterable[str] | None = None,
     ) -> LintResult:
+        """``whole_program`` additionally runs the interprocedural
+        concurrency pass over the full scanned set (the resulting model is
+        kept on ``self.model`` for lock-graph rendering). ``restrict_to``
+        filters the REPORT to the given repo-relative paths without
+        narrowing the scan — `--changed-only` needs the whole program to
+        resolve the call closure, but only the touched files' findings."""
         result = LintResult()
         all_findings: list[Finding] = list(extra_findings)
         missing: list[str] = []
-        for relpath in iter_python_files(self.root, paths, missing=missing):
-            findings, err = self.lint_file(relpath)
+        files = iter_python_files(self.root, paths, missing=missing)
+        pre_by_path: dict[str, list[Finding]] = {}
+        if whole_program:
+            from qdml_tpu.analysis import concurrency
+
+            ctxs, _errs = concurrency.load_contexts(self.root, files)
+            pre_by_path, self.model = concurrency.analyze_modules(ctxs)
+        for relpath in files:
+            findings, err = self.lint_file(
+                relpath, pre=pre_by_path.get(relpath, ())
+            )
             if err is not None:
                 result.errors.append(err)
             all_findings.extend(findings)
@@ -536,6 +568,12 @@ class LintEngine:
                 f"{p}: no such file or directory — a gate that scans nothing "
                 "must not pass"
             )
+        if restrict_to is not None:
+            keep = set(restrict_to)
+            all_findings = [f for f in all_findings if f.path in keep]
+            result.errors = [
+                e for e in result.errors if e.split(":", 1)[0] in keep
+            ]
         baseline = baseline or {}
         for f in sorted(all_findings, key=lambda f: (f.path, f.line, f.rule)):
             if f.suppressed:

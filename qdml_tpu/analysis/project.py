@@ -391,3 +391,93 @@ WALL_TIME_CALLS: frozenset[str] = frozenset({"time", "monotonic", "perf_counter"
 # helpers themselves (they difference FIRST, then divide the delta by the
 # window width — the pattern the rule exists to funnel everything through).
 RATE_SANCTIONED_MODULES: tuple[str, ...] = ("qdml_tpu/telemetry/timeseries.py",)
+
+# ---------------------------------------------------------------------------
+# Concurrency analyzer tables (analysis/concurrency.py — docs/ANALYSIS.md
+# "whole-program concurrency").
+# ---------------------------------------------------------------------------
+
+# Calls that can block the calling thread for unbounded (or scheduling-
+# dependent) time. Reachable inside a held-lock region they serialize every
+# peer of that lock behind one slow operation (rule blocking-under-lock).
+# Matched on the callee's LAST name/attribute segment; deliberately narrow —
+# `.get()`/`.pop()` are far too generic to flag.
+BLOCKING_CALLS: frozenset[str] = frozenset(
+    {
+        # host scheduling
+        "sleep",
+        "wait",            # Event.wait / Condition.wait / Popen.wait
+        "join",            # Thread.join / Process.join
+        "result",          # concurrent.futures drain
+        # device fences (a lock held across a device sync serializes every
+        # submit behind the fence — the swap path suppresses WITH a reason)
+        "block_until_ready",
+        "device_get",
+        # socket / stream IO
+        "create_connection",
+        "connect",
+        "accept",
+        "recv",
+        "recv_into",
+        "sendall",
+        "readline",
+        "readexactly",
+        "urlopen",
+        # subprocess
+        "check_output",
+        "check_call",
+        "communicate",
+        "popen",
+        "Popen",
+    }
+)
+
+# Synchronous calls that stall the event loop when reached from an
+# ``async def`` handler without an executor hop (rule sync-io-in-async).
+# time.sleep is the classic; asyncio.sleep resolves to a different canonical
+# name and is exempt. The sanctioned escape hatches are the loop's
+# run_in_executor / asyncio.to_thread (the callable is PASSED, not called).
+ASYNC_BLOCKING_CALLS: frozenset[str] = frozenset(
+    {
+        "sleep",
+        "create_connection",
+        "connect",
+        "accept",
+        "recv",
+        "recv_into",
+        "sendall",
+        "urlopen",
+        "check_output",
+        "check_call",
+        "communicate",
+        "result",          # concurrent.futures .result() parks the loop
+        "join",
+        "run",             # subprocess.run
+    }
+)
+
+# Files whose ``async def`` handlers are on the serving event loop and are
+# therefore in scope for sync-io-in-async (a stalled loop stops EVERY
+# connection, not one request).
+ASYNC_SCOPED_FILES: tuple[str, ...] = (
+    "qdml_tpu/serve/server.py",
+    "qdml_tpu/fleet/router.py",
+)
+
+# Executor escape hatches: a callable passed INTO one of these runs off the
+# event loop, so sync work inside it is sanctioned.
+EXECUTOR_CALLS: frozenset[str] = frozenset(
+    {"run_in_executor", "to_thread", "run_coroutine_threadsafe"}
+)
+
+# Call sites whose function-valued arguments become THREAD ENTRY POINTS —
+# the roots the unmapped-shared-state rule counts distinct writers from.
+THREAD_ROOT_CALLS: frozenset[str] = frozenset(
+    {
+        "Thread",
+        "Timer",
+        "add_done_callback",
+        "call_soon_threadsafe",
+        "submit",  # executor.submit(fn, ...)
+    }
+)

@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import threading
 
+from qdml_tpu.utils import lockdep
+
 from qdml_tpu.control.events import emit_record
 
 
@@ -76,7 +78,7 @@ class Autoscaler:
         self.cooldown_ticks = max(0, int(cooldown_ticks))
         self._sink = sink
         self.dry_run = bool(dry_run)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("Autoscaler._lock")
         # the scaler's shared decision state: current target replica count
         # (None until the first observation tells us the actual count),
         # debounce streaks and the cooldown countdown

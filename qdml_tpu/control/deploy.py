@@ -45,6 +45,8 @@ from __future__ import annotations
 import dataclasses
 import threading
 
+from qdml_tpu.utils import lockdep
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -160,7 +162,7 @@ class Deployer:
         self.tol_db = float(ctl.tol_db)
         self.watch_ticks = int(ctl.watch_ticks)
         self.rollback_db = float(ctl.rollback_db)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("Deployer._lock")
         # active post-deploy watch: {"ticks_left", "ref_db", "rollback_tags",
         # "deployed_tags"} — None when no deploy is being watched
         self._watch: dict | None = None

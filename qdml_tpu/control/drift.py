@@ -41,6 +41,8 @@ from __future__ import annotations
 
 import threading
 
+from qdml_tpu.utils import lockdep
+
 from qdml_tpu.control.events import emit_record
 
 # nmse_parity streams are in dB (~10x the dynamic range of the [0, 1]
@@ -129,7 +131,7 @@ class DriftMonitor:
         self.debounce = max(1, int(debounce))
         self.min_samples = int(min_samples)
         self._sink = sink
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("DriftMonitor._lock")
         # (scenario, signal) -> {"det": PageHinkley, "hits": int, "fired": bool}
         self._windows: dict[tuple[int, str], dict] = {}
 

@@ -37,6 +37,8 @@ from __future__ import annotations
 import json
 import threading
 
+from qdml_tpu.utils import lockdep
+
 from qdml_tpu.control.events import emit_record
 
 #: scale-down is refused when windowed SLO attainment is below this (the
@@ -95,7 +97,7 @@ class FleetAutoscaler:
         self._scale_fn = scale_fn
         self._sink = sink
         self.dry_run = bool(dry_run)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("FleetAutoscaler._lock")
         self._target = self.min_backends
         self._high_streak = 0
         self._low_streak = 0

@@ -45,6 +45,8 @@ fleets.
 from __future__ import annotations
 
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 
 from qdml_tpu.fleet.router import FleetRouter, _emit_event
@@ -133,12 +135,12 @@ class BackendLifecycle:
         # lifecycle OWNS (spawned here — boot-time backends are not ours to
         # terminate). Autoscaler tick thread writes, fleet-verb status
         # readers iterate: every touch holds _lock.
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("BackendLifecycle._lock")
         self._members: dict[str, dict] = {}
         self._procs: dict[str, object] = {}
         # one membership change at a time: two concurrent fleet verbs must
         # not interleave their grow/shrink loops
-        self._scale_lock = threading.Lock()
+        self._scale_lock = lockdep.Lock("BackendLifecycle._scale_lock")
         self._seq = 0
 
     # -- bookkeeping ---------------------------------------------------------
@@ -292,7 +294,7 @@ class BackendLifecycle:
                 if not rec["ok"]:
                     break
             while self.fleet_size() > n:
-                actions.append(self.scale_down())
+                actions.append(self.scale_down())  # lint: disable=blocking-under-lock(scale ops are one-at-a-time by design: _scale_lock is the coarse serializer for admissions/retirements, held only on the control path — the dedup-grace sleep must finish before the next retirement starts)
             after = self.fleet_size()
         return {
             "backends_before": before,

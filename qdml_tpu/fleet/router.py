@@ -60,6 +60,8 @@ from __future__ import annotations
 
 import hashlib
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 from bisect import bisect_right
 from concurrent.futures import ThreadPoolExecutor
@@ -145,7 +147,7 @@ class BackendState:
         self.eject_s = float(eject_s)
         self.readmit_probes = max(1, int(readmit_probes))
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("BackendState._lock")
         self._state = CLOSED
         self._fails = 0        # consecutive failures while closed
         self._oks = 0          # consecutive half-open probe successes
@@ -270,7 +272,7 @@ class Backend:
         self.poll_ok: bool = False
         # router-side wire metrics, guarded by _mlock (request threads add
         # concurrently; Histogram is a plain list underneath)
-        self._mlock = threading.Lock()
+        self._mlock = lockdep.Lock("Backend._mlock")
         self._latency = Histogram()
         self._forwarded = 0
         self._failed = 0
@@ -279,7 +281,7 @@ class Backend:
         self._inflight = 0
         # connection pool (LIFO: reuse the warmest socket first)
         self._clients: list[ServeClient] = []
-        self._clients_lock = threading.Lock()
+        self._clients_lock = lockdep.Lock("Backend._clients_lock")
         self._made = 0
 
     # -- connection pool ----------------------------------------------------
@@ -387,7 +389,7 @@ class RouterDedup:
     def __init__(self, ttl_s: float, clock: Callable[[], float] = time.monotonic):
         self.ttl_s = float(ttl_s)
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("RouterDedup._lock")
         self._entries: dict = {}  # rid -> {"ev": Event, "rep": dict|None, "ts": float}
         self.hits = 0
 
@@ -545,18 +547,18 @@ class FleetRouter:
         # Membership changes REPLACE ring + index + backend list together
         # under _ring_lock; the lists themselves are never mutated in place,
         # so a reader's snapshot is always internally consistent.
-        self._ring_lock = threading.Lock()
+        self._ring_lock = lockdep.Lock("FleetRouter._ring_lock")
         self._ring, self._ring_idx = _ring_points(self.backends)
         self._failovers = 0
         self._no_backend = 0
-        self._counter_lock = threading.Lock()
+        self._counter_lock = lockdep.Lock("FleetRouter._counter_lock")
         # traced requests' NET wire spans (exchange minus backend-reported
         # serve total; failed attempts at full duration) — raw samples live
         # HERE, so the fleet phase table's wire row has exact quantiles while
         # backend phases aggregate by exact (n, sum). Request executor
         # threads add concurrently: every touch holds _trace_lock
         # (graftlint LOCK_MAP, analysis/project.py).
-        self._trace_lock = threading.Lock()
+        self._trace_lock = lockdep.Lock("FleetRouter._trace_lock")
         self._trace_wire = Histogram()
         self._poll_stop = threading.Event()
         self._poll_thread: threading.Thread | None = None

@@ -23,12 +23,14 @@ import ctypes
 import os
 import subprocess
 import threading
+
+from qdml_tpu.utils import lockdep
 from typing import Sequence
 
 import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "qdml_io.cpp")
-_LOCK = threading.Lock()
+_LOCK = lockdep.Lock("native_io:_LOCK")
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
 
@@ -71,7 +73,7 @@ def _load() -> ctypes.CDLL | None:
         if _TRIED:
             return _LIB
         _TRIED = True
-        path = _build_lib()
+        path = _build_lib()  # lint: disable=blocking-under-lock(one-time lazy build: _LOCK makes the native compile exactly-once; every later caller needs the library and must wait for it regardless)
         if path is None:
             return None
         try:

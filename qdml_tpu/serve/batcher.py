@@ -32,6 +32,8 @@ deadline expiry at dequeue — is deterministically testable without sleeping
 from __future__ import annotations
 
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 from collections import deque
 from typing import Callable, Sequence
@@ -96,7 +98,7 @@ class MicroBatcher:
         # warmup, after the batcher exists).
         self.continuous = bool(continuous)
         self._q: deque[Request] = deque()
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("MicroBatcher._lock")
         # Wake signal owned by the QUEUE, not any one consumer: a replica
         # pool runs several ServeLoops draining this one batcher, and a
         # submit must be able to wake whichever replica's worker is idle

@@ -27,6 +27,8 @@ counters ride the same lock.
 from __future__ import annotations
 
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 from typing import Callable
 
@@ -54,7 +56,7 @@ class CircuitBreaker:
         self.open_s = float(open_s)
         self.probes = max(1, int(probes))
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("CircuitBreaker._lock")
         self._state = CLOSED
         self._opened_at = 0.0
         self._probes_left = 0

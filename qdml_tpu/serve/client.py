@@ -30,6 +30,8 @@ import json
 import random
 import socket
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 import uuid
 
@@ -66,7 +68,7 @@ class ServeClient:
         self.backoff_max_s = float(backoff_max_s)
         self.jitter = float(jitter)
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("ServeClient._lock")
         self._sock: socket.socket | None = None
         self._rfile = None
         self._was_connected = False
@@ -158,11 +160,11 @@ class ServeClient:
             per_try = timeout_s if remaining is None else min(timeout_s, remaining)
             try:
                 with self._lock:
-                    self._ensure_connected(per_try)
+                    self._ensure_connected(per_try)  # lint: disable=blocking-under-lock(the hold IS the wire protocol: one in-flight exchange per connection — _lock serializes this client's threads over one socket, reconnect included)
                     self._sock.settimeout(per_try)
-                    self._sock.sendall(payload)
+                    self._sock.sendall(payload)  # lint: disable=blocking-under-lock(the hold IS the wire protocol: one request/reply exchange owns the socket; send stays under _lock so a peer thread cannot interleave bytes)
                     while True:
-                        line = self._rfile.readline()
+                        line = self._rfile.readline()  # lint: disable=blocking-under-lock(the hold IS the wire protocol: the reply read belongs to the same exchange as the send; socket timeout bounds the wait)
                         if not line:
                             raise ConnectionResetError(
                                 "server closed the connection"

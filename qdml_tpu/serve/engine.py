@@ -67,6 +67,8 @@ treats the pad tail is the third measured-dispatch choice
 from __future__ import annotations
 
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 from typing import Any
 
@@ -156,7 +158,7 @@ class ServeEngine:
         # swap_params re-places new checkpoints with these SAME shardings,
         # which is what makes the swap recompile-free.
         self._var_shardings = self._build_var_shardings(hdce_vars, clf_vars)
-        self._swap_lock = threading.Lock()
+        self._swap_lock = lockdep.Lock("ServeEngine._swap_lock")
         # serializes whole swaps (resolve -> restore -> validate -> place ->
         # flip): two concurrent {"op": "swap"}s racing check-then-act could
         # land in reverse completion order and leave the OLDER checkpoint
@@ -164,7 +166,7 @@ class ServeEngine:
         # restore too, not just the flip (reentrant: swap_params re-acquires
         # on the same thread). Never held on the request path — infer only
         # takes the inner _swap_lock.
-        self._swap_gate = threading.RLock()
+        self._swap_gate = lockdep.RLock("ServeEngine._swap_gate")
         self._swap_epoch = 0
         self._live = (
             self._place(hdce_vars, self._var_shardings[0]),
@@ -212,7 +214,7 @@ class ServeEngine:
         # served by the dense fallback, never dropped — the RATE is the
         # capacity_factor health signal serve_summary reports and the report
         # gate watches)
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = lockdep.Lock("ServeEngine._dispatch_lock")
         self._overflow_rows = 0
         self._routed_rows = 0
 
@@ -376,7 +378,7 @@ class ServeEngine:
             new_c = self._place(clf_vars, self._var_shardings[1])
             # fault the transfers in OFF the request path: the first
             # post-swap batch must not pay the host->device copy
-            jax.block_until_ready((new_h, new_c))
+            jax.block_until_ready((new_h, new_c))  # lint: disable=blocking-under-lock(sanctioned off-request-path sync: the fence keeps half-copied params off replicas; _swap_gate is only ever held by swap/control calls, never the request path)
             post = compile_cache_stats()
             with self._swap_lock:
                 self._swap_epoch += 1
@@ -431,7 +433,7 @@ class ServeEngine:
                         "(see the reconcile note above) — deploy it with a "
                         "fresh engine + warmup, not a swap"
                     )
-            rec = self.swap_params(hdce_vars, clf_vars)
+            rec = self.swap_params(hdce_vars, clf_vars)  # lint: disable=blocking-under-lock(sanctioned off-request-path sync: swap_from_workdir is a control verb; _swap_gate re-entry serializes it with swap_params by design)
         rec["tags"] = {"hdce": hdce_tag, clf_prefix: clf_tag}
         return rec
 

@@ -41,6 +41,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from qdml_tpu.utils import lockdep
 from dataclasses import dataclass, field
 
 FAULT_CLASSES = (
@@ -117,7 +119,7 @@ class FaultPlan:
         self.specs = list(specs or [])
         self.seed = int(seed)
         self.rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("FaultPlan._lock")
         self._counts: dict[str, int] = {}
         self.fired: list[dict] = []  # audit trail: every fault that fired
 

@@ -42,6 +42,8 @@ attainment into the serving-SLO gate).
 from __future__ import annotations
 
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 
@@ -600,7 +602,7 @@ def run_loadgen_socket(
     # so the harness serializes its bookkeeping — read-modify-write counter
     # interleavings would silently undercount the very numbers the chaos
     # gates read (SLO rows, sheds)
-    mlock = threading.Lock()
+    mlock = lockdep.Lock("loadgen:mlock")
     shed_counts: dict[str, int] = {}
     give_ups = 0
     replies: list[dict | None] = [None] * n

@@ -56,6 +56,8 @@ import os
 import random
 import socket
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 from concurrent.futures import Future
 from typing import Callable
@@ -102,7 +104,7 @@ class ExitCoordinator:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("ExitCoordinator._lock")
         self._live = 0
 
     def enter(self, n: int) -> None:
@@ -591,7 +593,7 @@ class ReplicaPool:
             if serve_cfg.breaker
             else None
         )
-        self._pool_lock = threading.Lock()
+        self._pool_lock = lockdep.Lock("ReplicaPool._pool_lock")
         self._started = False
         self._next_id = n_replicas
         self._replicas = [
@@ -963,7 +965,7 @@ class DedupCache:
     def __init__(self, ttl_s: float, clock: Callable[[], float] = time.monotonic):
         self.ttl_s = float(ttl_s)
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("DedupCache._lock")
         self._entries: dict = {}  # rid -> (future, inserted_at)
         self.hits = 0
 
@@ -1313,11 +1315,11 @@ def run_server(
         # wait on BOTH: a bind failure must propagate, not hang on `ready`
         await asyncio.wait({task, ready}, return_when=asyncio.FIRST_COMPLETED)
         if task.done():
-            return task.result()
+            return task.result()  # lint: disable=sync-io-in-async(task.done() was just checked: result() on a completed future returns immediately, it only propagates the bind failure)
         print(
             json.dumps(
                 {
-                    "serving": f"{cfg.serve.host}:{ready.result()}",
+                    "serving": f"{cfg.serve.host}:{ready.result()}",  # lint: disable=sync-io-in-async(FIRST_COMPLETED with task not done means ready resolved: result() on a completed future returns immediately)
                     "host_id": host_id,
                     "buckets": list(engine.buckets),
                     "batching": engine.batching_summary(),

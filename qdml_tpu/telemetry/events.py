@@ -40,6 +40,8 @@ cheap enough to leave always-on.
 from __future__ import annotations
 
 import threading
+
+from qdml_tpu.utils import lockdep
 import time
 from collections import deque
 
@@ -50,7 +52,7 @@ DEFAULT_TAIL_LIMIT = 512
 # wall-clock millisecond (a fast in-process restart, or tests) must still
 # get DISTINCT start_seq epochs, or a stale cursor would silently "match"
 # the replacement ring and skip its first events
-_epoch_lock = threading.Lock()
+_epoch_lock = lockdep.Lock("events:_epoch_lock")
 _last_epoch = 0
 
 
@@ -127,7 +129,7 @@ class EventBus:
         # start_seq: a cursor from before a process restart mismatches and
         # the tail restarts from the head instead of skipping new events
         self.start_seq = _new_epoch()
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock("EventBus._lock")
         self._ring: deque = deque()
         self._seq = 0
         self._dropped = 0
@@ -216,7 +218,7 @@ class EventBus:
 # -- process-global bus (mirrors spans.set_sink / get_sink) ------------------
 
 _bus: EventBus | None = None
-_bus_guard = threading.Lock()
+_bus_guard = lockdep.Lock("events:_bus_guard")
 
 
 def install_bus(bus: EventBus | None) -> None:

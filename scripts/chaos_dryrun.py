@@ -32,7 +32,17 @@ Writes ``results/chaos_dryrun/``:
 - ``CHAOS_DRYRUN.json`` — the headline: per-class checks + all_pass.
 
 Run: ``python scripts/chaos_dryrun.py [--n=160] [--rate=400]
-[--deadline-ms=50] [--devices=2] [--seed=0]``
+[--deadline-ms=50] [--devices=2] [--seed=0] [--classes=a,b,...]
+[--out-dir=DIR]``
+
+``--classes`` restricts the matrix to a subset of fault classes (the
+``QDML_LOCKDEP=1`` witness re-run and the tier-1 smoke use this);
+``--out-dir`` redirects the artifact tree — the committed
+``results/chaos_dryrun/`` windows that ``run_tier1.sh`` stage-2 gates over
+must never be overwritten by a partial re-run. The headline always carries
+a ``lockdep`` block (:func:`qdml_tpu.utils.lockdep.witness_summary`): with
+``QDML_LOCKDEP=1`` the run fails unless zero lock-order inversions were
+witnessed across every injected crash, restart, and swap.
 
 Virtual-device timings measure supervision/retry/protocol behavior, not
 ICI; on a real pod the same script re-runs and the same gates arm on TPU
@@ -75,6 +85,8 @@ def main(argv: list[str]) -> int:
     # real hardware re-runs, tighten back toward the default 10%.
     threshold = _arg(argv, "threshold", "50")
     seed = int(_arg(argv, "seed", "0"))
+    only_classes = [c for c in _arg(argv, "classes", "").split(",") if c]
+    out_dir = _arg(argv, "out-dir", os.path.join("results", "chaos_dryrun"))
     force_cpu(devices)
 
     import asyncio
@@ -104,9 +116,18 @@ def main(argv: list[str]) -> int:
     from qdml_tpu.train.checkpoint import save_checkpoint
     from qdml_tpu.train.hdce import init_hdce_state
     from qdml_tpu.train.qsc import init_sc_state
+    from qdml_tpu.utils import lockdep
     from qdml_tpu.utils.metrics import MetricsLogger
 
-    out_dir = os.path.join("results", "chaos_dryrun")
+    run_classes = list(FAULT_CLASSES)
+    if only_classes:
+        unknown = [c for c in only_classes if c not in FAULT_CLASSES]
+        if unknown:
+            print(f"chaos_dryrun: unknown --classes {unknown}; "
+                  f"valid: {list(FAULT_CLASSES)}")
+            return 2
+        run_classes = only_classes
+
     os.makedirs(out_dir, exist_ok=True)
     scratch = tempfile.mkdtemp(prefix="chaos_")
 
@@ -330,8 +351,10 @@ def main(argv: list[str]) -> int:
                      "completed": base_summary["completed"]},
         "classes": {},
     }
+    if only_classes:
+        headline["classes_filter"] = run_classes
     all_pass = True
-    for kind in FAULT_CLASSES:
+    for kind in run_classes:
         plan = worker_plans[kind]() if kind in worker_plans else FaultPlan(seed=seed)
         pool = fresh_pool(plan).start()
         fault_summary, _fault_path = serve_window(
@@ -428,11 +451,24 @@ def main(argv: list[str]) -> int:
     compile_delta = engine.request_path_compiles()
     headline["compile_delta_after_all_classes"] = compile_delta
     all_pass = all_pass and all(v == 0 for v in compile_delta.values())
+    # the runtime lock-order witness: with QDML_LOCKDEP=1 every lock in the
+    # stack recorded its acquisition edges across injected crashes,
+    # restarts, and swaps — zero inversions is part of the headline gate
+    # (disabled runs record the block too, with enabled=false, so the
+    # committed artifact documents which mode produced it)
+    witness = lockdep.witness_summary()
+    headline["lockdep"] = witness
+    if witness["enabled"]:
+        all_pass = all_pass and witness["inversions"] == 0
     headline["all_pass"] = all_pass
     batching_autotune.set_table_path(None)
     with open(os.path.join(out_dir, "CHAOS_DRYRUN.json"), "w") as fh:
         json.dump(headline, fh, indent=2)
-    print(json.dumps({"all_pass": all_pass, "compile_delta": compile_delta}))
+    print(json.dumps({
+        "all_pass": all_pass, "compile_delta": compile_delta,
+        "lockdep": {k: witness[k] for k in
+                    ("enabled", "locks", "edges", "inversions")},
+    }))
     return 0 if all_pass else 1
 
 

@@ -623,6 +623,13 @@ def main(argv: list[str]) -> int:
     for p in prepared:
         p.kill()
     esink.close()
+    # runtime lock-order witness (QDML_LOCKDEP=1 re-runs gate on zero
+    # inversions; disabled runs record the block with enabled=false)
+    from qdml_tpu.utils import lockdep
+    witness = lockdep.witness_summary()
+    headline["lockdep"] = witness
+    if witness["enabled"]:
+        all_pass = all_pass and witness["inversions"] == 0
     headline["all_pass"] = all_pass
     with open(os.path.join(out_dir, "FLEET_ELASTIC.json"), "w") as fh:
         json.dump(headline, fh, indent=2, default=str)

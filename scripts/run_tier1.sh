@@ -20,6 +20,34 @@
 # accounting; edit ROADMAP.md first if that line ever needs to change).
 cd "$(dirname "$0")/.." || exit 2
 python -m qdml_tpu.cli lint --baseline || exit 5
+# Concurrency stage (exit-5 family, docs/ANALYSIS.md "whole-program
+# concurrency"): the lint call above already ran the four concurrency rules
+# (they ride the same baseline/suppression gate); what remains is the
+# artifact discipline — the committed static lock-order graph
+# (results/lockgraph/) must byte-match a regenerated one (the documented
+# hierarchy is generated, never asserted) and stay cycle-free, and the
+# committed QDML_LOCKDEP=1 chaos witness (results/lockdep_dryrun/) must
+# certify zero runtime lock-order inversions across injected crash +
+# restart + swap.
+python -m qdml_tpu.cli lint --baseline --lockgraph-check > /dev/null \
+  || { echo "lock graph stale or cyclic: run 'qdml-tpu lint --lockgraph' and commit results/lockgraph/"; exit 5; }
+python -c "
+import json, sys
+g = json.load(open('results/lockgraph/lockgraph.json'))
+sys.exit(1 if g.get('cycles') else 0)
+" || { echo "committed lock graph contains cycles"; exit 5; }
+if [ -f results/lockdep_dryrun/CHAOS_DRYRUN.json ]; then
+  python -c "
+import json, sys
+d = json.load(open('results/lockdep_dryrun/CHAOS_DRYRUN.json'))
+w = d.get('lockdep') or {}
+ok = (d.get('all_pass') and w.get('enabled') is True
+      and w.get('inversions') == 0 and (w.get('locks') or 0) > 0)
+sys.exit(0 if ok else 1)
+" || { echo "lockdep witness artifact failed (enabled/zero-inversions/all_pass)"; exit 5; }
+else
+  echo "missing results/lockdep_dryrun/CHAOS_DRYRUN.json (QDML_LOCKDEP=1 chaos witness)"; exit 5
+fi
 # One parameterized pass over the committed chaos-style artifact sets
 # (results/chaos_dryrun, results/fleet_router, results/fleet_elastic —
 # docs/RESILIENCE.md, docs/FLEET.md): every recovery window re-arms the

@@ -1,0 +1,24 @@
+"""Fixture: sync-io-in-async — a synchronous sleep directly in an async
+handler, one reached through a sync same-module helper, and the two
+sanctioned shapes (awaited asyncio.sleep; run_in_executor hop). The test
+presents this file under an ASYNC_SCOPED_FILES path."""
+import asyncio
+import time
+
+
+def _sync_helper():
+    time.sleep(0.01)
+
+
+async def bad_handler(reader, writer):
+    time.sleep(0.01)
+
+
+async def bad_closure_handler(reader, writer):
+    _sync_helper()
+
+
+async def good_handler(reader, writer):
+    await asyncio.sleep(0.01)
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, lambda: time.sleep(0.01))

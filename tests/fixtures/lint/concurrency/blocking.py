@@ -1,0 +1,21 @@
+"""Fixture: blocking-under-lock — a sleep directly inside a held region and
+one reached through the same-class call closure."""
+import threading
+import time
+
+
+class Blocky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.done = threading.Event()
+
+    def direct(self):
+        with self._lock:
+            time.sleep(0.1)
+
+    def through_helper(self):
+        with self._lock:
+            self._settle()
+
+    def _settle(self):
+        self.done.wait(1.0)

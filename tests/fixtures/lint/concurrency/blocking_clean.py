@@ -1,0 +1,24 @@
+"""Fixture twin: the same blocking work, moved OUTSIDE the held region —
+take the lock for the state flip only."""
+import threading
+import time
+
+
+class Patient:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.done = threading.Event()
+        self.n = 0
+
+    def direct(self):
+        time.sleep(0.1)
+        with self._lock:
+            self.n += 1
+
+    def through_helper(self):
+        self._settle()
+        with self._lock:
+            self.n += 1
+
+    def _settle(self):
+        self.done.wait(1.0)

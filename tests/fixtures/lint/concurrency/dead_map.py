@@ -1,0 +1,14 @@
+"""Fixture: dead-lock-map-entry — ``Here`` exists with ``_live`` guarded by
+``_lock``; the test's lock map also claims a renamed attribute, a renamed
+lock, and a class that no longer exists."""
+import threading
+
+
+class Here:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live = 0
+
+    def bump(self):
+        with self._lock:
+            self._live += 1

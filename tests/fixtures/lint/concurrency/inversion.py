@@ -1,0 +1,20 @@
+"""Fixture: lock-order-inversion — the same two locks nested in both
+orders. Two threads walking the cycle from different ends deadlock."""
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                self.n -= 1

@@ -1,0 +1,29 @@
+"""Fixture twin: consistent a-before-b ordering plus SEQUENTIAL use of the
+same locks — sequential acquisition (release before the next acquire) adds
+no graph edge, only nesting does."""
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def also_forward(self):
+        with self._a:
+            with self._b:
+                self.n -= 1
+
+    def sequential(self):
+        # b released before a is taken: argument-evaluation order, not
+        # nesting — must NOT create a b->a edge (which would fake a cycle)
+        with self._b:
+            x = self.n
+        with self._a:
+            self.n = x

@@ -1,0 +1,43 @@
+"""Fixture: unmapped-shared-state — ``_count`` is written from a spawned
+thread's loop AND from the caller's thread, with no LOCK_MAP row. The
+``Guarded`` twin has the identical shape but its row (passed by the test)
+sanctions it; ``Solo`` is written from the caller only."""
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        self._count += 1
+
+    def bump(self):
+        self._count += 1
+
+
+class Guarded:
+    def __init__(self):
+        self._count = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._lock:
+            self._count += 1
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+
+class Solo:
+    def __init__(self):
+        self._count = 0
+
+    def bump(self):
+        self._count += 1
+
+    def bump_again(self):
+        self._count += 1

@@ -1,0 +1,419 @@
+"""Whole-program concurrency analyzer + runtime lockdep witness (ISSUE 19).
+
+Static half (qdml_tpu/analysis/concurrency.py): the four rules over
+on-disk fixtures presented at qdml_tpu-shaped fake paths (the same pattern
+the per-module rule tests use), edge precision (nesting makes an edge,
+sequential acquisition does not), RLock re-entry exemption, the committed
+``results/lockgraph/`` artifact's freshness contract, and suppression/
+dead-suppression flowing through the engine like any per-module rule.
+
+Runtime half (qdml_tpu/utils/lockdep.py): disabled mode IS the stdlib
+class (import-time inert, zero overhead — the checkify-off discipline),
+enabled mode witnesses edges and raises a typed LockOrderError naming both
+edges and both first-seen stacks, and one full chaos fault class re-runs
+under QDML_LOCKDEP=1 pinning zero inversions across crash + restart.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+from collections import Counter
+
+import pytest
+
+from qdml_tpu.analysis import concurrency
+from qdml_tpu.analysis import cli as lint_cli
+from qdml_tpu.analysis.engine import LintEngine, ModuleContext
+from qdml_tpu.utils import lockdep
+
+REPO = lint_cli.repo_root()
+FIXDIR = os.path.join("tests", "fixtures", "lint", "concurrency")
+
+
+def _fixture_ctx(name: str, fake_path: str) -> ModuleContext:
+    with open(os.path.join(REPO, FIXDIR, name), encoding="utf-8") as fh:
+        src = fh.read()
+    return ModuleContext(
+        os.path.join("/fake", fake_path), fake_path, src, ast.parse(src)
+    )
+
+
+def _inline_ctx(src: str, fake_path: str) -> ModuleContext:
+    src = textwrap.dedent(src)
+    return ModuleContext(
+        os.path.join("/fake", fake_path), fake_path, src, ast.parse(src)
+    )
+
+
+def _analyze(*ctxs, lock_map=None):
+    return concurrency.analyze_modules(list(ctxs), lock_map=lock_map or {})
+
+
+def _rules(grouped) -> Counter:
+    return Counter(f.rule for fs in grouped.values() for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# static half: the four rules over fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_inversion_fixture_flags_the_cycle():
+    grouped, model = _analyze(
+        _fixture_ctx("inversion.py", "qdml_tpu/serve/inversion.py")
+    )
+    assert model.cycles() == [["Inverted._a", "Inverted._b"]]
+    findings = [
+        f for fs in grouped.values() for f in fs
+        if f.rule == "lock-order-inversion"
+    ]
+    # one finding per participating edge: either line is a fix site
+    assert len(findings) == 2
+    for f in findings:
+        assert "Inverted._a" in f.message and "Inverted._b" in f.message
+        assert "deadlock" in f.message
+
+
+def test_ordered_fixture_is_clean_and_sequential_makes_no_edge():
+    grouped, model = _analyze(
+        _fixture_ctx("inversion_clean.py", "qdml_tpu/serve/ordered.py")
+    )
+    assert _rules(grouped)["lock-order-inversion"] == 0
+    assert ("Ordered._a", "Ordered._b") in model.edges
+    # sequential() takes b then a NON-nested: no reverse edge, no fake cycle
+    assert ("Ordered._b", "Ordered._a") not in model.edges
+    assert model.cycles() == []
+
+
+def test_blocking_fixture_direct_and_closure():
+    grouped, _ = _analyze(
+        _fixture_ctx("blocking.py", "qdml_tpu/serve/blocking.py")
+    )
+    findings = sorted(
+        (f for fs in grouped.values() for f in fs
+         if f.rule == "blocking-under-lock"),
+        key=lambda f: f.line,
+    )
+    assert len(findings) == 2
+    assert "sleep" in findings[0].text                 # direct site
+    assert "self._settle()" in findings[1].text        # closure call site
+    assert "wait()" in findings[1].message             # names the blocker
+
+
+def test_blocking_clean_fixture():
+    grouped, _ = _analyze(
+        _fixture_ctx("blocking_clean.py", "qdml_tpu/serve/patient.py")
+    )
+    assert _rules(grouped)["blocking-under-lock"] == 0
+
+
+def test_sync_io_in_async_fixture():
+    # presented AS serve/server.py: the rule only arms on the event-loop
+    # files (project.ASYNC_SCOPED_FILES)
+    grouped, _ = _analyze(
+        _fixture_ctx("async_io.py", "qdml_tpu/serve/server.py")
+    )
+    findings = sorted(
+        (f for fs in grouped.values() for f in fs
+         if f.rule == "sync-io-in-async"),
+        key=lambda f: f.line,
+    )
+    assert len(findings) == 2
+    assert {f.context for f in findings} == {
+        "bad_handler", "bad_closure_handler"
+    }
+    # the same source OUTSIDE the scoped files is silent
+    grouped, _ = _analyze(
+        _fixture_ctx("async_io.py", "qdml_tpu/serve/other.py")
+    )
+    assert _rules(grouped)["sync-io-in-async"] == 0
+
+
+def test_unmapped_shared_state_fixture():
+    row = {"qdml_tpu/serve/shared_state.py": {"Guarded": {"_count": "_lock"}}}
+    grouped, _ = _analyze(
+        _fixture_ctx("shared_state.py", "qdml_tpu/serve/shared_state.py"),
+        lock_map=row,
+    )
+    findings = [
+        f for fs in grouped.values() for f in fs
+        if f.rule == "unmapped-shared-state"
+    ]
+    # Racy: thread root + caller, no row -> flagged. Guarded: identical
+    # shape, row sanctions it. Solo: caller-only writes, one entry point.
+    assert len(findings) == 1
+    assert "Racy._count" in findings[0].message
+    assert "thread:_loop" in findings[0].message
+    assert "caller" in findings[0].message
+
+
+def test_dead_lock_map_fixture():
+    stale_map = {
+        "qdml_tpu/serve/dead_map.py": {
+            "Here": {"_old": "_lock", "_live": "_zap_lock"},
+            "Gone": {"_x": "_l"},
+        },
+        "qdml_tpu/serve/missing.py": {"Nobody": {"_y": "_l"}},
+    }
+    grouped, _ = _analyze(
+        _fixture_ctx("dead_map.py", "qdml_tpu/serve/dead_map.py"),
+        _inline_ctx("LOCK_MAP = {}\n", "qdml_tpu/analysis/project.py"),
+        lock_map=stale_map,
+    )
+    msgs = [
+        f.message for fs in grouped.values() for f in fs
+        if f.rule == "dead-lock-map-entry"
+    ]
+    assert len(msgs) == 4
+    assert any("_old" in m and "never assigned" in m for m in msgs)
+    assert any("_zap_lock" in m and "not constructed" in m for m in msgs)
+    assert any("class 'Gone'" in m for m in msgs)
+    assert any("missing.py" in m and "not in the scanned tree" in m for m in msgs)
+
+
+def test_static_rlock_reentry_no_self_cycle():
+    ctx = _inline_ctx(
+        """
+        import threading
+
+
+        class Gate:
+            def __init__(self):
+                self._gate = threading.RLock()
+
+            def outer(self):
+                with self._gate:
+                    self.inner()
+
+            def inner(self):
+                with self._gate:
+                    return 1
+        """,
+        "qdml_tpu/serve/gate.py",
+    )
+    grouped, model = _analyze(ctx)
+    assert model.locks["Gate._gate"].kind == "rlock"
+    assert model.cycles() == []
+    assert _rules(grouped)["lock-order-inversion"] == 0
+
+
+def test_engine_suppression_and_dead_suppression_for_concurrency(tmp_path):
+    """Concurrency findings merge BEFORE suppression processing: an inline
+    reasoned disable suppresses them, and a stale one goes dead-suppression
+    — same machinery as every per-module rule."""
+    (tmp_path / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            import time
+
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def f(self):
+                    with self._lock:
+                        time.sleep(0.1)  # lint: disable=blocking-under-lock(test: the hold is the point)
+
+                def g(self):
+                    self.n += 1  # lint: disable=unmapped-shared-state(stale: single entry point, rule never fires here)
+            """
+        )
+    )
+    result = LintEngine(str(tmp_path)).run(["mod.py"])
+    sup = [f for f in result.suppressed if f.rule == "blocking-under-lock"]
+    assert len(sup) == 1 and sup[0].reason.startswith("test:")
+    rules = Counter(f.rule for f in result.new)
+    assert rules["dead-suppression"] == 1
+    assert rules["blocking-under-lock"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the committed repo artifacts
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lock_graph_is_cycle_free_and_fresh():
+    """The acceptance pin: the real package's lock-order graph has no cycle,
+    and the committed results/lockgraph/ byte-matches a regeneration (the
+    documented hierarchy is generated, never asserted)."""
+    _grouped, model = concurrency.analyze_files(REPO)
+    assert model.cycles() == []
+    assert concurrency.check_lockgraph(
+        model, os.path.join(REPO, "results", "lockgraph")
+    ) == []
+
+
+def test_lockgraph_check_detects_staleness(tmp_path):
+    _grouped, model = concurrency.analyze_files(REPO)
+    out = tmp_path / "lockgraph"
+    concurrency.write_lockgraph(model, str(out))
+    assert concurrency.check_lockgraph(model, str(out)) == []
+    graph = json.loads((out / "lockgraph.json").read_text())
+    graph["nodes"] = graph["nodes"][:-1]  # a lock vanished from the record
+    (out / "lockgraph.json").write_text(json.dumps(graph))
+    problems = concurrency.check_lockgraph(model, str(out))
+    assert problems and "stale" in problems[0]
+
+
+def test_repo_lockdep_witness_artifact_certifies_zero_inversions():
+    path = os.path.join(REPO, "results", "lockdep_dryrun", "CHAOS_DRYRUN.json")
+    with open(path) as fh:
+        d = json.load(fh)
+    w = d["lockdep"]
+    assert w["enabled"] is True
+    assert w["inversions"] == 0 and w["inversion_edges"] == []
+    assert w["locks"] > 0 and w["edges"] > 0
+    assert d["all_pass"] is True
+    # the witnessed classes cover crash + restart + swap
+    assert set(d["classes"]) == {"replica_crash", "corrupt_swap"}
+    assert d["classes"]["replica_crash"]["restarts"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# runtime half: lockdep unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_lockdep_disabled_is_the_stdlib_class(monkeypatch):
+    monkeypatch.delenv("QDML_LOCKDEP", raising=False)
+    assert type(lockdep.Lock("X")) is type(threading.Lock())
+    assert type(lockdep.RLock("X")) is type(threading.RLock())
+    # import-time constructions in the package picked the stdlib path too
+    from qdml_tpu.runtime import native_io
+
+    assert type(native_io._LOCK) is type(threading.Lock())
+    # a real class constructed now: stdlib lock, zero wrapper overhead
+    from qdml_tpu.serve.faults import FaultPlan
+
+    assert type(FaultPlan(seed=0)._lock) is type(threading.Lock())
+
+
+@pytest.fixture
+def witnessed(monkeypatch):
+    monkeypatch.setenv("QDML_LOCKDEP", "1")
+    lockdep.reset()
+    yield
+    lockdep.reset()
+
+
+def test_lockdep_consistent_order_is_clean(witnessed):
+    a, b = lockdep.Lock("A"), lockdep.Lock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    w = lockdep.witness_summary()
+    assert w["enabled"] is True
+    assert w["edges"] == 1 and w["inversions"] == 0
+    assert w["locks"] == 2 and w["max_held"] == 2
+
+
+def test_lockdep_inversion_raises_typed_error_with_both_stacks(witnessed):
+    a, b = lockdep.Lock("A"), lockdep.Lock("B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockdep.LockOrderError) as exc:
+        with b:
+            with a:
+                pass
+    e = exc.value
+    assert e.first == ("A", "B") and e.second == ("B", "A")
+    assert "first-seen stack for A -> B" in str(e)
+    assert "acquiring stack for B -> A" in str(e)
+    assert e.first_stack and e.second_stack
+    # recorded before the raise: the counter survives swallowed exceptions
+    assert lockdep.witness_summary()["inversions"] == 1
+
+
+def test_lockdep_rlock_reentry_is_exempt(witnessed):
+    g = lockdep.RLock("G")
+    with g:
+        with g:
+            pass
+    w = lockdep.witness_summary()
+    assert w["edges"] == 0 and w["inversions"] == 0
+
+
+def test_lockdep_env_read_at_construction(witnessed, monkeypatch):
+    assert isinstance(lockdep.Lock("now"), lockdep._DepLock)
+    monkeypatch.delenv("QDML_LOCKDEP")
+    assert type(lockdep.Lock("later")) is type(threading.Lock())
+
+
+# ---------------------------------------------------------------------------
+# --changed-only
+# ---------------------------------------------------------------------------
+
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, check=True, capture_output=True,
+    )
+
+
+def test_changed_only_scopes_the_report(tmp_path, monkeypatch):
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "clean_mod.py").write_text(
+        "y = 2  # lint: disable=broad-except\n"  # bare-suppression finding
+    )
+    _git(tmp_path, "add", "clean_mod.py")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (tmp_path / "touched.py").write_text(
+        "x = 1  # lint: disable=broad-except\n"  # bare-suppression finding
+    )
+    assert lint_cli.changed_files(str(tmp_path)) == ["touched.py"]
+    # the full run sees both findings; restrict_to reports the touched file
+    engine = LintEngine(str(tmp_path))
+    full = engine.run(["clean_mod.py", "touched.py"])
+    assert len(full.new) == 2
+    scoped = engine.run(
+        ["clean_mod.py", "touched.py"], restrict_to=["touched.py"]
+    )
+    assert [f.path for f in scoped.new] == ["touched.py"]
+    # the CLI flag end-to-end: findings in the touched file fail the gate...
+    monkeypatch.setattr(lint_cli, "repo_root", lambda: str(tmp_path))
+    assert lint_cli.lint_main(
+        ["--paths=clean_mod.py,touched.py", "--changed-only"]
+    ) == 1
+    # ...and a clean tree short-circuits to OK even with committed findings
+    _git(tmp_path, "add", "touched.py")
+    _git(tmp_path, "commit", "-qm", "touch")
+    assert lint_cli.lint_main(
+        ["--paths=clean_mod.py,touched.py", "--changed-only"]
+    ) == 0
+
+
+# ---------------------------------------------------------------------------
+# the chaos witness, live (tier-1, slow-allowlisted)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_fault_class_under_lockdep(tmp_path):
+    """One full chaos fault class (replica_crash: injected crash, supervised
+    restart, recovery windows) re-run with QDML_LOCKDEP=1 — the whole
+    serving stack's locks witnessed live, zero inversions. The committed
+    results/lockdep_dryrun/ artifact extends this to corrupt_swap."""
+    out = tmp_path / "lockdep_chaos"
+    env = dict(os.environ, QDML_LOCKDEP="1", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "scripts/chaos_dryrun.py",
+         "--classes=replica_crash", "--n=160", f"--out-dir={out}"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    with open(out / "CHAOS_DRYRUN.json") as fh:
+        d = json.load(fh)
+    w = d["lockdep"]
+    assert w["enabled"] is True and w["inversions"] == 0
+    assert w["locks"] > 0 and w["edges"] > 0
+    assert d["all_pass"] is True
+    assert d["classes"]["replica_crash"]["restarts"] >= 1
